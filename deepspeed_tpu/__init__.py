@@ -1,0 +1,120 @@
+"""deepspeed_tpu — a TPU-native training & inference framework with the DeepSpeed
+feature surface (reference: gwsshs22/DeepSpeed v0.13.2), built on JAX/XLA/Pallas.
+
+Top-level API parity with ``deepspeed/__init__.py``:
+``initialize()`` (:64), ``init_inference()`` (:263), ``add_config_arguments()``
+(:240), ``init_distributed`` re-export (:38).
+"""
+
+import argparse
+import os
+import sys
+from typing import Optional, Union
+
+from deepspeed_tpu import comm as comm
+from deepspeed_tpu.accelerator import get_accelerator
+from deepspeed_tpu.comm.comm import init_distributed
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.utils import groups, logger, log_dist
+from deepspeed_tpu.version import __version__, git_branch, git_hash
+
+dist = comm
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mpu=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               mesh=None,
+               loss_fn=None,
+               param_specs=None,
+               rng_seed=0,
+               config_params=None):
+    """Initialize the DeepSpeed-TPU engine (reference deepspeed/__init__.py:64).
+
+    Differences forced by the functional SPMD model:
+      - ``model`` is a flax module (whose ``apply(params, batch)`` returns the
+        scalar loss) or a pure ``loss_fn(params, batch[, rng])`` callable.
+      - ``model_parameters`` is the *initial parameter pytree* (the torch version
+        takes a parameter list off an already-materialized module).
+      - ``mesh``/``param_specs`` optionally override topology/TP placement.
+
+    Returns the reference's 4-tuple: (engine, optimizer, dataloader, lr_scheduler).
+    """
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    log_dist(f"DeepSpeed-TPU info: version={__version__}, git-hash={git_hash}, git-branch={git_branch}", ranks=[0])
+
+    if config is None:
+        config = config_params
+    if config is None and args is not None and hasattr(args, "deepspeed_config") and args.deepspeed_config:
+        config = args.deepspeed_config
+    assert config is not None, "DeepSpeed requires --deepspeed_config to specify configuration file"
+
+    # Pipeline-parallel models route to the pipeline engine (reference :156-196)
+    engine_cls = DeepSpeedEngine
+    try:
+        from deepspeed_tpu.runtime.pipe.module import PipelineModule
+        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+        if isinstance(model, PipelineModule):
+            engine_cls = PipelineEngine
+    except ImportError:
+        pass
+
+    engine = engine_cls(args=args,
+                        model=model,
+                        optimizer=optimizer,
+                        model_parameters=model_parameters,
+                        training_data=training_data,
+                        lr_scheduler=lr_scheduler,
+                        mpu=mpu,
+                        dist_init_required=dist_init_required,
+                        collate_fn=collate_fn,
+                        config=config,
+                        mesh=mesh,
+                        loss_fn=loss_fn,
+                        param_specs=param_specs,
+                        rng_seed=rng_seed)
+
+    return_items = [engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler]
+    return tuple(return_items)
+
+
+def add_config_arguments(parser):
+    """Reference deepspeed/__init__.py:240."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed",
+                       default=False,
+                       action="store_true",
+                       help="Enable DeepSpeed (helper flag for user code, no impact on DeepSpeed backend)")
+    group.add_argument("--deepspeed_config", default=None, type=str, help="DeepSpeed json configuration file.")
+    group.add_argument("--deepscale",
+                       default=False,
+                       action="store_true",
+                       help="Deprecated enable DeepSpeed (helper flag for user code, no impact)")
+    group.add_argument("--deepscale_config", default=None, type=str, help="Deprecated DeepSpeed json config file.")
+    return parser
+
+
+def default_inference_config():
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    return DeepSpeedInferenceConfig().model_dump()
+
+
+def init_inference(model, config=None, **kwargs):
+    """Reference deepspeed/__init__.py:263."""
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    log_dist(f"DeepSpeed-TPU info: version={__version__}", ranks=[0])
+    if isinstance(config, dict):
+        config = DeepSpeedInferenceConfig(**{**config, **kwargs})
+    elif config is None:
+        config = DeepSpeedInferenceConfig(**kwargs)
+    return InferenceEngine(model, config=config)

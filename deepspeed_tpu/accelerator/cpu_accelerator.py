@@ -1,0 +1,53 @@
+"""CPU (host XLA) accelerator — used by the test harness via a virtual N-device mesh.
+
+Reference shape: ``accelerator/cpu_accelerator.py:18``. All JAX semantics are shared
+with the TPU implementation; only identity and dtype preferences differ.
+"""
+
+from deepspeed_tpu.accelerator.tpu_accelerator import TPU_Accelerator
+
+
+class CPU_Accelerator(TPU_Accelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "cpu"
+        self._communication_backend_name = "xla"
+
+    def device_name(self, device_index=None):
+        if device_index is None:
+            return "cpu"
+        return f"cpu:{device_index}"
+
+    def current_device_name(self):
+        return "cpu:0"
+
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        return True
+
+    def total_memory(self, device_index=None):
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal"):
+                        return int(line.split()[1]) * 1024
+        except Exception:
+            pass
+        return 0
+
+    def available_memory(self, device_index=None):
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemAvailable"):
+                        return int(line.split()[1]) * 1024
+        except Exception:
+            pass
+        return 0
+
+    def op_builder_dir(self):
+        # The Pallas/XLA op tier runs on host XLA too (interpret mode for Pallas).
+        return "deepspeed_tpu.op_builder.tpu"

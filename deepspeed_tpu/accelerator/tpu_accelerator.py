@@ -1,0 +1,326 @@
+"""TPU accelerator implementation.
+
+The north-star first deliverable (SURVEY.md §2.1): a ``TPU_Accelerator`` implementing
+the ``DeepSpeedAccelerator`` surface with JAX/XLA semantics. Reference shape:
+``accelerator/cuda_accelerator.py:26``.
+"""
+
+import contextlib
+import functools
+import os
+
+import numpy as np
+
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator
+
+
+class _NoopStream:
+    """XLA schedules compute/communication itself; streams are a compatibility shim."""
+
+    def synchronize(self):
+        import jax
+        jax.effects_barrier()
+
+    def wait_stream(self, other):
+        ...
+
+
+class _Event:
+    """Host-time event; record() blocks on async dispatch (CUDA-event analog)."""
+
+    def __init__(self, enable_timing=False, **kwargs):
+        self.enable_timing = enable_timing
+        self.time = None
+
+    def record(self, stream=None):
+        import jax, time
+        jax.effects_barrier()
+        self.time = time.time()
+
+    def synchronize(self):
+        ...
+
+    def elapsed_time(self, end_event):
+        return (end_event.time - self.time) * 1000.0
+
+    def query(self):
+        return self.time is not None
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "tpu"
+        # Collectives lower through XLA over ICI/DCN; there is no user-visible
+        # NCCL-style library, so the backend is named for the transport.
+        self._communication_backend_name = "xla"
+        self._compile_backend = "jax"
+        self._seed = 0
+        self._rng_key = None
+
+    def _jax(self):
+        import jax
+        return jax
+
+    # ---- device APIs -------------------------------------------------------------
+    def is_synchronized_device(self):
+        return False
+
+    def device_name(self, device_index=None):
+        if device_index is None:
+            return "tpu"
+        return f"tpu:{device_index}"
+
+    def device(self, device_index=None):
+        jax = self._jax()
+        devices = jax.local_devices()
+        return devices[device_index or 0]
+
+    def set_device(self, device_index):
+        # SPMD: one process drives all local devices; nothing to select.
+        ...
+
+    def current_device(self):
+        return 0
+
+    def current_device_name(self):
+        return "tpu:0"
+
+    def device_count(self):
+        return len(self._jax().local_devices())
+
+    def global_device_count(self):
+        return len(self._jax().devices())
+
+    def synchronize(self, device_index=None):
+        self._jax().effects_barrier()
+
+    # ---- RNG APIs ----------------------------------------------------------------
+    def random(self):
+        import jax.random as jrandom
+        return jrandom
+
+    def _key(self):
+        import jax
+        if self._rng_key is None:
+            self._rng_key = jax.random.PRNGKey(self._seed)
+        return self._rng_key
+
+    def set_rng_state(self, new_state, device_index=None):
+        self._rng_key = new_state
+
+    def get_rng_state(self, device_index=None):
+        return self._key()
+
+    def manual_seed(self, seed):
+        import jax
+        self._seed = int(seed)
+        self._rng_key = jax.random.PRNGKey(self._seed)
+
+    def manual_seed_all(self, seed):
+        self.manual_seed(seed)
+
+    def initial_seed(self):
+        return self._seed
+
+    def default_generator(self, device_index):
+        return self._key()
+
+    # ---- streams/events ----------------------------------------------------------
+    def Stream(self, device=None, priority=0, **kwargs):
+        return _NoopStream()
+
+    @contextlib.contextmanager
+    def stream(self, stream):
+        yield
+
+    def current_stream(self, device_index=None):
+        return _NoopStream()
+
+    def default_stream(self, device_index=None):
+        return _NoopStream()
+
+    def Event(self, **kwargs):
+        return _Event(**kwargs)
+
+    # ---- memory management -------------------------------------------------------
+    def empty_cache(self):
+        ...
+
+    def _stats(self, device_index=None):
+        dev = self.device(device_index)
+        return dev.memory_stats() or {}
+
+    def memory_allocated(self, device_index=None):
+        return self._stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index=None):
+        return self._stats(device_index).get("peak_bytes_in_use", 0)
+
+    def reset_max_memory_allocated(self, device_index=None):
+        ...
+
+    def memory_cached(self, device_index=None):
+        return self.memory_allocated(device_index)
+
+    def max_memory_cached(self, device_index=None):
+        return self.max_memory_allocated(device_index)
+
+    def reset_max_memory_cached(self, device_index=None):
+        ...
+
+    def memory_stats(self, device_index=None):
+        return self._stats(device_index)
+
+    def reset_peak_memory_stats(self, device_index=None):
+        ...
+
+    def memory_reserved(self, device_index=None):
+        return self._stats(device_index).get("bytes_reserved", self.memory_allocated(device_index))
+
+    def max_memory_reserved(self, device_index=None):
+        return self.max_memory_allocated(device_index)
+
+    def total_memory(self, device_index=None):
+        return self._stats(device_index).get("bytes_limit", 0)
+
+    def available_memory(self, device_index=None):
+        return self.total_memory(device_index) - self.memory_allocated(device_index)
+
+    # ---- dtype support -----------------------------------------------------------
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        # TPUs compute natively in bf16; fp16 arithmetic works but is not the
+        # preferred path (kept for API parity with loss-scaling tests).
+        return True
+
+    def supported_dtypes(self):
+        import jax.numpy as jnp
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8, jnp.int32]
+
+    # ---- misc --------------------------------------------------------------------
+    def amp(self):
+        return None
+
+    def is_available(self):
+        try:
+            return len(self._jax().devices()) > 0
+        except Exception:
+            return False
+
+    def range_push(self, msg):
+        try:
+            import jax.profiler
+            self._trace_ctx = jax.profiler.TraceAnnotation(msg)
+            self._trace_ctx.__enter__()
+        except Exception:
+            self._trace_ctx = None
+
+    def range_pop(self):
+        ctx = getattr(self, "_trace_ctx", None)
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+            self._trace_ctx = None
+
+    def lazy_call(self, callback):
+        callback()
+
+    def communication_backend_name(self):
+        return self._communication_backend_name
+
+    def is_triton_supported(self):
+        return False
+
+    # ---- graph operations --------------------------------------------------------
+    def create_graph(self):
+        # jit compilation is the graph capture mechanism; callers pass a callable.
+        return None
+
+    def capture_to_graph(self, graph, pool=None, stream=None):
+        return contextlib.nullcontext()
+
+    def replay_graph(self, graph):
+        ...
+
+    # ---- tensor factories --------------------------------------------------------
+    def _factory(self, dtype):
+        import jax.numpy as jnp
+
+        def make(*shape):
+            if len(shape) == 1 and isinstance(shape[0], (list, tuple, np.ndarray)):
+                return jnp.asarray(shape[0], dtype=dtype)
+            return jnp.zeros(shape, dtype=dtype)
+
+        return make
+
+    @property
+    def BFloat16Tensor(self):
+        import jax.numpy as jnp
+        return self._factory(jnp.bfloat16)
+
+    @property
+    def ByteTensor(self):
+        import jax.numpy as jnp
+        return self._factory(jnp.uint8)
+
+    @property
+    def DoubleTensor(self):
+        import jax.numpy as jnp
+        return self._factory(jnp.float64)
+
+    @property
+    def FloatTensor(self):
+        import jax.numpy as jnp
+        return self._factory(jnp.float32)
+
+    @property
+    def HalfTensor(self):
+        import jax.numpy as jnp
+        return self._factory(jnp.float16)
+
+    @property
+    def IntTensor(self):
+        import jax.numpy as jnp
+        return self._factory(jnp.int32)
+
+    @property
+    def LongTensor(self):
+        import jax.numpy as jnp
+        return self._factory(jnp.int64)
+
+    def pin_memory(self, tensor, align_bytes=1):
+        # Host numpy arrays are the pinned-staging representation on TPU hosts.
+        return np.asarray(tensor)
+
+    def is_pinned(self, tensor):
+        return isinstance(tensor, np.ndarray)
+
+    def on_accelerator(self, tensor):
+        import jax
+        return isinstance(tensor, jax.Array)
+
+    # ---- op builder dispatch -----------------------------------------------------
+    def op_builder_dir(self):
+        return "deepspeed_tpu.op_builder.tpu"
+
+    def create_op_builder(self, class_name):
+        builder_class = self.get_op_builder(class_name)
+        return builder_class() if builder_class is not None else None
+
+    def get_op_builder(self, class_name):
+        try:
+            import importlib
+            module = importlib.import_module(self.op_builder_dir())
+            return getattr(module, class_name, None)
+        except ImportError:
+            return None
+
+    def build_extension(self):
+        from setuptools.command.build_ext import build_ext
+        return build_ext
+
+    def export_envs(self):
+        return ["JAX_PLATFORMS", "XLA_FLAGS", "TPU_", "LIBTPU"]

@@ -1,0 +1,42 @@
+"""Communication backend interface.
+
+Reference: ``deepspeed/comm/backend.py`` (Backend ABC) + ``deepspeed/comm/torch.py:99``
+(TorchBackend). The TPU build has exactly one backend — XLA collectives over the
+global mesh — so the capability probes that the reference feature-detects
+(``has_all_gather_into_tensor`` etc., torch.py:41-58) are all True here.
+"""
+
+
+class Backend:
+
+    def __init__(self, name="backend", rank=0, size=1):
+        self.name = name
+        self.world_group = None
+        self.world_size = size
+        self.world_rank = rank
+        self.initialized = False
+
+    def is_initialized(self):
+        return self.initialized
+
+    def new_group(self, ranks):
+        raise NotImplementedError
+
+    def init_process_group(self):
+        self.initialized = True
+
+    def destroy_process_group(self):
+        self.initialized = False
+
+    # capability flags (reference feature-detects these; XLA always has them)
+    def has_all_gather_into_tensor(self):
+        return True
+
+    def has_reduce_scatter_tensor(self):
+        return True
+
+    def has_coalescing_manager(self):
+        return True
+
+    def has_all_reduce_coalesced(self):
+        return True

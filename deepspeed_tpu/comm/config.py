@@ -1,0 +1,21 @@
+"""Comms logger config (reference: deepspeed/comm/config.py)."""
+
+from typing import List
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class CommsConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    prof_all: bool = True
+    prof_ops: List[str] = []
+    verbose: bool = False
+    debug: bool = False
+
+
+class DeepSpeedCommsConfig:
+
+    def __init__(self, ds_config: dict):
+        self.comms_logger_enabled = "comms_logger" in ds_config
+        if self.comms_logger_enabled:
+            self.comms_logger = CommsConfig(**ds_config["comms_logger"])

@@ -1,0 +1,60 @@
+"""Inference v1 config.
+
+Reference: ``deepspeed/inference/config.py`` (DeepSpeedInferenceConfig: dtype, tp
+size, kernel injection, max tokens, quantization).
+"""
+
+from enum import Enum
+from typing import Optional
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    """Reference: inference/config.py TPConfig."""
+    enabled: bool = True
+    tp_size: int = 1
+    tp_grain_size: int = 64
+
+
+class QuantTypeEnum(str, Enum):
+    asym = "asymmetric"
+    sym = "symmetric"
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    num_bits: int = 8
+    q_type: QuantTypeEnum = QuantTypeEnum.sym
+    q_groups: int = 1
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    """Reference: inference/config.py DeepSpeedInferenceConfig."""
+
+    dtype: str = "bfloat16"  # TPU-native default (reference defaults to fp16)
+    tensor_parallel: DeepSpeedTPConfig = Field({}, alias="tp")
+    enable_cuda_graph: bool = False  # jit IS the captured graph on TPU
+    zero: dict = {}
+    triangular_masking: bool = True
+    moe: bool = False
+    moe_experts: list = [1]
+    max_out_tokens: int = Field(1024, ge=1)
+    min_out_tokens: int = Field(1, ge=1)
+    replace_with_kernel_inject: bool = False
+    injection_policy: Optional[dict] = None
+    checkpoint: Optional[str] = None
+    quant: QuantizationConfig = {}
+    max_tokens: int = Field(1024, alias="max_out_tokens")
+
+    @property
+    def jnp_dtype(self):
+        import jax.numpy as jnp
+        return {
+            "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+            "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+            "float32": jnp.float32, "fp32": jnp.float32, "float": jnp.float32,
+            "int8": jnp.int8,
+        }[str(self.dtype).replace("torch.", "")]

@@ -1,0 +1,34 @@
+"""Monitor config (reference: deepspeed/monitor/config.py)."""
+
+from typing import Optional
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class TensorBoardConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class WandbConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed"
+
+
+class CSVConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class DeepSpeedMonitorConfig(DeepSpeedConfigModel):
+    tensorboard: TensorBoardConfig = {}
+    wandb: WandbConfig = {}
+    csv_monitor: CSVConfig = {}
+
+    @property
+    def enabled(self):
+        return self.tensorboard.enabled or self.wandb.enabled or self.csv_monitor.enabled

@@ -1,0 +1,116 @@
+"""Monitoring backends.
+
+Reference: ``deepspeed/monitor/monitor.py:29`` (MonitorMaster fan-out to
+TensorBoard/W&B/CSV writers). Events are ``(tag, value, step)`` triples written on
+host rank 0.
+"""
+
+import os
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Monitor:
+
+    def __init__(self, monitor_config):
+        self.monitor_config = monitor_config
+        self.enabled = getattr(monitor_config, "enabled", False)
+
+    def write_events(self, event_list):
+        raise NotImplementedError
+
+
+def _rank():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+class TensorBoardMonitor(Monitor):
+
+    def __init__(self, tensorboard_config):
+        super().__init__(tensorboard_config)
+        self.enabled = tensorboard_config.enabled and _rank() == 0
+        self.summary_writer = None
+        if self.enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                log_dir = os.path.join(tensorboard_config.output_path or "./runs", tensorboard_config.job_name)
+                self.summary_writer = SummaryWriter(log_dir=log_dir)
+            except Exception as e:
+                logger.warning(f"TensorBoard not available: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list, flush=True):
+        if self.summary_writer is None:
+            return
+        for event in event_list:
+            self.summary_writer.add_scalar(*event)
+        if flush:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+
+    def __init__(self, wandb_config):
+        super().__init__(wandb_config)
+        self.enabled = wandb_config.enabled and _rank() == 0
+        if self.enabled:
+            try:
+                import wandb
+                wandb.init(project=wandb_config.project, group=wandb_config.group, entity=wandb_config.team)
+                self._wandb = wandb
+            except Exception as e:
+                logger.warning(f"wandb not available: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            self._wandb.log({name: value}, step=int(step))
+
+
+class csvMonitor(Monitor):
+
+    def __init__(self, csv_config):
+        super().__init__(csv_config)
+        self.enabled = csv_config.enabled and _rank() == 0
+        self.filenames = {}
+        if self.enabled:
+            self.log_dir = os.path.join(csv_config.output_path or "./csv_monitor", csv_config.job_name)
+            os.makedirs(self.log_dir, exist_ok=True)
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        import csv
+        for name, value, step in event_list:
+            fname = os.path.join(self.log_dir, name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([int(step), float(value)])
+
+
+class MonitorMaster(Monitor):
+    """Reference monitor.py:29 — fans events out to every enabled backend."""
+
+    def __init__(self, monitor_config):
+        super().__init__(monitor_config)
+        self.tb_monitor = TensorBoardMonitor(monitor_config.tensorboard)
+        self.wandb_monitor = WandbMonitor(monitor_config.wandb)
+        self.csv_monitor = csvMonitor(monitor_config.csv_monitor)
+        self.enabled = self.tb_monitor.enabled or self.wandb_monitor.enabled or self.csv_monitor.enabled
+
+    def write_events(self, event_list):
+        if self.tb_monitor.enabled:
+            self.tb_monitor.write_events(event_list)
+        if self.wandb_monitor.enabled:
+            self.wandb_monitor.write_events(event_list)
+        if self.csv_monitor.enabled:
+            self.csv_monitor.write_events(event_list)

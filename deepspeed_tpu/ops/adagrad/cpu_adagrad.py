@@ -1,0 +1,48 @@
+"""Adagrad.
+
+Reference: ``deepspeed/ops/adagrad/cpu_adagrad.py`` over ``csrc/adagrad/cpu_adagrad.cpp``.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optimizer import TpuOptimizer, _tree_zeros_like
+
+
+class AdagradState(NamedTuple):
+    step: jnp.ndarray
+    sum_sq: any
+
+
+class DeepSpeedCPUAdagrad(TpuOptimizer):
+
+    name = "adagrad"
+
+    def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0):
+        super().__init__(lr=lr, weight_decay=weight_decay)
+        self.eps = eps
+
+    def init(self, params):
+        return AdagradState(step=jnp.zeros([], jnp.int32), sum_sq=_tree_zeros_like(params))
+
+    def update(self, grads, state, params, lr):
+        wd = self.weight_decay
+
+        def upd(p, g, s):
+            g = g.astype(p.dtype)
+            if wd != 0.0:
+                g = g + wd * p
+            s = s + g * g
+            return p - lr * g / (jnp.sqrt(s) + self.eps), s
+
+        p_flat, treedef = jax.tree.flatten(params)
+        g_flat = treedef.flatten_up_to(grads)
+        s_flat = treedef.flatten_up_to(state.sum_sq)
+        out = [upd(p, g, s) for p, g, s in zip(p_flat, g_flat, s_flat)]
+        return (jax.tree.unflatten(treedef, [o[0] for o in out]),
+                AdagradState(step=state.step + 1, sum_sq=jax.tree.unflatten(treedef, [o[1] for o in out])))
+
+
+FusedAdagrad = DeepSpeedCPUAdagrad

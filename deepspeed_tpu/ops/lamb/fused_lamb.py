@@ -1,0 +1,78 @@
+"""Fused LAMB.
+
+Reference: ``deepspeed/ops/lamb/fused_lamb.py:14`` over ``csrc/lamb/fused_lamb_cuda.cu``.
+LAMB = Adam step rescaled per-layer by trust ratio ||p|| / ||update||.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optimizer import TpuOptimizer, _tree_zeros_like
+
+
+class LambState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: any
+    exp_avg_sq: any
+
+
+class FusedLamb(TpuOptimizer):
+
+    name = "lamb"
+
+    def __init__(self,
+                 lr=1e-3,
+                 bias_correction=True,
+                 betas=(0.9, 0.999),
+                 eps=1e-8,
+                 weight_decay=0.0,
+                 max_grad_norm=0.0,
+                 max_coeff=10.0,
+                 min_coeff=0.01,
+                 amsgrad=False):
+        super().__init__(lr=lr, weight_decay=weight_decay)
+        if amsgrad:
+            raise RuntimeError("FusedLamb does not support the AMSGrad variant")
+        self.betas = betas
+        self.eps = eps
+        self.bias_correction = bias_correction
+        self.max_coeff = max_coeff
+        self.min_coeff = min_coeff
+
+    def init(self, params):
+        return LambState(step=jnp.zeros([], jnp.int32),
+                         exp_avg=_tree_zeros_like(params),
+                         exp_avg_sq=_tree_zeros_like(params))
+
+    def update(self, grads, state, params, lr):
+        b1, b2 = self.betas
+        step = state.step + 1
+        stepf = step.astype(jnp.float32)
+        bc1 = 1.0 - b1**stepf if self.bias_correction else 1.0
+        bc2 = 1.0 - b2**stepf if self.bias_correction else 1.0
+        wd = self.weight_decay
+
+        def upd(p, g, m, v):
+            g = g.astype(p.dtype)
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * (g * g)
+            u = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+            if wd != 0.0:
+                u = u + wd * p
+            p_norm = jnp.linalg.norm(p.astype(jnp.float32))
+            u_norm = jnp.linalg.norm(u.astype(jnp.float32))
+            trust = jnp.where((p_norm > 0) & (u_norm > 0),
+                              jnp.clip(p_norm / u_norm, self.min_coeff, self.max_coeff), 1.0)
+            return p - lr * trust * u, m, v
+
+        p_flat, treedef = jax.tree.flatten(params)
+        g_flat = treedef.flatten_up_to(grads)
+        m_flat = treedef.flatten_up_to(state.exp_avg)
+        v_flat = treedef.flatten_up_to(state.exp_avg_sq)
+        out = [upd(p, g, m, v) for p, g, m, v in zip(p_flat, g_flat, m_flat, v_flat)]
+        return (jax.tree.unflatten(treedef, [o[0] for o in out]),
+                LambState(step=step,
+                          exp_avg=jax.tree.unflatten(treedef, [o[1] for o in out]),
+                          exp_avg_sq=jax.tree.unflatten(treedef, [o[2] for o in out])))

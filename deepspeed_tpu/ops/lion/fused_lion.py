@@ -1,0 +1,50 @@
+"""Fused Lion.
+
+Reference: ``deepspeed/ops/lion/fused_lion.py:17`` over ``csrc/lion``.
+Lion: sign of the interpolated momentum, decoupled weight decay.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optimizer import TpuOptimizer, _tree_zeros_like
+
+
+class LionState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: any
+
+
+class FusedLion(TpuOptimizer):
+
+    name = "lion"
+
+    def __init__(self, lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0):
+        super().__init__(lr=lr, weight_decay=weight_decay)
+        self.betas = betas
+
+    def init(self, params):
+        return LionState(step=jnp.zeros([], jnp.int32), exp_avg=_tree_zeros_like(params))
+
+    def update(self, grads, state, params, lr):
+        b1, b2 = self.betas
+        wd = self.weight_decay
+
+        def upd(p, g, m):
+            g = g.astype(p.dtype)
+            c = b1 * m + (1.0 - b1) * g
+            new_p = p * (1.0 - lr * wd) - lr * jnp.sign(c)
+            new_m = b2 * m + (1.0 - b2) * g
+            return new_p, new_m
+
+        p_flat, treedef = jax.tree.flatten(params)
+        g_flat = treedef.flatten_up_to(grads)
+        m_flat = treedef.flatten_up_to(state.exp_avg)
+        out = [upd(p, g, m) for p, g, m in zip(p_flat, g_flat, m_flat)]
+        return (jax.tree.unflatten(treedef, [o[0] for o in out]),
+                LionState(step=state.step + 1, exp_avg=jax.tree.unflatten(treedef, [o[1] for o in out])))
+
+
+DeepSpeedCPULion = FusedLion  # host-offloaded variant shares numerics (csrc/lion/cpu_lion.cpp)

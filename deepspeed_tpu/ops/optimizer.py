@@ -1,0 +1,60 @@
+"""Optimizer base protocol.
+
+TPU-native replacement for the reference's fused CUDA optimizers
+(``csrc/adam/multi_tensor_adam.cu``, ``csrc/lamb``, ``csrc/lion``; Python wrappers in
+``deepspeed/ops/{adam,lamb,lion,adagrad}``). Each optimizer is a *pure functional*
+transform — ``init(params) -> state`` and ``update(grads, state, params, lr) ->
+(new_params, new_state)`` — applied inside the engine's jitted step, where XLA fuses
+the whole elementwise update chain into a single pass over HBM (the role the
+multi-tensor-apply CUDA kernels play in the reference).
+
+``lr`` is a traced scalar so LR schedules never trigger recompilation.
+"""
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class TpuOptimizer:
+    """Functional optimizer protocol; subclasses implement init/update."""
+
+    name = "base"
+
+    def __init__(self, lr=1e-3, weight_decay=0.0):
+        self.lr = lr
+        self.weight_decay = weight_decay
+
+    # -- functional API (used inside jit) ------------------------------------------
+    def init(self, params):
+        raise NotImplementedError
+
+    def update(self, grads, state, params, lr):
+        raise NotImplementedError
+
+    # -- convenience imperative API (reference-parity surface) ---------------------
+    def get_lr(self):
+        return self.lr
+
+    def set_lr(self, lr):
+        self.lr = lr
+
+    # param_groups shim so reference-style LR schedulers can drive us
+    @property
+    def param_groups(self):
+        return [{"lr": self.lr, "weight_decay": self.weight_decay}]
+
+
+def _tree_zeros_like(params, dtype=None):
+    return jax.tree.map(lambda p: jnp.zeros_like(p, dtype=dtype or p.dtype), params)
+
+
+def apply_weight_decay(update, param, weight_decay, lr, decoupled: bool):
+    """AdamW-style decoupled decay adds wd*p to the step; L2 adds wd*p to the grad
+    (handled by callers before moments for the non-decoupled mode)."""
+    if weight_decay == 0.0:
+        return update
+    if decoupled:
+        return update + weight_decay * param
+    return update

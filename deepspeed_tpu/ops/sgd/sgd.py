@@ -1,0 +1,51 @@
+"""SGD with momentum (torch.optim.SGD-compatible semantics)."""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optimizer import TpuOptimizer, _tree_zeros_like
+
+
+class SGDState(NamedTuple):
+    step: jnp.ndarray
+    momentum_buf: any
+
+
+class SGD(TpuOptimizer):
+
+    name = "sgd"
+
+    def __init__(self, lr=1e-2, momentum=0.0, weight_decay=0.0, nesterov=False):
+        super().__init__(lr=lr, weight_decay=weight_decay)
+        self.momentum = momentum
+        self.nesterov = nesterov
+
+    def init(self, params):
+        return SGDState(step=jnp.zeros([], jnp.int32),
+                        momentum_buf=_tree_zeros_like(params) if self.momentum else None)
+
+    def update(self, grads, state, params, lr):
+        wd = self.weight_decay
+        mom = self.momentum
+
+        def upd(p, g, b):
+            g = g.astype(p.dtype)
+            if wd != 0.0:
+                g = g + wd * p
+            if mom != 0.0:
+                b = mom * b + g
+                g = (g + mom * b) if self.nesterov else b
+            return p - lr * g, b
+
+        p_flat, treedef = jax.tree.flatten(params)
+        g_flat = treedef.flatten_up_to(grads)
+        b_flat = treedef.flatten_up_to(state.momentum_buf) if mom else [None] * len(p_flat)
+        if mom:
+            out = [upd(p, g, b) for p, g, b in zip(p_flat, g_flat, b_flat)]
+            return (jax.tree.unflatten(treedef, [o[0] for o in out]),
+                    SGDState(step=state.step + 1,
+                             momentum_buf=jax.tree.unflatten(treedef, [o[1] for o in out])))
+        new_p = [p - lr * (g.astype(p.dtype) + (wd * p if wd else 0.0)) for p, g in zip(p_flat, g_flat)]
+        return jax.tree.unflatten(treedef, new_p), SGDState(step=state.step + 1, momentum_buf=None)

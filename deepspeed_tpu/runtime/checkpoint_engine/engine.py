@@ -1,0 +1,157 @@
+"""Checkpoint save/load for the engine.
+
+Reference: ``deepspeed/runtime/engine.py:3052-3548`` (save/load incl. ZeRO shards)
+and ``deepspeed/runtime/checkpoint_engine/`` (CheckpointEngine ABC / torch / nebula).
+The TPU design (SURVEY.md §5.4): ONE logical checkpoint in sharded-array format
+(orbax → tensorstore). Every host writes only its shards; restore reshards into
+whatever mesh/topology is current — which is the reference's "universal checkpoint"
+(ds_to_universal.py) for free.
+"""
+
+import json
+import os
+import pickle
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+LATEST_FILE = "latest"
+
+
+class CheckpointEngine:
+    """Reference: checkpoint_engine/checkpoint_engine.py (ABC)."""
+
+    def __init__(self, config_params=None):
+        ...
+
+    def create(self, tag):
+        logger.info(f"[TPU] Saving checkpoint tag {tag}")
+
+    def save(self, state_dict, path: str):
+        raise NotImplementedError
+
+    def load(self, path: str, map_location=None):
+        raise NotImplementedError
+
+    def commit(self, tag):
+        return True
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    """Sharded async-capable checkpoint engine over orbax/tensorstore."""
+
+    def __init__(self, config_params=None, use_async=False):
+        super().__init__(config_params)
+        import orbax.checkpoint as ocp
+        self._ckptr = ocp.StandardCheckpointer() if not use_async else ocp.AsyncCheckpointer(
+            ocp.StandardCheckpointHandler())
+
+    def save(self, state_dict, path: str):
+        self._ckptr.save(path, state_dict, force=True)
+
+    def load(self, path: str, map_location=None, target=None):
+        if target is not None:
+            return self._ckptr.restore(path, target=target)
+        return self._ckptr.restore(path)
+
+    def wait(self):
+        if hasattr(self._ckptr, "wait_until_finished"):
+            self._ckptr.wait_until_finished()
+
+
+def _ckpt_path(save_dir, tag):
+    return os.path.join(os.path.abspath(save_dir), str(tag))
+
+
+def save_engine_state(engine, save_dir, tag, client_state, save_latest):
+    import jax
+    path = _ckpt_path(save_dir, tag)
+    os.makedirs(save_dir, exist_ok=True)
+
+    ck = OrbaxCheckpointEngine()
+    arrays = {
+        "params": engine.params,
+        "opt_state": _named_opt_state(engine.opt_state),
+        "scale_state": engine.scale_state._asdict(),
+    }
+    ck.save(arrays, os.path.join(path, "state"))
+
+    host_state = {
+        "global_steps": engine.global_steps,
+        "global_samples": engine.global_samples,
+        "micro_steps": engine.micro_steps,
+        "skipped_steps": int(engine._overflow_count),
+        "current_lr": engine._current_lr,
+        "lr_scheduler": engine.lr_scheduler.state_dict() if engine.lr_scheduler is not None else None,
+        "ds_config": engine._config._param_dict,
+        "client_state": client_state,
+    }
+    # host-side metadata is identical on every process; only rank 0 writes it
+    # (shared-filesystem checkpoints must not see N concurrent writers)
+    if jax.process_index() == 0:
+        with open(os.path.join(path, "host_state.pkl"), "wb") as f:
+            pickle.dump(host_state, f)
+        if save_latest:
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(str(tag))
+    logger.info(f"Saved checkpoint to {path}")
+    return True
+
+
+def load_engine_state(engine, load_dir, tag, load_optimizer_states=True, load_lr_scheduler_states=True,
+                      load_module_only=False):
+    import jax
+    if tag is None:
+        latest = os.path.join(load_dir, LATEST_FILE)
+        if not os.path.isfile(latest):
+            logger.warning(f"Unable to find latest file at {latest}, returning (None, None)")
+            return None, None
+        with open(latest) as f:
+            tag = f.read().strip()
+    path = _ckpt_path(load_dir, tag)
+    if not os.path.isdir(path):
+        logger.warning(f"Checkpoint path {path} does not exist")
+        return None, None
+
+    ck = OrbaxCheckpointEngine()
+    # Restore against the engine's current shardings → automatic resharding
+    # (the universal-checkpoint reshape of deepspeed/checkpoint/ds_to_universal.py).
+    target = {
+        "params": _shaped(engine.params, engine._param_shardings),
+        "opt_state": _named_opt_state(_shaped(engine.opt_state, None)),
+        "scale_state": {k: v for k, v in engine.scale_state._asdict().items()},
+    }
+    restored = ck.load(os.path.join(path, "state"), target=target)
+    engine.params = jax.device_put(restored["params"], engine._param_shardings)
+    if load_optimizer_states and not load_module_only:
+        engine.opt_state = jax.device_put(type(engine.opt_state)(**restored["opt_state"]),
+                                          engine._opt_shardings)
+        from deepspeed_tpu.runtime.fp16.loss_scaler import LossScaleState
+        engine.scale_state = LossScaleState(**{k: restored["scale_state"][k] for k in ("cur_scale", "good_steps",
+                                                                                       "hysteresis")})
+
+    with open(os.path.join(path, "host_state.pkl"), "rb") as f:
+        host_state = pickle.load(f)
+    if not load_module_only:
+        import jax.numpy as jnp
+        engine.global_steps = host_state["global_steps"]
+        engine.global_samples = host_state["global_samples"]
+        engine.micro_steps = host_state["micro_steps"]
+        engine._current_lr = host_state["current_lr"]
+        engine._overflow_count = jnp.asarray(host_state.get("skipped_steps", 0), jnp.int32)
+        if load_lr_scheduler_states and engine.lr_scheduler is not None and host_state["lr_scheduler"]:
+            engine.lr_scheduler.load_state_dict(host_state["lr_scheduler"])
+    logger.info(f"Loaded checkpoint from {path}")
+    return path, host_state.get("client_state", {})
+
+
+def _named_opt_state(opt_state):
+    """NamedTuple → dict (orbax-friendly)."""
+    if hasattr(opt_state, "_asdict"):
+        return dict(opt_state._asdict())
+    return opt_state
+
+
+def _shaped(tree, shardings):
+    return tree

@@ -1,0 +1,257 @@
+"""Master JSON config.
+
+Reference: ``deepspeed/runtime/config.py`` — ``DeepSpeedConfig:696`` with ~80
+accessors and the batch-size triangle validation
+(``train_batch_size = micro_batch * gradient_accumulation_steps * dp_world_size``).
+
+The JSON schema is the reference's; unknown keys are preserved (pydantic extra=allow)
+so user configs written for the reference parse unchanged.
+"""
+
+import json
+import os
+from typing import Optional, Union
+
+from pydantic import Field
+
+from deepspeed_tpu.comm.config import CommsConfig
+from deepspeed_tpu.monitor.config import DeepSpeedMonitorConfig
+from deepspeed_tpu.profiling.config import DeepSpeedFlopsProfilerConfig
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel, dict_raise_error_on_duplicate_keys
+from deepspeed_tpu.runtime.precision_config import BF16Config, FP16Config
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedConfigError(Exception):
+    """Reference: runtime/config.py:94."""
+
+
+class OptimizerConfig(DeepSpeedConfigModel):
+    type: str = "adamw"
+    params: dict = {}
+    legacy_fusion: bool = False
+
+
+class SchedulerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: dict = {}
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """Reference: activation_checkpointing/config.py. On TPU these map onto
+    ``jax.checkpoint`` policies; partition_activations maps to sharding the
+    saved residuals over the model axis."""
+    partition_activations: bool = False
+    contiguous_memory_optimization: bool = False
+    cpu_checkpointing: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+
+class GradientCompressionConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    tag_validation: str = "Warn"
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: dict = {}
+
+
+class DataTypesConfig(DeepSpeedConfigModel):
+    grad_accum_dtype: Optional[str] = None
+
+
+class AioConfig(DeepSpeedConfigModel):
+    """Reference: csrc/aio config block."""
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+class ElasticityConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: list = [2, 4, 6]
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.1
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch: bool = True
+
+
+class DeepSpeedConfig:
+    """Parse + validate a config dict/path. Accessor attribute names follow the
+    reference so engine code reads identically."""
+
+    def __init__(self, config: Union[str, dict], mpu=None, mesh=None):
+        if isinstance(config, str):
+            if not os.path.exists(config):
+                raise DeepSpeedConfigError(f"Expected a string path to an existing deepspeed config, got {config}")
+            with open(config) as f:
+                self._param_dict = json.load(f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        elif isinstance(config, dict):
+            self._param_dict = config
+        else:
+            raise DeepSpeedConfigError(
+                f"Expected a string path or dict, got {type(config)}")
+
+        self.mesh = mesh
+        self._initialize_params(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    # -- parsing -------------------------------------------------------------------
+    def _initialize_params(self, pd: dict):
+        self.train_batch_size = pd.get(C.TRAIN_BATCH_SIZE)
+        self.train_micro_batch_size_per_gpu = pd.get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+        self.gradient_accumulation_steps = pd.get(C.GRADIENT_ACCUMULATION_STEPS)
+        self.steps_per_print = pd.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = pd.get(C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+        self.wall_clock_breakdown = pd.get(C.WALL_CLOCK_BREAKDOWN, C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = pd.get(C.MEMORY_BREAKDOWN, False)
+
+        self.gradient_clipping = pd.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
+        self.prescale_gradients = pd.get(C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = pd.get(C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+        self.sparse_gradients_enabled = pd.get(C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
+
+        self.zero_config = DeepSpeedZeroConfig(**pd.get(C.ZERO_OPTIMIZATION, {}))
+        self.bfloat16_config = BF16Config(**pd.get(C.BFLOAT16, pd.get(C.BFLOAT16_OLD, {})))
+        self.fp16_config = FP16Config(**pd.get(C.FP16, {}))
+        if self.fp16_config.enabled and self.bfloat16_config.enabled:
+            raise DeepSpeedConfigError("bf16 and fp16 modes cannot be simultaneously enabled")
+
+        opt = pd.get(C.OPTIMIZER)
+        self.optimizer_config = OptimizerConfig(**opt) if opt else None
+        sched = pd.get(C.SCHEDULER)
+        self.scheduler_config = SchedulerConfig(**sched) if sched else None
+        # reference-style raw accessors
+        self.optimizer_name = self.optimizer_config.type.lower() if self.optimizer_config else None
+        self.optimizer_params = self.optimizer_config.params if self.optimizer_config else None
+        self.optimizer_legacy_fusion = self.optimizer_config.legacy_fusion if self.optimizer_config else False
+        self.scheduler_name = self.scheduler_config.type if self.scheduler_config else None
+        self.scheduler_params = self.scheduler_config.params if self.scheduler_config else None
+
+        self.activation_checkpointing_config = ActivationCheckpointingConfig(
+            **pd.get("activation_checkpointing", {}))
+        self.monitor_config = DeepSpeedMonitorConfig(**pd.get("monitor", pd))
+        self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(**pd.get("flops_profiler", {}))
+        self.comms_config = CommsConfig(**pd.get("comms_logger", {}))
+        self.checkpoint_config = CheckpointConfig(**pd.get(C.CHECKPOINT, {}))
+        self.data_types_config = DataTypesConfig(**pd.get(C.DATA_TYPES, {}))
+        self.aio_config = AioConfig(**pd.get("aio", {}))
+        self.elasticity_config = ElasticityConfig(**pd.get("elasticity", {}))
+
+        self.checkpoint_tag_validation_enabled = self.checkpoint_config.tag_validation != "Ignore"
+        self.checkpoint_tag_validation_fail = self.checkpoint_config.tag_validation == "Fail"
+        self.load_universal_checkpoint = self.checkpoint_config.load_universal
+        self.use_node_local_storage = self.checkpoint_config.use_node_local_storage
+        self.grad_accum_dtype = self.data_types_config.grad_accum_dtype
+
+        # parallel sizes (TPU addition: declared in config instead of mpu objects)
+        self.pipeline_parallel_size = pd.get(C.PIPELINE_PARALLEL_SIZE, 1)
+        self.sequence_parallel_size = pd.get(C.SEQUENCE_PARALLEL_SIZE, 1)
+        self.tensor_parallel_size = pd.get(C.TENSOR_PARALLEL_SIZE, 1)
+        self.expert_parallel_size = pd.get(C.EXPERT_PARALLEL_SIZE, 1)
+
+        self.pipeline = pd.get(C.PIPELINE, {})
+        self.use_data_before_expert_parallel_ = pd.get(C.USE_DATA_BEFORE_EXPERT_PARALLEL,
+                                                       C.USE_DATA_BEFORE_EXPERT_PARALLEL_DEFAULT)
+
+        # aux subsystems parsed lazily by their owners
+        self.compression_config = pd.get("compression_training", {})
+        self.data_efficiency_config = pd.get("data_efficiency", {})
+        self.autotuning_config = pd.get("autotuning", {})
+        self.nebula_config = pd.get("nebula", {})
+        self.curriculum_enabled_legacy = bool(pd.get("curriculum_learning", {}).get("enabled", False))
+        self.curriculum_params_legacy = pd.get("curriculum_learning", {})
+
+        self.eigenvalue_enabled = bool(pd.get("eigenvalue", {}).get("enabled", False))
+        self.progressive_layer_drop = pd.get("progressive_layer_drop", {})
+        self.pld_enabled = bool(self.progressive_layer_drop.get("enabled", False))
+
+    # -- batch triangle ------------------------------------------------------------
+    def _data_parallel_size(self):
+        from deepspeed_tpu.utils import groups
+        if self.mesh is not None:
+            dp = 1
+            for ax in ("data", "expert"):
+                dp *= self.mesh.shape.get(ax, 1)
+            return dp
+        if groups.mesh_is_initialized():
+            return groups.get_data_parallel_world_size()
+        try:
+            import jax
+            n = len(jax.devices())
+        except Exception:
+            n = 1
+        return max(1, n // (self.tensor_parallel_size * self.pipeline_parallel_size * self.sequence_parallel_size))
+
+    def _configure_train_batch_size(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        dp = self._data_parallel_size()
+
+        if all(v is not None for v in (train_batch, micro_batch, grad_acc)):
+            pass
+        elif train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= dp
+            grad_acc = max(1, grad_acc)
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // dp
+            micro_batch //= grad_acc
+            micro_batch = max(1, micro_batch)
+        elif micro_batch is not None and grad_acc is not None:
+            train_batch = micro_batch * grad_acc * dp
+        elif train_batch is not None:
+            grad_acc = 1
+            micro_batch = max(1, train_batch // dp)
+        elif micro_batch is not None:
+            train_batch = micro_batch * dp
+            grad_acc = 1
+        else:
+            raise DeepSpeedConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu needs to be provided")
+
+        self.train_batch_size = train_batch
+        self.train_micro_batch_size_per_gpu = micro_batch
+        self.gradient_accumulation_steps = grad_acc
+
+    def _batch_assertion(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        dp = self._data_parallel_size()
+        assert train_batch > 0, f"Train batch size: {train_batch} has to be greater than 0"
+        assert micro_batch > 0, f"Micro batch size per gpu: {micro_batch} has to be greater than 0"
+        assert grad_acc > 0, f"Gradient accumulation steps: {grad_acc} has to be greater than 0"
+        assert train_batch == micro_batch * grad_acc * dp, (
+            f"Check batch related parameters. train_batch_size is not equal "
+            f"to micro_batch_per_gpu * gradient_acc_step * world_size "
+            f"{train_batch} != {micro_batch} * {grad_acc} * {dp}")
+
+    def _do_sanity_check(self):
+        self._batch_assertion()
+        if self.zero_config.stage > 0 and not (self.fp16_config.enabled or self.bfloat16_config.enabled):
+            logger.warning("ZeRO enabled without fp16/bf16; running fp32 sharded state")
+
+    def print_user_config(self):
+        logger.info("  json = {}".format(
+            json.dumps(self._param_dict, sort_keys=True, indent=4, separators=(",", ":"))))
+
+    def print(self, name):
+        logger.info(f"{name}:")
+        for arg in sorted(vars(self)):
+            if arg != "_param_dict":
+                logger.info(f"  {arg} {'.' * (29 - len(arg))} {getattr(self, arg)}")
+        self.print_user_config()

@@ -1,0 +1,92 @@
+"""Config model base.
+
+Reference: ``deepspeed/runtime/config_utils.py:16`` — ``DeepSpeedConfigModel``, a
+pydantic base supporting "auto" values and deprecated-field aliasing
+(``json_schema_extra={"deprecated": True, "new_param": ...}``).
+"""
+
+from functools import reduce
+from typing import Dict
+
+from pydantic import BaseModel, ConfigDict, model_validator
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Base for all config blocks; extra fields allowed (forward compat), validation
+    on assignment, and reference-style deprecated-field migration."""
+
+    model_config = ConfigDict(
+        validate_default=True,
+        validate_assignment=True,
+        use_enum_values=True,
+        populate_by_name=True,
+        extra="allow",
+        protected_namespaces=(),
+        arbitrary_types_allowed=True,
+    )
+
+    def __init__(self, strict=False, **data):
+        if not strict:  # drop "auto" values so defaults apply (reference behavior)
+            data = {k: v for k, v in data.items() if not (v == "auto" and k != "auto")}
+        super().__init__(**data)
+
+    def _process_deprecated_field(self, dep_field):
+        fields_set = self.model_fields_set
+        kwargs = type(self).model_fields[dep_field].json_schema_extra or {}
+        new_param_fn = kwargs.get("new_param_fn", lambda x: x)
+        param_value = new_param_fn(getattr(self, dep_field))
+        new_param = kwargs.get("new_param", "")
+        dep_msg = kwargs.get("deprecated_msg", "")
+        if dep_field in fields_set:
+            logger.warning(f"Config parameter {dep_field} is deprecated" +
+                           (f" use {new_param} instead" if new_param else "") +
+                           (f". {dep_msg}" if dep_msg else ""))
+            if new_param and kwargs.get("set_new_param", True):
+                new_param_nested = new_param.split(".")
+                if len(new_param_nested) > 1:
+                    new_param_name = new_param_nested[-1]
+                    first_level_name = new_param_nested[0]
+                    new_param_obj = reduce(getattr, new_param_nested[:-1], self)
+                else:
+                    new_param_name = new_param
+                    new_param_obj = self
+                try:
+                    setattr(new_param_obj, new_param_name, param_value)
+                except Exception as e:
+                    logger.error(f"Tried setting value for '{new_param}' with value from deprecated '{dep_field}'")
+                    raise e
+
+    @model_validator(mode="after")
+    def _deprecated_fields_check(self):
+        fields = type(self).model_fields
+        for field_name, field_info in fields.items():
+            kwargs = field_info.json_schema_extra
+            if isinstance(kwargs, dict) and kwargs.get("deprecated", False):
+                self._process_deprecated_field(field_name)
+        return self
+
+
+def get_scalar_param(param_dict: Dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict: Dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict: Dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """Reject duplicate keys in the JSON config (reference config_utils.py)."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError(f"Duplicate keys {keys} in DeepSpeed config")
+    return d

@@ -1,0 +1,68 @@
+"""Data loading.
+
+Reference: ``deepspeed/runtime/dataloader.py`` (DeepSpeedDataLoader, RepeatingLoader).
+Under single-controller SPMD the loader yields *global* batches of host numpy arrays;
+``engine.shard_batch`` places them over the data/seq mesh axes (the role the
+per-rank DistributedSampler plays in the reference).
+"""
+
+import numpy as np
+
+
+class DeepSpeedDataLoader:
+
+    def __init__(self, dataset, batch_size, shuffle=False, seed=0, collate_fn=None, drop_last=True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.collate_fn = collate_fn or _default_collate
+        self.drop_last = drop_last
+        self._epoch = 0
+
+    def __len__(self):
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else (n + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch):
+        self._epoch = epoch
+
+    def __iter__(self):
+        n = len(self.dataset)
+        idx = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            rng.shuffle(idx)
+        for start in range(0, n - (self.batch_size - 1 if self.drop_last else 0), self.batch_size):
+            sel = idx[start:start + self.batch_size]
+            yield self.collate_fn([self.dataset[int(i)] for i in sel])
+
+
+class RepeatingLoader:
+    """Reference dataloader.py RepeatingLoader: wrap an iterator to restart it."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            batch = next(self.data_iter)
+        except StopIteration:
+            if hasattr(self.loader, "set_epoch"):
+                self.loader.set_epoch(getattr(self.loader, "_epoch", 0) + 1)
+            self.data_iter = iter(self.loader)
+            batch = next(self.data_iter)
+        return batch
+
+
+def _default_collate(samples):
+    first = samples[0]
+    if isinstance(first, (tuple, list)):
+        return type(first)(_default_collate([s[i] for s in samples]) for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: _default_collate([s[k] for s in samples]) for k in first}
+    return np.stack([np.asarray(s) for s in samples])

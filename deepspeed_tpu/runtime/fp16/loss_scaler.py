@@ -1,0 +1,158 @@
+"""Loss scaling.
+
+Reference: ``deepspeed/runtime/fp16/loss_scaler.py`` (LossScaler:67,
+DynamicLossScaler:91). The scale state lives *inside* the jitted step as a small
+pytree so overflow-skip and scale adjustment happen on-device with no host sync:
+
+    state = (cur_scale, good_steps, hysteresis_left)
+
+bf16 runs don't need scaling (TPU-native); the engine only threads this state when
+fp16 is enabled.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+CONSECUTIVE_HYSTERESIS = "consecutive_hysteresis"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScaleState(NamedTuple):
+    cur_scale: jnp.ndarray  # f32 scalar
+    good_steps: jnp.ndarray  # i32 scalar
+    hysteresis: jnp.ndarray  # i32 scalar
+
+
+def static_loss_scale_state(scale: float) -> LossScaleState:
+    return LossScaleState(cur_scale=jnp.asarray(scale, jnp.float32),
+                          good_steps=jnp.zeros([], jnp.int32),
+                          hysteresis=jnp.asarray(1, jnp.int32))
+
+
+def dynamic_loss_scale_state(initial_scale_power=16, delayed_shift=2) -> LossScaleState:
+    return LossScaleState(cur_scale=jnp.asarray(2.0**initial_scale_power, jnp.float32),
+                          good_steps=jnp.zeros([], jnp.int32),
+                          hysteresis=jnp.asarray(delayed_shift, jnp.int32))
+
+
+def update_scale(state: LossScaleState,
+                 overflow,
+                 *,
+                 scale_window: int = 1000,
+                 scale_factor: float = 2.0,
+                 min_scale: float = 1.0,
+                 delayed_shift: int = 1,
+                 consecutive_hysteresis: bool = False,
+                 dynamic: bool = True) -> LossScaleState:
+    """Pure update — reference DynamicLossScaler.update_scale semantics."""
+    if not dynamic:
+        return state
+    overflow = jnp.asarray(overflow)
+
+    # reference DynamicLossScaler.update_scale: an overflow either consumes one
+    # hysteresis count (delayed_shift>1 and counts remain) or shrinks the scale;
+    # hysteresis refills at the scale window (or every good step when
+    # consecutive_hysteresis), and the scale grows after scale_window good steps.
+    must_shrink = overflow & ((delayed_shift == 1) | (state.hysteresis <= 1))
+    shrunk = jnp.maximum(state.cur_scale / scale_factor, min_scale)
+    h_on_overflow = jnp.where(must_shrink, state.hysteresis, state.hysteresis - 1)
+
+    window_full = (state.good_steps + 1) % scale_window == 0
+    grown = jnp.where(~overflow & window_full, state.cur_scale * scale_factor, state.cur_scale)
+
+    new_scale = jnp.where(must_shrink, shrunk, grown)
+    new_good = jnp.where(overflow, 0, jnp.where(window_full, 0, state.good_steps + 1))
+    if consecutive_hysteresis:
+        h_on_good = jnp.asarray(delayed_shift, jnp.int32)
+    else:
+        h_on_good = jnp.where(window_full, jnp.asarray(delayed_shift, jnp.int32), state.hysteresis)
+    new_h = jnp.where(overflow, h_on_overflow, h_on_good).astype(jnp.int32)
+    return LossScaleState(cur_scale=new_scale, good_steps=new_good.astype(jnp.int32), hysteresis=new_h)
+
+
+class LossScalerBase:
+    """Stateful API-parity wrapper (reference LossScalerBase)."""
+
+    def __init__(self, cur_scale):
+        self.cur_scale = cur_scale
+        self.dynamic = False
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, module, grad_in, grad_out):
+        return tuple(self.loss_scale * g for g in grad_in)
+
+    def update_scale(self, overflow):
+        pass
+
+    def backward(self, loss, retain_graph=False):
+        raise NotImplementedError("Use the engine's backward; JAX has no .backward graphs")
+
+
+class LossScaler(LossScalerBase):
+    """Static scale (reference loss_scaler.py:67)."""
+
+    def __init__(self, scale=1.0):
+        super().__init__(scale)
+
+    def has_overflow(self, params):
+        return False
+
+
+class DynamicLossScaler(LossScalerBase):
+    """Reference loss_scaler.py:91."""
+
+    def __init__(self,
+                 init_scale=2**32,
+                 scale_factor=2.0,
+                 scale_window=1000,
+                 min_scale=1.0,
+                 delayed_shift=1,
+                 consecutive_hysteresis=False,
+                 raise_error_at_min_scale=True,
+                 dtype=None):
+        super().__init__(init_scale)
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = delayed_shift
+        self.cur_hysteresis = delayed_shift
+        self.consecutive_hysteresis = consecutive_hysteresis
+        self.raise_error_at_min_scale = raise_error_at_min_scale
+        self.dynamic = True
+
+    def update_scale(self, overflow):
+        if overflow:
+            if self.delayed_shift == 1 or self.cur_hysteresis == 1:
+                if self.cur_scale == self.min_scale and self.raise_error_at_min_scale:
+                    raise Exception("Current loss scale already at minimum - cannot decrease scale anymore.")
+                self.cur_scale = max(self.cur_scale / self.scale_factor, self.min_scale)
+            else:
+                self.cur_hysteresis -= 1
+            self.last_overflow_iter = self.cur_iter
+        else:
+            if self.consecutive_hysteresis:
+                self.cur_hysteresis = self.delayed_shift
+            if (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:
+                if not self.consecutive_hysteresis:
+                    self.cur_hysteresis = self.delayed_shift
+                self.cur_scale *= self.scale_factor
+        self.cur_iter += 1
+
+
+def CreateLossScaler(dtype, static_loss_scale, dynamic_scaling, dynamic_loss_args):
+    """Reference factory (loss_scaler.py bottom)."""
+    import jax.numpy as jnp
+    if dtype == jnp.float16 and dynamic_scaling:
+        kwargs = dynamic_loss_args or {}
+        return DynamicLossScaler(dtype=dtype, **kwargs)
+    loss_scale_value = static_loss_scale if dtype == jnp.float16 else 1.0
+    return LossScaler(scale=loss_scale_value)

@@ -1,0 +1,27 @@
+"""fp16 / bf16 config blocks (reference: runtime/fp16 configs inside config.py)."""
+
+from typing import Optional
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class BF16Config(DeepSpeedConfigModel):
+    """bf16 is the TPU-native precision; no loss scaling needed."""
+    enabled: bool = False
+    # reference bf16_optimizer accumulates grads in fp32
+    immediate_grad_update: bool = False
+
+
+class FP16Config(DeepSpeedConfigModel):
+    """fp16 + (dynamic) loss scaling, reference fp16/loss_scaler.py semantics."""
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = Field(0.0, ge=0.0)  # 0 = dynamic
+    initial_scale_power: int = Field(16, ge=0)
+    loss_scale_window: int = Field(1000, gt=0)
+    hysteresis: int = Field(2, ge=0)
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = Field(1.0, ge=0.0)
+    fp16_master_weights_and_grads: bool = False

@@ -1,0 +1,82 @@
+"""Runtime math helpers.
+
+Reference: ``deepspeed/runtime/utils.py`` (clip_grad_norm_, get_global_norm,
+CheckOverflow, see_memory_usage). Under SPMD these are pure jnp functions over
+(possibly sharded) pytrees — jit + GSPMD make the cross-partition reductions
+implicit, which is what the reference's allreduce-of-partial-norms does by hand.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def global_norm(tree):
+    """L2 norm over every leaf (fp32 accumulation)."""
+    leaves = [jnp.sum(jnp.square(l.astype(jnp.float32))) for l in jax.tree.leaves(tree) if l is not None]
+    if not leaves:
+        return jnp.zeros([], jnp.float32)
+    return jnp.sqrt(sum(leaves))
+
+
+def get_global_norm(norm_list):
+    """Reference get_global_norm: combine pre-computed norms."""
+    total = sum(n**2.0 for n in norm_list)
+    return total**0.5
+
+
+def clip_grads_by_global_norm(grads, max_norm, norm=None, eps=1e-6):
+    """Reference clip_grad_norm_ semantics: scale all grads by max_norm/(norm+eps)
+    when norm exceeds max_norm. Returns (clipped_grads, norm)."""
+    if norm is None:
+        norm = global_norm(grads)
+    coef = jnp.minimum(1.0, max_norm / (norm + eps))
+    clipped = jax.tree.map(lambda g: (g * coef.astype(g.dtype)), grads)
+    return clipped, norm
+
+
+def tree_all_finite(tree):
+    """Overflow probe (reference CheckOverflow / _has_inf_or_nan, stage3.py:2114)."""
+    leaves = [jnp.all(jnp.isfinite(l.astype(jnp.float32))) for l in jax.tree.leaves(tree) if l is not None]
+    if not leaves:
+        return jnp.asarray(True)
+    out = leaves[0]
+    for l in leaves[1:]:
+        out = out & l
+    return out
+
+
+def cast_tree(tree, dtype):
+    return jax.tree.map(lambda l: l.astype(dtype) if hasattr(l, "astype") and jnp.issubdtype(l.dtype, jnp.floating)
+                        else l, tree)
+
+
+def tree_select(pred, a, b):
+    """Per-leaf where(pred, a, b) with a scalar predicate."""
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def see_memory_usage(message, force=False):
+    if not force:
+        return
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        gb = 1024**3
+        logger.info(f"{message} | in_use {stats.get('bytes_in_use', 0)/gb:.2f}GB "
+                    f"peak {stats.get('peak_bytes_in_use', 0)/gb:.2f}GB "
+                    f"limit {stats.get('bytes_limit', 0)/gb:.2f}GB")
+    except Exception:
+        logger.info(f"{message} | memory stats unavailable")
+
+
+def call_to_str(base, *args, **kwargs):
+    name = f"{base}("
+    if args:
+        name += ", ".join(str(arg) for arg in args)
+        if kwargs:
+            name += ", "
+    if kwargs:
+        name += ", ".join(f"{key}={repr(arg)}" for key, arg in kwargs.items())
+    name += ")"
+    return name

@@ -1,0 +1,3 @@
+from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import NoopTimer, SynchronizedWallClockTimer, ThroughputTimer

@@ -1,0 +1,103 @@
+"""Per-op communication statistics.
+
+Reference: ``deepspeed/utils/comms_logging.py:67`` (CommsLogger) — per-op message-size
+histograms with count/latency/algbw/busbw and straggler detection via
+``dist.log_summary``.
+"""
+
+import math
+from collections import defaultdict
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def get_caller_func(frame=3):
+    import sys
+    return sys._getframe(frame).f_code.co_name
+
+
+def convert_size(size_bytes):
+    if size_bytes == 0:
+        return "0B"
+    size_name = ("B", "KB", "MB", "GB", "TB", "PB")
+    i = int(math.floor(math.log(size_bytes, 1024)))
+    p = math.pow(1024, i)
+    s = round(size_bytes / p, 2)
+    return f"{s} {size_name[i]}"
+
+
+def calc_bw_log(comm_op, size, duration, n):
+    """Algorithm/bus bandwidth for a collective (reference comms_logging.py:32)."""
+    if duration <= 0:
+        return 0, 0, 0
+    tput = size / duration
+    if comm_op in ("all_to_all_single", ):
+        busbw = tput * ((n - 1) / n) if n > 0 else tput
+    elif comm_op in ("all_gather_into_tensor", "reduce_scatter_tensor", "allgather_fn", "reduce_scatter_fn"):
+        busbw = tput * ((n - 1) / n) if n > 0 else tput
+    elif comm_op in ("all_reduce", "inference_all_reduce"):
+        busbw = tput * (2 * (n - 1) / n) if n > 0 else tput
+    else:
+        busbw = tput
+    return tput / 1e9, busbw / 1e9, duration * 1e3
+
+
+class CommsLogger:
+
+    def __init__(self):
+        self.comms_dict = defaultdict(lambda: defaultdict(lambda: [0, [], [], []]))
+        self.verbose = False
+        self.debug = False
+        self.prof_ops = []
+        self.prof_all = True
+        self.enabled = False
+
+    def configure(self, deepspeed_config=None, enabled=None, prof_all=None, prof_ops=None, verbose=None, debug=None):
+        if deepspeed_config is not None:
+            cl = getattr(deepspeed_config, "comms_config", None)
+            if cl is not None:
+                self.enabled = cl.enabled
+                self.prof_all = cl.prof_all
+                self.prof_ops = cl.prof_ops
+                self.verbose = cl.verbose
+                self.debug = cl.debug
+        if enabled is not None:
+            self.enabled = enabled
+        if prof_all is not None:
+            self.prof_all = prof_all
+        if prof_ops is not None:
+            self.prof_ops = prof_ops
+        if verbose is not None:
+            self.verbose = verbose
+        if debug is not None:
+            self.debug = debug
+
+    def append(self, raw_name, record_name, latency, msg_size, n=1):
+        if self.prof_ops and raw_name not in self.prof_ops and not self.prof_all:
+            return
+        entry = self.comms_dict[record_name][msg_size]
+        algbw, busbw, lat_ms = calc_bw_log(raw_name, msg_size, latency, n)
+        entry[0] += 1
+        entry[1].append(lat_ms)
+        entry[2].append(algbw)
+        entry[3].append(busbw)
+        if self.verbose:
+            logger.info(f"comm op: {record_name} | time (ms): {lat_ms:.2f} | "
+                        f"msg size: {convert_size(msg_size)} | algbw (Gbps): {algbw*8:.2f} | "
+                        f"busbw (Gbps): {busbw*8:.2f}")
+
+    def log_all(self, print_log=True, show_straggler=False):
+        from numpy import mean
+        lines = [f"{'Comm. Op':<20}{'Message Size':<20}{'Count':<10}"
+                 f"{'Total Latency(ms)':<20}{'Avg Latency(ms)':<20}{'tput_avg (Gbps)':<20}{'busbw_avg (Gbps)':<20}"]
+        for record_name in self.comms_dict.keys():
+            lines.append(record_name)
+            for msg_size, vals in sorted(self.comms_dict[record_name].items()):
+                count, latencies, algbws, busbws = vals
+                lines.append(f"{'':<20}{convert_size(msg_size):<20}{count:<10}"
+                             f"{sum(latencies):<20.2f}{mean(latencies):<20.2f}"
+                             f"{mean(algbws)*8:<20.2f}{mean(busbws)*8:<20.2f}")
+        out = "\n".join(lines)
+        if print_log:
+            logger.info("\n" + out)
+        return out

@@ -1,0 +1,209 @@
+"""Parallel-group registry as named axes of one global ``jax.sharding.Mesh``.
+
+TPU-native analog of the reference's ``deepspeed/utils/groups.py`` (lazy registry of
+torch process groups, groups.py:51-560). On TPU the natural SPMD formulation is ONE
+device mesh whose named axes play the role of process groups:
+
+    ('pipe', 'data', 'expert', 'seq', 'model')
+
+- ``model``  — tensor parallelism (innermost: highest-bandwidth ICI neighbors).
+- ``seq``    — Ulysses sequence parallelism (reference: deepspeed/sequence/layer.py).
+- ``expert`` — expert parallelism; carved out of the data-parallel ranks exactly like
+  the reference's ``_create_expert_and_data_parallel`` (groups.py:113-295): the dense
+  data-parallel world is ``data × expert``; expert parameters are data-parallel over
+  ``data`` only (the "expert-data-parallel group") and expert-parallel over ``expert``.
+- ``data``   — the remaining data parallelism.
+- ``pipe``   — pipeline stages (outermost; can span DCN).
+
+ZeRO partitioning happens over the "sequence-data-parallel" axes
+(('data', 'expert', 'seq')) matching the reference engine's use of
+``seq_data_parallel_group`` as the ZeRO group (engine.py:1138-1145).
+
+Collectives over these groups are expressed with ``jax.lax.{psum, all_gather,
+psum_scatter, all_to_all, ppermute}`` inside ``jax.shard_map``/``pjit`` — XLA lowers
+them to ICI/DCN collectives; there are no NCCL communicators to manage.
+"""
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+# Canonical mesh axis names, outermost (DCN-friendly) to innermost (ICI-critical).
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+EXPERT_AXIS = "expert"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+MESH_AXES = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+# Axis groups used as "process groups".
+DATA_PARALLEL_AXES = (DATA_AXIS, EXPERT_AXIS)  # dense-param DP group
+EXPERT_DATA_PARALLEL_AXES = (DATA_AXIS, )  # expert-param DP group
+SEQ_DATA_PARALLEL_AXES = (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS)  # ZeRO partition group
+
+_MESH = None  # the process-global Mesh (analog of the reference's module globals)
+
+
+class TopologyError(ValueError):
+    pass
+
+
+@dataclass
+class MeshTopology:
+    """Degrees of each parallel dimension; multiplies to the device count."""
+
+    pipe: int = 1
+    data: int = 1
+    expert: int = 1
+    seq: int = 1
+    model: int = 1
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.pipe, self.data, self.expert, self.seq, self.model)
+
+    def world_size(self) -> int:
+        return int(np.prod(self.shape))
+
+
+def initialize_mesh(
+    *,
+    data_parallel_size: Optional[int] = None,
+    model_parallel_size: int = 1,
+    pipe_parallel_size: int = 1,
+    expert_parallel_size: int = 1,
+    sequence_parallel_size: int = 1,
+    devices=None,
+    force: bool = False,
+):
+    """Build (or rebuild) the global mesh. ``data_parallel_size=None`` infers it from
+    the device count, mirroring the reference where dp = world // (mp*pp)."""
+    global _MESH
+    import jax
+    from jax.sharding import Mesh
+
+    if _MESH is not None and not force:
+        return _MESH
+
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    fixed = model_parallel_size * pipe_parallel_size * expert_parallel_size * sequence_parallel_size
+    if n % fixed != 0:
+        raise TopologyError(f"device count {n} not divisible by mp*pp*ep*sp = {fixed}")
+    if data_parallel_size is None:
+        data_parallel_size = n // fixed
+    topo = MeshTopology(pipe=pipe_parallel_size,
+                        data=data_parallel_size,
+                        expert=expert_parallel_size,
+                        seq=sequence_parallel_size,
+                        model=model_parallel_size)
+    if topo.world_size() != n:
+        raise TopologyError(f"mesh shape {topo.shape} (= {topo.world_size()}) != device count {n}")
+
+    dev_array = np.asarray(devices).reshape(topo.shape)
+    _MESH = Mesh(dev_array, MESH_AXES)
+    logger.info(f"initialized mesh pipe={topo.pipe} data={topo.data} expert={topo.expert} "
+                f"seq={topo.seq} model={topo.model} over {n} devices")
+    return _MESH
+
+
+def mesh_is_initialized() -> bool:
+    return _MESH is not None
+
+
+def get_mesh():
+    if _MESH is None:
+        initialize_mesh()
+    return _MESH
+
+
+def set_mesh(mesh):
+    """Install an externally built mesh (must use MESH_AXES names)."""
+    global _MESH
+    for ax in mesh.axis_names:
+        if ax not in MESH_AXES:
+            raise TopologyError(f"external mesh axis {ax!r} not in {MESH_AXES}")
+    _MESH = mesh
+    return _MESH
+
+
+def destroy_mesh():
+    """Reset global state (tests)."""
+    global _MESH
+    _MESH = None
+
+
+def _axis_size(axes) -> int:
+    mesh = get_mesh()
+    if isinstance(axes, str):
+        axes = (axes, )
+    size = 1
+    for ax in axes:
+        size *= mesh.shape.get(ax, 1)
+    return size
+
+
+# ---- world-size accessors (reference: groups.py getters) -------------------------
+
+def get_model_parallel_world_size() -> int:
+    return _axis_size(MODEL_AXIS)
+
+
+def get_sequence_parallel_world_size() -> int:
+    return _axis_size(SEQ_AXIS)
+
+
+def get_pipe_parallel_world_size() -> int:
+    return _axis_size(PIPE_AXIS)
+
+
+def get_expert_parallel_world_size() -> int:
+    return _axis_size(EXPERT_AXIS)
+
+
+def get_data_parallel_world_size() -> int:
+    """Dense-parameter DP degree (reference dp = world // (mp*pp))."""
+    return _axis_size(DATA_PARALLEL_AXES)
+
+
+def get_expert_data_parallel_world_size() -> int:
+    return _axis_size(EXPERT_DATA_PARALLEL_AXES)
+
+
+def get_sequence_data_parallel_world_size() -> int:
+    """The ZeRO partition degree (sp * dp), reference groups.py:452-499."""
+    return _axis_size(SEQ_DATA_PARALLEL_AXES)
+
+
+def get_world_size() -> int:
+    return get_mesh().size
+
+
+# ---- axis-name accessors: pass these to jax.lax collectives ----------------------
+
+def get_data_parallel_axes() -> Tuple[str, ...]:
+    return DATA_PARALLEL_AXES
+
+
+def get_expert_parallel_axis() -> str:
+    return EXPERT_AXIS
+
+
+def get_sequence_parallel_axis() -> str:
+    return SEQ_AXIS
+
+
+def get_model_parallel_axis() -> str:
+    return MODEL_AXIS
+
+
+def get_pipe_parallel_axis() -> str:
+    return PIPE_AXIS
+
+
+def get_zero_partition_axes() -> Tuple[str, ...]:
+    return SEQ_DATA_PARALLEL_AXES

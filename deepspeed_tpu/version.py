@@ -1,0 +1,14 @@
+"""Version info for deepspeed_tpu.
+
+Mirrors the role of the reference's ``version.txt`` / ``deepspeed/git_version_info.py``.
+"""
+
+__version__ = "0.1.0"
+version = __version__
+git_hash = "unknown"
+git_branch = "main"
+
+# Populated by the op registry at import time (analog of the reference's
+# op_builder/all_ops.py + git_version_info installed-ops record).
+installed_ops = {}
+compatible_ops = {}

@@ -1,0 +1,52 @@
+"""Test model zoo.
+
+Reference: ``tests/unit/simple_model.py`` (SimpleModel:19, SimpleMoEModel:79,
+random_dataloader:272). Models are tiny flax modules whose apply returns the loss.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel(nn.Module):
+    """Reference SimpleModel: linear stack + cross-entropy-ish loss; here an MLP
+    regression so the loss is smooth and deterministic."""
+    hidden_dim: int = 16
+    nlayers: int = 2
+
+    @nn.compact
+    def __call__(self, batch):
+        x, y = batch
+        for _ in range(self.nlayers):
+            x = nn.Dense(self.hidden_dim)(x)
+            x = nn.relu(x)
+        x = nn.Dense(1)(x)
+        return jnp.mean((x.squeeze(-1) - y)**2)
+
+
+def make_simple_model(hidden_dim=16, nlayers=2, seed=0, batch_size=8):
+    model = SimpleModel(hidden_dim=hidden_dim, nlayers=nlayers)
+    x = jnp.ones((batch_size, hidden_dim))
+    y = jnp.ones((batch_size, ))
+    params = model.init(jax.random.PRNGKey(seed), (x, y))["params"]
+    return model, params
+
+
+def random_dataset(total_samples, hidden_dim, seed=123):
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(total_samples, hidden_dim)).astype(np.float32)
+    w = rng.normal(size=(hidden_dim, )).astype(np.float32)
+    ys = (xs @ w).astype(np.float32)
+    return [(xs[i], ys[i]) for i in range(total_samples)]
+
+
+def random_batches(n_batches, batch_size, hidden_dim, seed=123):
+    rng = np.random.default_rng(seed)
+    out = []
+    w = rng.normal(size=(hidden_dim, )).astype(np.float32)
+    for _ in range(n_batches):
+        x = rng.normal(size=(batch_size, hidden_dim)).astype(np.float32)
+        out.append((x, (x @ w).astype(np.float32)))
+    return out

@@ -1,0 +1,97 @@
+"""Config system tests (reference: tests/unit/runtime/test_ds_config_dict.py etc.)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.utils import groups
+
+
+@pytest.fixture(autouse=True)
+def mesh():
+    groups.initialize_mesh(force=True)  # dp = 8
+    yield
+
+
+def test_batch_triangle_complete():
+    cfg = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2})
+    assert cfg.gradient_accumulation_steps == 2
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_from_micro_and_gas():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 3})
+    assert cfg.train_batch_size == 48
+
+
+def test_batch_only_train_batch():
+    cfg = DeepSpeedConfig({"train_batch_size": 16})
+    assert cfg.train_micro_batch_size_per_gpu == 2
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_missing_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({})
+
+
+def test_batch_inconsistent_raises():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig({
+            "train_batch_size": 10,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2
+        })
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({
+            "train_batch_size": 8,
+            "fp16": {"enabled": True},
+            "bf16": {"enabled": True}
+        })
+
+
+def test_zero_config_fields():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {
+            "stage": 3,
+            "stage3_param_persistence_threshold": 123,
+            "offload_optimizer": {"device": "cpu"}
+        }
+    })
+    assert cfg.zero_config.stage == 3
+    assert cfg.zero_config.param_persistence_threshold == 123
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
+    assert cfg.zero_config.overlap_comm is True  # defaulted by stage
+
+
+def test_zero_deprecated_field_warns():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {"stage": 2, "cpu_offload": True}
+    })
+    assert cfg.zero_config.stage == 2
+
+
+def test_duplicate_json_keys_raise(tmp_path):
+    p = tmp_path / "ds.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(p))
+
+
+def test_config_from_file(tmp_path):
+    p = tmp_path / "ds.json"
+    p.write_text(json.dumps({"train_batch_size": 8, "optimizer": {"type": "AdamW", "params": {"lr": 0.1}}}))
+    cfg = DeepSpeedConfig(str(p))
+    assert cfg.optimizer_name == "adamw"
+    assert cfg.optimizer_params["lr"] == 0.1
+
+
+def test_auto_values_ignored():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "zero_optimization": {"stage": 1, "reduce_bucket_size": "auto"}})
+    assert cfg.zero_config.reduce_bucket_size == int(5e8)

@@ -1,0 +1,46 @@
+"""Mesh topology registry tests (reference: tests/unit/runtime/pipe/test_topology.py
+style pure-logic coverage for deepspeed/utils/groups.py)."""
+
+import pytest
+
+from deepspeed_tpu.utils import groups
+
+
+def test_default_mesh_all_data():
+    mesh = groups.initialize_mesh(force=True)
+    assert mesh.size == 8
+    assert groups.get_data_parallel_world_size() == 8
+    assert groups.get_model_parallel_world_size() == 1
+    assert groups.get_expert_parallel_world_size() == 1
+    assert groups.get_sequence_data_parallel_world_size() == 8
+
+
+def test_mixed_topology():
+    groups.initialize_mesh(model_parallel_size=2, expert_parallel_size=2, force=True)
+    assert groups.get_model_parallel_world_size() == 2
+    assert groups.get_expert_parallel_world_size() == 2
+    # dense DP spans data*expert (expert groups are carved out of DP ranks)
+    assert groups.get_data_parallel_world_size() == 4
+    assert groups.get_expert_data_parallel_world_size() == 2
+    assert groups.get_world_size() == 8
+
+
+def test_seq_parallel_topology():
+    groups.initialize_mesh(sequence_parallel_size=4, force=True)
+    assert groups.get_sequence_parallel_world_size() == 4
+    assert groups.get_data_parallel_world_size() == 2
+    # ZeRO partitions over sp*dp (reference seq_data_parallel_group)
+    assert groups.get_sequence_data_parallel_world_size() == 8
+
+
+def test_invalid_topology_raises():
+    with pytest.raises(groups.TopologyError):
+        groups.initialize_mesh(model_parallel_size=3, force=True)
+
+
+def test_external_mesh_axis_validation():
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+    with pytest.raises(groups.TopologyError):
+        groups.set_mesh(Mesh(np.array(jax.devices()).reshape(8), ("bogus", )))

@@ -1,0 +1,74 @@
+"""LR schedule tests (reference: tests/unit/runtime/test_lr_schedulers.py)."""
+
+import math
+
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (LRRangeTest, OneCycle, WarmupCosineLR, WarmupDecayLR, WarmupLR,
+                                                get_lr_schedule_class)
+
+
+def test_warmup_lr_reaches_max():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10, warmup_type="linear")
+    lrs = [s.step()[0] for _ in range(20)]
+    assert lrs[-1] == pytest.approx(0.1)
+    assert lrs[0] < lrs[5] < lrs[9]
+
+
+def test_warmup_log_monotone():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=100, warmup_type="log")
+    lrs = [s.step()[0] for _ in range(100)]
+    assert all(b >= a for a, b in zip(lrs, lrs[1:]))
+    assert lrs[-1] == pytest.approx(1.0)
+
+
+def test_warmup_decay_hits_zero():
+    s = WarmupDecayLR(total_num_steps=100, warmup_max_lr=0.1, warmup_num_steps=10)
+    for _ in range(101):
+        lr = s.step()[0]
+    assert lr == pytest.approx(0.0, abs=1e-6)
+
+
+def test_warmup_decay_validates():
+    with pytest.raises(ValueError):
+        WarmupDecayLR(total_num_steps=5, warmup_num_steps=10)
+
+
+def test_one_cycle_shape():
+    s = OneCycle(cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=10)
+    lrs = [s.step()[0] for _ in range(30)]
+    assert max(lrs) == pytest.approx(0.1, rel=0.2)
+    assert lrs[0] == pytest.approx(0.01, rel=0.1)
+    # decays after the cycle
+    assert lrs[-1] <= 0.01 + 1e-9
+
+
+def test_lr_range_test_staircase():
+    s = LRRangeTest(lr_range_test_min_lr=0.01, lr_range_test_step_size=5, lr_range_test_step_rate=1.0,
+                    lr_range_test_staircase=True)
+    lrs = [s.step()[0] for _ in range(10)]
+    assert lrs[0] == lrs[4] == pytest.approx(0.01)
+    assert lrs[5] == pytest.approx(0.02)
+
+
+def test_warmup_cosine():
+    s = WarmupCosineLR(total_num_steps=100, warmup_num_steps=10, cos_min_ratio=0.0)
+    lrs = [s.step()[0] for _ in range(101)]
+    assert lrs[10] == pytest.approx(1.0, rel=0.01)
+    assert lrs[-1] == pytest.approx(0.0, abs=0.01)
+
+
+def test_registry():
+    assert get_lr_schedule_class("WarmupLR") is WarmupLR
+    with pytest.raises(ValueError):
+        get_lr_schedule_class("NoSuch")
+
+
+def test_state_dict_roundtrip():
+    s = WarmupLR(warmup_max_lr=0.1, warmup_num_steps=10)
+    for _ in range(5):
+        s.step()
+    sd = s.state_dict()
+    s2 = WarmupLR(warmup_max_lr=0.1, warmup_num_steps=10)
+    s2.load_state_dict(sd)
+    assert s2.last_batch_iteration == s.last_batch_iteration
