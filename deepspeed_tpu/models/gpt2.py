@@ -1,0 +1,89 @@
+"""GPT-2 causal LM (milestone config #2: GPT-2-125M SFT, BASELINE.md).
+
+Reference exercises GPT-2 through HF injection policies
+(``deepspeed/module_inject/containers/gpt2.py``); here it is a native flax model.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.llama import causal_attention, cross_entropy_loss
+from deepspeed_tpu.utils import groups
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    layer_norm_epsilon: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False
+
+    @staticmethod
+    def gpt2_125m(**kw):
+        return GPT2Config(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        return GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=2, n_head=4, **kw)
+
+
+class GPT2Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        H = cfg.n_head
+        D = cfg.n_embd // H
+        B, S, _ = x.shape
+        dense = partial(nn.Dense, dtype=cfg.dtype)
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_1")(x)
+        qkv = dense(3 * cfg.n_embd, name="c_attn")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, H, D)
+        k = k.reshape(B, S, H, D)
+        v = v.reshape(B, S, H, D)
+        attn = causal_attention(q, k, v, scale=1.0 / (D**0.5)).reshape(B, S, cfg.n_embd)
+        x = x + dense(cfg.n_embd, name="c_proj")(attn)
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_2")(x)
+        h = dense(4 * cfg.n_embd, name="c_fc")(h)
+        h = nn.gelu(h)
+        x = x + dense(cfg.n_embd, name="mlp_c_proj")(h)
+        return x
+
+
+class GPT2LMHeadModel(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, batch):
+        input_ids, labels = batch
+        cfg = self.cfg
+        B, S = input_ids.shape
+        wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype, name="wte")
+        x = wte(input_ids)
+        pos = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype, name="wpe")(jnp.arange(S)[None])
+        x = x + pos
+        block = nn.remat(GPT2Block) if cfg.remat else GPT2Block
+        for i in range(cfg.n_layer):
+            x = block(cfg, name=f"h_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_f")(x)
+        logits = wte.attend(x.astype(jnp.float32))  # tied embeddings
+        return cross_entropy_loss(logits, labels)
+
+
+def init_params(cfg: GPT2Config, rng=None, batch_size=1, seq_len=16):
+    model = GPT2LMHeadModel(cfg)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    ids = jnp.zeros((batch_size, seq_len), jnp.int32)
+    return model, model.init(rng, (ids, ids))["params"]

@@ -1,0 +1,221 @@
+"""Llama-family causal LM (the flagship training model).
+
+Role in the framework: the reference exercises Llama-2 through DeepSpeed-Chat SFT
+(BASELINE.md north-star: Llama-2-7B ZeRO-3 bf16) and through inference policies
+(``deepspeed/inference/v2/model_implementations/llama_v2``). This is the TPU-native
+equivalent model implementation: flax, bf16 matmuls on the MXU, GQA, RoPE, SwiGLU,
+``jax.checkpoint`` rematerialization, Megatron-style TP sharding specs over the
+``model`` mesh axis, and Ulysses sequence parallelism over the ``seq`` axis.
+"""
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.sequence.layer import DistributedAttention
+from deepspeed_tpu.utils import groups
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = True
+    sequence_parallel: bool = False
+    use_flash_attention: bool = False
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=256, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                    num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=128,
+                    remat=False)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+    @staticmethod
+    def llama2_7b(**kw):
+        return LlamaConfig(**kw)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("weight", nn.initializers.ones, (x.shape[-1], ), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (normed * scale).astype(x.dtype)
+
+
+def rotary_embedding(seq_len, head_dim, theta=10000.0, dtype=jnp.float32):
+    inv_freq = 1.0 / (theta**(jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rotary(x, cos, sin):
+    # x: [B, S, H, D]; rotate pairs (x1, x2) per the Llama convention
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def causal_attention(q, k, v, scale):
+    """Plain XLA attention [B,S,H,D]; fused/flash variant in ops/pallas."""
+    B, S, H, D = q.shape
+    _, _, KVH, _ = k.shape
+    if KVH != H:  # GQA: repeat kv heads
+        rep = H // KVH
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def flash_causal_attention(q, k, v, scale):
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+    return flash_attention(q, k, v, scale=scale, causal=True)
+
+
+class LlamaAttention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin):
+        cfg = self.cfg
+        H, KVH = cfg.num_attention_heads, cfg.num_key_value_heads
+        D = cfg.hidden_size // H
+        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype)
+
+        q = dense(H * D, name="q_proj")(x).reshape(*x.shape[:-1], H, D)
+        k = dense(KVH * D, name="k_proj")(x).reshape(*x.shape[:-1], KVH, D)
+        v = dense(KVH * D, name="v_proj")(x).reshape(*x.shape[:-1], KVH, D)
+        q = apply_rotary(q, cos, sin)
+        k = apply_rotary(k, cos, sin)
+
+        inner = flash_causal_attention if cfg.use_flash_attention else causal_attention
+        attn = partial(inner, scale=1.0 / (D**0.5))
+        if cfg.sequence_parallel:
+            # Ulysses: all-to-all seq→heads around full-sequence local attention
+            attn = DistributedAttention(attn)
+        out = attn(q, k, v)
+        out = out.reshape(*x.shape[:-1], H * D)
+        return dense(cfg.hidden_size, name="o_proj")(out)
+
+
+class LlamaMLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype)
+        gate = dense(cfg.intermediate_size, name="gate_proj")(x)
+        up = dense(cfg.intermediate_size, name="up_proj")(x)
+        return dense(cfg.hidden_size, name="down_proj")(nn.silu(gate) * up)
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin):
+        x = x + LlamaAttention(self.cfg, name="self_attn")(RMSNorm(self.cfg.rms_norm_eps,
+                                                                   name="input_layernorm")(x), cos, sin)
+        x = x + LlamaMLP(self.cfg, name="mlp")(RMSNorm(self.cfg.rms_norm_eps,
+                                                        name="post_attention_layernorm")(x))
+        return x
+
+
+class LlamaModel(nn.Module):
+    """Returns logits [B, S, V]."""
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="embed_tokens")(input_ids)
+        S = input_ids.shape[1]
+        D = cfg.hidden_size // cfg.num_attention_heads
+        cos, sin = rotary_embedding(S, D, cfg.rope_theta, jnp.float32)
+
+        block = LlamaBlock
+        if cfg.remat:
+            # activation recomputation: keep only block boundaries
+            # (reference activation_checkpointing/checkpointing.py role)
+            block = nn.remat(LlamaBlock, policy=jax.checkpoint_policies.nothing_saveable)
+        for i in range(cfg.num_hidden_layers):
+            x = block(cfg, name=f"layers_{i}")(x, cos, sin)
+
+        x = RMSNorm(cfg.rms_norm_eps, name="norm")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head")(x)
+        return logits
+
+
+class LlamaForCausalLM(nn.Module):
+    """Loss module: batch = (input_ids, labels); -100 labels are masked."""
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, batch):
+        input_ids, labels = batch
+        logits = LlamaModel(self.cfg, name="model")(input_ids)
+        return cross_entropy_loss(logits, labels)
+
+
+def cross_entropy_loss(logits, labels, ignore_index=-100):
+    valid = labels != ignore_index
+    labels_safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, labels_safe[..., None], axis=-1)[..., 0]
+    return -jnp.sum(ll * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+
+def init_params(cfg: LlamaConfig, rng=None, batch_size=1, seq_len=None):
+    model = LlamaForCausalLM(cfg)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    S = seq_len or min(cfg.max_position_embeddings, 16)
+    ids = jnp.zeros((batch_size, S), jnp.int32)
+    return model, model.init(rng, (ids, ids))["params"]
+
+
+def llama_param_specs(params, model_axis=groups.MODEL_AXIS):
+    """Megatron-style TP placement over the ``model`` axis: column-parallel
+    q/k/v/gate/up (+embed, lm_head), row-parallel o_proj/down_proj. The reference
+    gets this from megatron mpu / AutoTP (module_inject/auto_tp.py:188)."""
+    from jax.sharding import PartitionSpec as P
+
+    COL = {"q_proj", "k_proj", "v_proj", "gate_proj", "up_proj", "lm_head"}
+    ROW = {"o_proj", "down_proj"}
+
+    def spec(path, leaf):
+        names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+        if leaf.ndim == 2:
+            if any(n in COL for n in names):
+                return P(None, model_axis)
+            if any(n in ROW for n in names):
+                return P(model_axis, None)
+            if "embed_tokens" in names:
+                return P(None, model_axis)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)

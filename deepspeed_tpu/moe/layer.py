@@ -1,0 +1,101 @@
+"""MoE layer (flax).
+
+Reference: ``deepspeed/moe/layer.py`` (MoE:16 — wrapper creating EP groups and
+wiring TopKGate + MOELayer + local Experts) and ``deepspeed/moe/experts.py``.
+
+The flax module owns the gate weight and a *stacked* expert FFN parameter bank of
+shape [num_local_experts * ep, ...] sharded over the expert mesh axis; expert
+compute is a vmap over that leading dim, so each chip runs only its local experts
+(the reference's ``Experts:10`` ModuleList of per-rank experts).
+"""
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.moe.sharded_moe import TopKGate, moe_dispatch_combine
+from deepspeed_tpu.utils import groups
+
+
+class ExpertFFN(nn.Module):
+    """Stacked expert MLPs: params have a leading expert dim (sharded over EP)."""
+    num_experts: int
+    d_model: int
+    d_hidden: int
+    activation: Callable = nn.gelu
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):  # x: [E, C, M]
+        wi = self.param("wi", nn.initializers.lecun_normal(), (self.num_experts, self.d_model, self.d_hidden),
+                        self.dtype)
+        wo = self.param("wo", nn.initializers.lecun_normal(), (self.num_experts, self.d_hidden, self.d_model),
+                        self.dtype)
+        h = jnp.einsum("ecm,emh->ech", x, wi.astype(x.dtype))
+        h = self.activation(h)
+        return jnp.einsum("ech,ehm->ecm", h, wo.astype(x.dtype))
+
+
+class MoE(nn.Module):
+    """Reference MoE:16 API surface as a flax module.
+
+    Call with x: [..., M] (flattened to tokens internally); returns
+    (output, l_aux, exp_counts) exactly like the reference forward.
+    """
+    hidden_size: int
+    num_experts: int = 1
+    ffn_hidden_size: Optional[int] = None
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    use_residual: bool = False
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+    activation: Callable = nn.gelu
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, used_token=None, training: bool = True):
+        M = self.hidden_size
+        orig_shape = x.shape
+        tokens = x.reshape(-1, M)
+
+        gate = TopKGate(M, self.num_experts, self.k, self.capacity_factor, self.eval_capacity_factor,
+                        self.min_capacity, self.noisy_gate_policy, self.drop_tokens, self.use_rts)
+        wg = self.param("gate", nn.initializers.lecun_normal(), (M, self.num_experts), jnp.float32)
+        rng = self.make_rng("gating") if self.has_rng("gating") else None
+        l_aux, combine, dispatch, exp_counts = gate(wg, tokens, rng=rng, used_token=used_token, training=training)
+
+        experts = ExpertFFN(self.num_experts, M, self.ffn_hidden_size or 4 * M, self.activation, self.dtype)
+        out = moe_dispatch_combine(tokens, combine, dispatch, experts)
+
+        if self.use_residual:
+            # PR-MoE (reference layer.py use_residual): dense MLP + learned mix
+            mlp_out = nn.Dense(self.ffn_hidden_size or 4 * M, dtype=x.dtype)(tokens)
+            mlp_out = self.activation(mlp_out)
+            mlp_out = nn.Dense(M, dtype=x.dtype)(mlp_out)
+            coef = nn.Dense(2, dtype=x.dtype)(tokens)
+            coef = jax.nn.softmax(coef, axis=-1)
+            out = out * coef[..., 0:1] + mlp_out * coef[..., 1:2]
+
+        return out.reshape(orig_shape), l_aux, exp_counts
+
+
+def expert_param_specs(params, expert_axis=groups.EXPERT_AXIS):
+    """PartitionSpec tree for an MoE module's params: expert banks sharded on their
+    leading (expert) dim, everything else replicated. Feed to
+    ``deepspeed_tpu.initialize(param_specs=...)`` (the reference marks expert params
+    with ``allreduce=False`` + EP groups; here placement is the whole story)."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, leaf):
+        names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+        if any(n in ("wi", "wo") for n in names) and leaf.ndim >= 1:
+            return P(expert_axis, *([None] * (leaf.ndim - 1)))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)

@@ -1,0 +1,218 @@
+"""MoE gating + dispatch math.
+
+Reference: ``deepspeed/moe/sharded_moe.py`` (TopKGate:348, top1gating:184 with
+capacity + random token selection, top2gating:282, MOELayer:425, _AllToAll:95).
+
+TPU-native formulation: gating is pure jnp (einsum dispatch/combine masks exactly as
+the reference computes them), and expert parallelism is expressed with
+``with_sharding_constraint`` over the ``expert`` mesh axis — GSPMD inserts the two
+variable all-to-alls the reference issues explicitly (dispatch and return), and
+overlaps them with the expert GEMMs.
+"""
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils import groups
+
+def multiplicative_jitter(x, rng, epsilon=1e-2):
+    """Reference sharded_moe.py multiplicative_jitter: noise in [1-eps, 1+eps]."""
+    if epsilon == 0:
+        return x
+    u = jax.random.uniform(rng, x.shape, dtype=x.dtype, minval=1.0 - epsilon, maxval=1.0 + epsilon)
+    return x * u
+
+
+def gumbel_rsample(shape, rng, dtype=jnp.float32):
+    return jax.random.gumbel(rng, shape, dtype=dtype)
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float, min_capacity: int) -> int:
+    """Reference sharded_moe.py _capacity: ceil(tokens/experts * factor), floored
+    at min_capacity. Static on TPU (shapes must be compile-time constants)."""
+    capacity = math.ceil((num_tokens / num_experts) * capacity_factor)
+    return max(capacity, min_capacity)
+
+
+def _one_hot(indices, num_classes, dtype=jnp.float32):
+    return jax.nn.one_hot(indices, num_classes, dtype=dtype)
+
+
+def top1gating(logits: jnp.ndarray,
+               capacity_factor: float = 1.0,
+               min_capacity: int = 4,
+               used_token: Optional[jnp.ndarray] = None,
+               noisy_gate_policy: Optional[str] = None,
+               rng: Optional[jnp.ndarray] = None,
+               drop_tokens: bool = True,
+               use_rts: bool = True):
+    """Top-1 gating (reference top1gating:184).
+
+    Returns (l_aux, combine_weights [S,E,C], dispatch_mask [S,E,C], exp_counts).
+    """
+    S, E = logits.shape
+    capacity = _capacity(S, E, capacity_factor, min_capacity)
+    if not drop_tokens:
+        # grow capacity to fit every token (reference drop_tokens=False path does a
+        # max over exp_counts; static shapes force worst-case S here)
+        capacity = S
+
+    if noisy_gate_policy == "RSample":
+        assert rng is not None, "RSample noisy gating needs an rng"
+        logits_w_noise = logits + gumbel_rsample(logits.shape, rng, dtype=logits.dtype)
+    else:
+        logits_w_noise = logits
+
+    gates = jax.nn.softmax(logits, axis=1)
+    indices1_s = jnp.argmax(logits_w_noise if noisy_gate_policy == "RSample" else gates, axis=1)
+    mask1 = _one_hot(indices1_s, E)
+    if used_token is not None:
+        mask1 = mask1 * used_token[:, None]
+
+    exp_counts = jnp.sum(mask1, axis=0)
+
+    # aux loss (reference: me*ce*E)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    # random token selection: prioritize tokens by uniform score within expert;
+    # with no rng (eval / inference) fall back to positional priority — the
+    # reference uses torch's implicit generator, which has no analog here
+    if use_rts and rng is not None:
+        mask1_rand = mask1 * jax.random.uniform(jax.random.fold_in(rng, 1), mask1.shape, dtype=mask1.dtype)
+    else:
+        mask1_rand = mask1
+
+    # position of each token within its expert's queue, ordered by priority
+    top_idx = jnp.argsort(-mask1_rand, axis=0)  # [S, E] token order per expert
+    rank_in_expert = jnp.argsort(top_idx, axis=0)  # inverse perm: priority rank
+    locations1 = jnp.where(mask1 > 0, rank_in_expert.astype(jnp.float32), 0.0)
+    keep = (rank_in_expert < capacity).astype(mask1.dtype)
+    mask1 = mask1 * keep
+
+    locations1_s = jnp.sum(locations1 * mask1, axis=1).astype(jnp.int32)
+
+    gates1_s = jnp.sum(gates * mask1, axis=1)  # gate value if kept else 0
+    locations1_sc = _one_hot(locations1_s, capacity)
+    combine_weights = gates1_s[:, None, None] * mask1[:, :, None] * locations1_sc[:, None, :]
+    dispatch_mask = (combine_weights > 0).astype(logits.dtype)
+    return l_aux, combine_weights, dispatch_mask, exp_counts
+
+
+def top2gating(logits: jnp.ndarray,
+               capacity_factor: float = 1.0,
+               min_capacity: int = 4,
+               rng: Optional[jnp.ndarray] = None):
+    """Top-2 gating (reference top2gating:282, GShard algorithm)."""
+    S, E = logits.shape
+    capacity = _capacity(S, E, 2 * capacity_factor, min_capacity)
+
+    gates = jax.nn.softmax(logits, axis=1)
+    indices1_s = jnp.argmax(gates, axis=1)
+    mask1 = _one_hot(indices1_s, E)
+
+    logits_w_noise = logits + (gumbel_rsample(logits.shape, rng, dtype=logits.dtype) if rng is not None else 0.0)
+    logits_except1 = jnp.where(mask1.astype(bool), -jnp.inf, logits_w_noise)
+    indices2_s = jnp.argmax(logits_except1, axis=1)
+    mask2 = _one_hot(indices2_s, E)
+
+    locations1 = jnp.cumsum(mask1, axis=0) - 1
+    locations2 = jnp.cumsum(mask2, axis=0) - 1 + jnp.sum(mask1, axis=0, keepdims=True)
+
+    exp_counts = jnp.sum(mask1 + mask2, axis=0)
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.mean(me * ce) * E * E
+
+    mask1 = mask1 * (locations1 < capacity)
+    mask2 = mask2 * (locations2 < capacity)
+
+    locations1_s = jnp.sum(locations1 * mask1, axis=1).astype(jnp.int32)
+    locations2_s = jnp.sum(locations2 * mask2, axis=1).astype(jnp.int32)
+
+    # normalize gate values of the two selected experts
+    gates1_s = jnp.sum(gates * mask1, axis=1)
+    gates2_s = jnp.sum(gates * mask2, axis=1)
+    denom = gates1_s + gates2_s
+    denom = jnp.where(denom < jnp.finfo(denom.dtype).eps, 1.0, denom)
+    gates1_s = gates1_s / denom
+    gates2_s = gates2_s / denom
+
+    combine1 = gates1_s[:, None, None] * mask1[:, :, None] * _one_hot(locations1_s, capacity)[:, None, :]
+    combine2 = gates2_s[:, None, None] * mask2[:, :, None] * _one_hot(locations2_s, capacity)[:, None, :]
+    combine_weights = combine1 + combine2
+    dispatch_mask = (combine_weights > 0).astype(logits.dtype)
+    return l_aux, combine_weights, dispatch_mask, exp_counts
+
+
+class TopKGate:
+    """Reference TopKGate:348 — functional form: call with (wg, x, rng)."""
+
+    def __init__(self,
+                 model_dim: int,
+                 num_experts: int,
+                 k: int = 1,
+                 capacity_factor: float = 1.0,
+                 eval_capacity_factor: float = 1.0,
+                 min_capacity: int = 8,
+                 noisy_gate_policy: Optional[str] = None,
+                 drop_tokens: bool = True,
+                 use_rts: bool = True,
+                 top2_2nd_expert_sampling: bool = True):
+        if k not in (1, 2):
+            raise ValueError("Only top-1 and top-2 gatings are supported.")
+        self.model_dim = model_dim
+        self.num_experts = num_experts
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.min_capacity = min_capacity
+        self.noisy_gate_policy = noisy_gate_policy
+        self.drop_tokens = drop_tokens
+        self.use_rts = use_rts
+        self.top2_2nd_expert_sampling = top2_2nd_expert_sampling
+
+    def __call__(self, wg: jnp.ndarray, x: jnp.ndarray, rng=None, used_token=None, training=True):
+        x_fp32 = x.astype(jnp.float32)
+        if self.noisy_gate_policy == "Jitter" and rng is not None and training:
+            x_fp32 = multiplicative_jitter(x_fp32, rng)
+        logits = x_fp32 @ wg.astype(jnp.float32)
+        cf = self.capacity_factor if training else self.eval_capacity_factor
+        if self.k == 1:
+            return top1gating(logits, cf, self.min_capacity, used_token,
+                              self.noisy_gate_policy if training else None, rng,
+                              self.drop_tokens, self.use_rts)
+        return top2gating(logits, cf, self.min_capacity,
+                          rng if (training and self.top2_2nd_expert_sampling) else None)
+
+
+def moe_dispatch_combine(x: jnp.ndarray,
+                         combine_weights: jnp.ndarray,
+                         dispatch_mask: jnp.ndarray,
+                         expert_fn,
+                         expert_axis: str = groups.EXPERT_AXIS,
+                         mesh=None):
+    """Dispatch → expert compute → combine (reference MOELayer.forward:477-554).
+
+    x: [S, M]; combine/dispatch: [S, E, C]. ``expert_fn(inputs[E, C, M]) -> [E, C, M]``
+    applies the per-expert FFN (vmapped over the expert dim, whose parameters are
+    sharded over the expert axis). The sharding constraints around expert_fn force
+    the [E, C, M] buffers onto the expert axis — GSPMD materializes the dispatch
+    and return all-to-alls of the reference's _AllToAll autograd fn.
+    """
+    from deepspeed_tpu.sequence.layer import _constrain
+
+    def expert_sharded(t):
+        return _constrain(t, (expert_axis, ) + (None, ) * (t.ndim - 1), mesh)
+
+    dispatched = jnp.einsum("sec,sm->ecm", dispatch_mask, x)
+    dispatched = expert_sharded(dispatched)
+    expert_out = expert_fn(dispatched)
+    expert_out = expert_sharded(expert_out)
+    combined = jnp.einsum("sec,ecm->sm", combine_weights.astype(x.dtype), expert_out.astype(x.dtype))
+    return combined

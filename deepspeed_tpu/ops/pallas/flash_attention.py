@@ -1,0 +1,201 @@
+"""Blocked (flash) causal attention.
+
+TPU-native replacement for the reference's attention kernels: the inference-v2
+``blocked_flash`` binding (``deepspeed/inference/v2/kernels/ragged_ops/blocked_flash``)
+and the training softmax/attention CUDA kernels (``csrc/transformer/softmax_kernels.cu``).
+
+Design:
+- Forward: a Pallas kernel, grid over (batch*heads, q_blocks); each program streams
+  KV blocks through VMEM with an online-softmax accumulator (flash-attention-2
+  schedule). Causal masking skips fully-masked KV blocks.
+- Backward: custom VJP that recomputes attention blockwise in pure JAX
+  (lax.scan over KV blocks) — O(S) memory like the forward, fused by XLA. A Pallas
+  backward kernel is a later optimization; this keeps training memory-correct now.
+- CPU (tests): interpret mode.
+
+Layout: q, k, v are [B, S, H, D] (kv may have fewer heads — GQA is expanded by the
+caller or here via repeat).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _on_cpu():
+    return jax.default_backend() == "cpu"
+
+
+def _fit_block(seq_len, cap):
+    """Largest divisor of seq_len that is <= cap (block shapes must tile S)."""
+    b = min(cap, seq_len)
+    while seq_len % b:
+        b -= 1
+    return b
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale, causal, block_q,
+                block_k, nkb):
+    """Flash-attention-2 schedule: grid (bh, q_blocks, kv_blocks); the kv dim is the
+    innermost (sequential) grid axis so Pallas double-buffers the K/V block fetches
+    while the scratch accumulators carry the online softmax across iterations."""
+    from jax.experimental import pallas as pl
+
+    q_idx = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # causal: block fully above the diagonal contributes nothing
+    run = (kb * block_k <= q_idx * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[...].astype(jnp.float32)  # [bq, d]
+        k_blk = k_ref[...].astype(jnp.float32)  # [bk, d]
+        v_blk = v_ref[...].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_scr[...][:, :1]  # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
+        l_scr[...] = l_scr[...] * alpha + jnp.broadcast_to(jnp.sum(p, axis=1, keepdims=True), l_scr.shape)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v_blk, (((1, ), (0, )), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(kb == nkb - 1)
+    def _finish():
+        l = l_scr[...][:, :1]
+        o_ref[...] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, scale, causal, block_q=512, block_k=1024):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, H, D = q.shape
+    block_q = _fit_block(S, block_q)
+    block_k = _fit_block(S, block_k)
+    nkb = S // block_k
+
+    qr = q.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    kr = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    vr = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+                               block_k=block_k, nkb=nkb)
+    on_cpu = _on_cpu()
+    scratch = [
+        pltpu.VMEM((block_q, 128), jnp.float32),  # m (lane-broadcast)
+        pltpu.VMEM((block_q, 128), jnp.float32),  # l (lane-broadcast)
+        pltpu.VMEM((block_q, D), jnp.float32),  # acc
+    ]
+    kwargs = {}
+    if not on_cpu:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, S // block_q, nkb),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        scratch_shapes=scratch,
+        interpret=on_cpu,
+        **kwargs,
+    )(qr, kr, vr)
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def _blockwise_attention_ref(q, k, v, scale, causal, block_k=256):
+    """Memory-efficient pure-JAX attention (scan over KV blocks) — used for the
+    VJP recompute and as numerical reference."""
+    B, S, H, D = q.shape
+    block_k = _fit_block(S, block_k)
+    nkb = S // block_k
+    q32 = q.astype(jnp.float32)
+    q_pos = jnp.arange(S)
+
+    def body(carry, kb):
+        m, l, acc = carry
+        start = kb * block_k
+        k_blk = jax.lax.dynamic_slice_in_dim(k, start, block_k, axis=1).astype(jnp.float32)
+        v_blk = jax.lax.dynamic_slice_in_dim(v, start, block_k, axis=1).astype(jnp.float32)
+        s = jnp.einsum("bqhd,bkhd->bqhk", q32, k_blk) * scale
+        if causal:
+            k_pos = start + jnp.arange(block_k)
+            s = jnp.where(q_pos[None, :, None, None] >= k_pos[None, None, None, :], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum("bqhk,bkhd->bqhd", p, v_blk)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((B, S, H), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, S, H), jnp.float32)
+    a0 = jnp.zeros((B, S, H, D), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, a0), jnp.arange(nkb))
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def _expand_gqa(q, k, v):
+    H, KVH = q.shape[2], k.shape[2]
+    if KVH != H:
+        rep = H // KVH
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, scale=1.0, causal=True):
+    k, v = _expand_gqa(q, k, v)
+    return _flash_fwd_pallas(q, k, v, scale, causal)
+
+
+def _fa_fwd(q, k, v, scale, causal):
+    out = flash_attention(q, k, v, scale, causal)
+    return out, (q, k, v)
+
+
+def _fa_bwd(scale, causal, res, g):
+    q, k, v = res
+    kvh = k.shape[2]
+    ke, ve = _expand_gqa(q, k, v)
+
+    def f(q, ke, ve):
+        return _blockwise_attention_ref(q, ke, ve, scale, causal)
+
+    _, vjp = jax.vjp(f, q, ke, ve)
+    dq, dke, dve = vjp(g)
+    if kvh != q.shape[2]:  # fold expanded GQA grads back onto kv heads
+        rep = q.shape[2] // kvh
+        B, S, _, D = dke.shape
+        dk = dke.reshape(B, S, kvh, rep, D).sum(axis=3)
+        dv = dve.reshape(B, S, kvh, rep, D).sum(axis=3)
+    else:
+        dk, dv = dke, dve
+    return dq, dk, dv
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

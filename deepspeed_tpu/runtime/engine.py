@@ -172,7 +172,9 @@ class DeepSpeedEngine:
         else:
             self.optimizer = _make_optimizer(self._config.optimizer_name, self._config.optimizer_params)
         opt_shapes = jax.eval_shape(self.optimizer.init, self.params)
-        self._opt_shardings = self.zero_policy.opt_shardings(opt_shapes)
+        opt_base = _broadcast_param_specs(opt_shapes, self.params, self.param_specs) \
+            if self.param_specs is not None else None
+        self._opt_shardings = self.zero_policy.opt_shardings(opt_shapes, opt_base)
         self.opt_state = jax.jit(self.optimizer.init, out_shardings=self._opt_shardings)(self.params)
 
         # grad accumulation buffer
@@ -230,13 +232,25 @@ class DeepSpeedEngine:
         if model is None:
             raise ValueError("Provide a model (flax module or loss callable) or loss_fn")
         if hasattr(model, "apply"):
+            try:
+                import flax.linen as _nn
+                is_flax = isinstance(model, _nn.Module)
+            except ImportError:
+                is_flax = False
 
-            def fn(params, batch, rng=None):
-                import jax
-                rngs = {"dropout": rng, "params": rng} if rng is not None else None
-                try:
+            if is_flax:
+
+                def fn(params, batch, rng=None):
+                    import jax
+                    if rng is not None:
+                        ks = jax.random.split(rng, 3)
+                        rngs = {"dropout": ks[0], "params": ks[1], "gating": ks[2]}
+                    else:
+                        rngs = None
                     return model.apply({"params": params}, batch, rngs=rngs)
-                except TypeError:
+            else:  # duck-typed: apply(variables, batch) without flax rng plumbing
+
+                def fn(params, batch, rng=None):
                     return model.apply({"params": params}, batch)
 
             return fn
@@ -618,6 +632,34 @@ class DeepSpeedEngine:
                  **{"/".join(map(str, k)): v
                     for k, v in _flatten_dict(gathered).items()})
         return True
+
+
+def _broadcast_param_specs(opt_tree, params, specs):
+    """Optimizer states mirror the param tree (moments) plus scalars; give the
+    param-shaped subtrees their parameters' TP/EP base specs so moments land on the
+    same shards as their parameter (reference: optimizer state lives in the same
+    flat partition as its param)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    pdef = jax.tree.structure(params)
+
+    def rec(t):
+        if t is None:  # empty optimizer-state slot (e.g. SGD without momentum)
+            return None
+        try:
+            if jax.tree.structure(t) == pdef:
+                return specs
+        except Exception:
+            pass
+        if isinstance(t, tuple) and hasattr(t, "_fields"):  # NamedTuple
+            return type(t)(*[rec(getattr(t, f)) for f in t._fields])
+        if isinstance(t, (list, tuple)):
+            return type(t)(rec(c) for c in t)
+        if isinstance(t, dict):
+            return {k: rec(v) for k, v in t.items()}
+        return P()
+
+    return rec(opt_tree)
 
 
 def _flatten_dict(tree, prefix=()):

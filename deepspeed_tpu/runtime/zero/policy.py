@@ -47,21 +47,32 @@ class ZeroShardingPolicy:
 
     # ---- spec construction -----------------------------------------------------
     def _add_zero_axes(self, shape, base_spec):
-        """Extend ``base_spec`` (TP placement) with the ZeRO axes on the first
-        free dimension divisible by the ZeRO degree."""
+        """Extend ``base_spec`` (TP/EP placement) with the ZeRO axes on the first
+        free dimension divisible by the ZeRO degree. Axes already used by the base
+        spec are excluded — an expert-sharded parameter is ZeRO-partitioned only
+        over the remaining axes, which is exactly the reference's
+        expert-data-parallel group (engine.py:2417, groups.py:113-295)."""
         from jax.sharding import PartitionSpec as P
-        if not self.zero_axes or self.zero_size == 1:
-            return base_spec
         base = tuple(base_spec) if base_spec is not None else ()
         base = base + (None, ) * (len(shape) - len(base))
+        used = set()
+        for entry in base:
+            if entry is None:
+                continue
+            for ax in (entry if isinstance(entry, tuple) else (entry, )):
+                used.add(ax)
+        axes = tuple(ax for ax in self.zero_axes if ax not in used)
+        size_prod = int(np.prod([self.mesh.shape[ax] for ax in axes])) if axes else 1
+        if not axes or size_prod == 1:
+            return P(*base)
         if int(np.prod(shape)) <= self.persistence_threshold:
             return P(*base)
         for dim, size in enumerate(shape):
             if base[dim] is not None:
-                continue  # taken by TP
-            if size % self.zero_size == 0 and size > 0:
+                continue  # taken by TP/EP
+            if size % size_prod == 0 and size > 0:
                 new = list(base)
-                new[dim] = self.zero_axes if len(self.zero_axes) > 1 else self.zero_axes[0]
+                new[dim] = axes if len(axes) > 1 else axes[0]
                 return P(*new)
         return P(*base)  # nothing divides — stay replicated
 

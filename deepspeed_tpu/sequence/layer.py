@@ -1,0 +1,73 @@
+"""Ulysses sequence parallelism.
+
+Reference: ``deepspeed/sequence/layer.py`` (DistributedAttention:60, _SeqAllToAll:44,
+single_all_to_all:15): sequence-sharded activations are all-to-all'd so each rank
+holds *all* sequence positions for a *subset of heads*, local attention runs over the
+full sequence, and the output is all-to-all'd back.
+
+TPU-native formulation: the two all-to-alls are sharding-constraint flips over the
+``seq`` mesh axis — [B, S@seq, H, D] → [B, S, H@seq, D] → attention →
+[B, S, H@seq, D] → [B, S@seq, H, D]. GSPMD lowers each flip to exactly one
+all-to-all on ICI (the optimal Ulysses communication pattern, SURVEY.md §5.7).
+"""
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils import groups
+
+
+def _constrain(t, spec_axes, mesh=None):
+    """Apply a per-dim PartitionSpec (tuple of axis-name-or-None); no-op when the
+    named axes are absent or degenerate. Shared by Ulysses and MoE dispatch."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    try:
+        mesh = mesh if mesh is not None else groups.get_mesh()
+    except Exception:
+        return t
+    used = [a for a in spec_axes if a is not None]
+    if not used or all(mesh.shape.get(a, 1) <= 1 for a in used):
+        return t
+    return jax.lax.with_sharding_constraint(t, NamedSharding(mesh, P(*spec_axes)))
+
+
+def seq_to_head_sharding(x, seq_axis_name=groups.SEQ_AXIS, seq_dim=1, head_dim=2):
+    """single_all_to_all (reference layer.py:15), scatter heads / gather sequence."""
+    spec = [None] * x.ndim
+    spec[head_dim] = seq_axis_name
+    return _constrain(x, spec)
+
+
+def head_to_seq_sharding(x, seq_axis_name=groups.SEQ_AXIS, seq_dim=1, head_dim=2):
+    spec = [None] * x.ndim
+    spec[seq_dim] = seq_axis_name
+    return _constrain(x, spec)
+
+
+class DistributedAttention:
+    """Reference DistributedAttention:60.
+
+    Args mirror the reference: ``local_attention`` is any callable
+    ``(q, k, v, *args, **kwargs) -> out`` operating on [B, S, H, D] tensors;
+    ``scatter_idx``/``gather_idx`` pick which dims flip sharding (defaults: heads=2
+    scattered, seq=1 gathered).
+    """
+
+    def __init__(self, local_attention: Callable, sequence_process_group=None, scatter_idx: int = 2,
+                 gather_idx: int = 1):
+        self.local_attn = local_attention
+        self.seq_axis = sequence_process_group or groups.SEQ_AXIS
+        self.scatter_idx = scatter_idx
+        self.gather_idx = gather_idx
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        # in: [B, S(sharded over seq axis), H, D]
+        q = seq_to_head_sharding(query, self.seq_axis, self.gather_idx, self.scatter_idx)
+        k = seq_to_head_sharding(key, self.seq_axis, self.gather_idx, self.scatter_idx)
+        v = seq_to_head_sharding(value, self.seq_axis, self.gather_idx, self.scatter_idx)
+        # local attention sees full sequence, heads partitioned
+        out = self.local_attn(q, k, v, *args, **kwargs)
+        # out: back to sequence sharding
+        return head_to_seq_sharding(out, self.seq_axis, self.gather_idx, self.scatter_idx)

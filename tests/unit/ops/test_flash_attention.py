@@ -1,0 +1,110 @@
+"""Flash attention kernel tests (reference: tests/unit/inference/v2/modules/
+test_blocked_attn.py compares against a flash reference; here Pallas vs jnp)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.llama import causal_attention
+from deepspeed_tpu.ops.pallas.flash_attention import _blockwise_attention_ref, flash_attention
+
+
+def _rand_qkv(B, S, H, D, kvh=None, seed=0, dtype=jnp.float32):
+    rng = jax.random.PRNGKey(seed)
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (B, S, H, D), dtype)
+    k = jax.random.normal(kk, (B, S, kvh or H, D), dtype)
+    v = jax.random.normal(kv, (B, S, kvh or H, D), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_matches_dense(causal):
+    B, S, H, D = 2, 256, 4, 32
+    q, k, v = _rand_qkv(B, S, H, D)
+    scale = 1.0 / np.sqrt(D)
+    out = flash_attention(q, k, v, scale, causal)
+    if causal:
+        ref = causal_attention(q, k, v, scale)
+    else:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        probs = jax.nn.softmax(logits, axis=-1)
+        ref = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_flash_gqa():
+    B, S, H, D = 1, 128, 8, 16
+    q, k, v = _rand_qkv(B, S, H, D, kvh=2)
+    out = flash_attention(q, k, v, 1.0 / np.sqrt(D), True)
+    ref = causal_attention(q, k, v, 1.0 / np.sqrt(D))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_flash_gradients_match_dense():
+    B, S, H, D = 1, 128, 2, 16
+    q, k, v = _rand_qkv(B, S, H, D)
+    scale = 1.0 / np.sqrt(D)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, scale, True)**2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(causal_attention(q, k, v, scale)**2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3)
+
+
+def test_flash_gqa_gradients():
+    B, S, H, D = 1, 128, 4, 16
+    q, k, v = _rand_qkv(B, S, H, D, kvh=2)
+    scale = 1.0 / np.sqrt(D)
+
+    gf = jax.grad(lambda q, k, v: jnp.sum(flash_attention(q, k, v, scale, True)**2),
+                  argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda q, k, v: jnp.sum(causal_attention(q, k, v, scale)**2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3)
+
+
+def test_flash_irregular_seq_lengths():
+    """S not divisible by default blocks: forward AND backward must still match
+    dense (regression: bwd used to drop the tail KV block at S=300)."""
+    for S in (300, 96, 192):
+        B, H, D = 1, 2, 16
+        q, k, v = _rand_qkv(B, S, H, D, seed=S)
+        scale = 1.0 / np.sqrt(D)
+        out = flash_attention(q, k, v, scale, True)
+        ref = causal_attention(q, k, v, scale)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+        gf = jax.grad(lambda q: jnp.sum(flash_attention(q, k, v, scale, True)**2))(q)
+        gd = jax.grad(lambda q: jnp.sum(causal_attention(q, k, v, scale)**2))(q)
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd), rtol=2e-3, atol=2e-3)
+
+
+def test_blockwise_ref_matches_dense():
+    B, S, H, D = 2, 128, 2, 16
+    q, k, v = _rand_qkv(B, S, H, D)
+    scale = 1.0 / np.sqrt(D)
+    out = _blockwise_attention_ref(q, k, v, scale, True, block_k=32)
+    ref = causal_attention(q, k, v, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_llama_flash_flag():
+    from deepspeed_tpu.models import llama
+    cfg = llama.LlamaConfig.tiny(use_flash_attention=True)
+    model, params = llama.init_params(cfg, batch_size=2, seq_len=128)
+    ids = jnp.zeros((2, 128), jnp.int32)
+    loss = model.apply({"params": params}, (ids, ids))
+    cfg2 = llama.LlamaConfig.tiny()
+    model2 = llama.LlamaForCausalLM(cfg2)
+    loss2 = model2.apply({"params": params}, (ids, ids))
+    np.testing.assert_allclose(float(loss), float(loss2), rtol=5e-3)

@@ -255,6 +255,22 @@ def test_checkpoint_reshard_across_stages(tmp_path):
         np.testing.assert_allclose(a, b)
 
 
+def test_sgd_with_param_specs_none_state():
+    """SGD momentum=0 has a None state slot; param_specs must not crash init
+    (regression: _broadcast_param_specs returned P() for None subtrees)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    groups.initialize_mesh(model_parallel_size=2, force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=8)
+    specs = jax.tree.map(lambda p: P(), params0)
+    cfg = _engine_config(stage=1, micro=1)
+    cfg["optimizer"] = {"type": "SGD", "params": {"lr": 0.1}}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                               config=cfg, param_specs=specs)
+    loss = engine.train_batch(batch=random_batches(1, 8, HIDDEN)[0])
+    assert np.isfinite(float(loss))
+
+
 def test_lr_scheduler_integration():
     groups.initialize_mesh(force=True)
     model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=8)

@@ -1,0 +1,63 @@
+"""Ulysses sequence-parallel tests (reference: deepspeed/sequence/layer.py has no
+dedicated unit test in-tree; this is the equivalence gate: distributed attention ==
+local attention)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.sequence.layer import DistributedAttention
+from deepspeed_tpu.utils import groups
+
+
+def _attn(q, k, v, scale=1.0):
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def test_distributed_attention_matches_local():
+    groups.initialize_mesh(sequence_parallel_size=4, force=True)
+    mesh = groups.get_mesh()
+    B, S, H, D = 2, 16, 8, 4
+    rng = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(r, (B, S, H, D)) for r in jax.random.split(rng, 3))
+
+    dist_attn = DistributedAttention(_attn)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    seq_sharded = NamedSharding(mesh, P(None, "seq", None, None))
+
+    @jax.jit
+    def f(q, k, v):
+        q = jax.lax.with_sharding_constraint(q, seq_sharded)
+        k = jax.lax.with_sharding_constraint(k, seq_sharded)
+        v = jax.lax.with_sharding_constraint(v, seq_sharded)
+        return dist_attn(q, k, v)
+
+    out = f(q, k, v)
+    ref = _attn(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+
+def test_distributed_attention_inserts_all_to_all():
+    groups.initialize_mesh(sequence_parallel_size=4, force=True)
+    mesh = groups.get_mesh()
+    B, S, H, D = 1, 8, 8, 4
+    q = jnp.ones((B, S, H, D))
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    seq_sharded = NamedSharding(mesh, P(None, "seq", None, None))
+    dist_attn = DistributedAttention(_attn)
+
+    def f(q, k, v):
+        q = jax.lax.with_sharding_constraint(q, seq_sharded)
+        k = jax.lax.with_sharding_constraint(k, seq_sharded)
+        v = jax.lax.with_sharding_constraint(v, seq_sharded)
+        return dist_attn(q, k, v)
+
+    compiled = jax.jit(f).lower(q, q, q).compile()
+    hlo = compiled.as_text()
+    assert "all-to-all" in hlo, "Ulysses sharding flip should lower to all-to-all"
