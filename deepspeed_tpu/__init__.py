@@ -35,6 +35,7 @@ def initialize(args=None,
                loss_fn=None,
                param_specs=None,
                rng_seed=0,
+               example_batch=None,
                config_params=None):
     """Initialize the DeepSpeed-TPU engine (reference deepspeed/__init__.py:64).
 
@@ -80,7 +81,8 @@ def initialize(args=None,
                         mesh=mesh,
                         loss_fn=loss_fn,
                         param_specs=param_specs,
-                        rng_seed=rng_seed)
+                        rng_seed=rng_seed,
+                        example_batch=example_batch)
 
     return_items = [engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler]
     return tuple(return_items)

@@ -1,0 +1,2 @@
+"""Re-export (reference: deepspeed/pipe/__init__.py)."""
+from deepspeed_tpu.runtime.pipe import LayerSpec, PipelineModule, TiedLayerSpec

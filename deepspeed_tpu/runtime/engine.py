@@ -94,6 +94,7 @@ class DeepSpeedEngine:
                  loss_fn=None,
                  param_specs=None,
                  rng_seed=0,
+                 example_batch=None,
                  dont_change_device=False):
         import jax
         import jax.numpy as jnp
@@ -112,6 +113,9 @@ class DeepSpeedEngine:
         self._global_grad_norm = None
         self.training = True
         self.data_iterator = None
+        # subclasses (PipelineEngine) override when their loss already averages
+        # microbatches; None = divide accumulated grads by GAS
+        self._apply_gas_divisor = getattr(self, "_apply_gas_divisor", None)
 
         # 1. distributed bootstrap (reference __init__.py:128 / comm.py:604)
         if dist_init_required is None or dist_init_required:
@@ -157,8 +161,14 @@ class DeepSpeedEngine:
         self._rng = jax.random.PRNGKey(rng_seed)
 
         # 7. parameters (master fp32, placed per policy)
+        if model_parameters is None and example_batch is not None and hasattr(model, "init"):
+            # materialize flax params from the example batch (pipeline engines do
+            # the same; shapes are static under XLA anyway)
+            self._rng, sub = jax.random.split(self._rng)
+            model_parameters = model.init(sub, example_batch)["params"]
         if model_parameters is None:
-            raise ValueError("model_parameters (the initial parameter pytree) is required")
+            raise ValueError("model_parameters (the initial parameter pytree) is required "
+                             "(or pass example_batch with a flax model to init in-engine)")
         params = cast_tree(model_parameters, self.master_dtype)
         self._param_shardings = self.zero_policy.param_shardings(params, self.param_specs)
         # jit-copy (not plain device_put): the step donates param buffers, and the
@@ -459,7 +469,8 @@ class DeepSpeedEngine:
         fp16 = self._fp16
         dynamic = self._dynamic_scale
         fp16_cfg = self._config.fp16_config
-        gas = float(self.gradient_accumulation_steps())
+        gas = self._apply_gas_divisor if self._apply_gas_divisor is not None \
+            else float(self.gradient_accumulation_steps())
 
         def fn(params, opt_state, acc_grads, scale_state, lr):
             inv = (1.0 / (scale_state.cur_scale * gas))

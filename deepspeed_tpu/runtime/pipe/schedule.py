@@ -1,0 +1,264 @@
+"""Pipeline schedules as instruction streams.
+
+Reference: ``deepspeed/runtime/pipe/schedule.py`` (PipeSchedule, InferenceSchedule:135,
+TrainSchedule:189 — 1F1B, DataParallelSchedule:301; PipeInstruction command objects).
+
+On TPU the *execution* of a schedule is a jitted scan with ppermute (XLA overlaps
+compute and stage transfers itself; see pipe/engine.py), but the instruction-stream
+generators are kept with reference semantics: they document and test the 1F1B
+ordering, and drive the host-level fallback executor.
+"""
+
+from abc import ABC, abstractmethod
+
+
+class PipeSchedule(ABC):
+    """Reference schedule.py PipeSchedule: yields lists of PipeInstruction per step."""
+
+    def __init__(self, micro_batches, stages, stage_id):
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = self.stage_id - 1
+        self.next_stage = self.stage_id + 1
+
+    @abstractmethod
+    def steps(self):
+        ...
+
+    def num_pipe_buffers(self):
+        return self.micro_batches
+
+    def _valid_micro_batch(self, micro_batch_id):
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id):
+        return 0 <= stage_id < self.stages
+
+    @property
+    def stage(self):
+        return self.stage_id
+
+    @property
+    def num_stages(self):
+        return self.stages
+
+    @property
+    def num_micro_batches(self):
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def _buffer_idx(self, micro_batch_id):
+        assert self._valid_micro_batch(micro_batch_id)
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def __iter__(self):
+        self.it = None
+        return self
+
+    def __next__(self):
+        if self.it is None:
+            self.it = self.steps()
+        return next(self.it)
+
+
+class InferenceSchedule(PipeSchedule):
+    """Reference schedule.py:135 — forward-only pipelining."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            cmds = []
+            micro_batch_id = step_id - self.stage_id
+
+            if self._valid_micro_batch(micro_batch_id):
+                if self.is_first_stage or self.is_last_stage:
+                    cmds.append(LoadMicroBatch(self._buffer_idx(micro_batch_id)))
+                if self._valid_stage(self.prev_stage):
+                    cmds.append(RecvActivation(self._buffer_idx(micro_batch_id)))
+
+            if self._valid_micro_batch(micro_batch_id):
+                cmds.append(ForwardPass(self._buffer_idx(micro_batch_id)))
+                if self._valid_stage(self.next_stage):
+                    cmds.append(SendActivation(self._buffer_idx(micro_batch_id)))
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return 2
+
+
+class TrainSchedule(PipeSchedule):
+    """Reference schedule.py:189 — 1F1B: each stage alternates forward/backward
+    once warm, bounding in-flight activations to the pipeline depth."""
+
+    def steps(self):
+        prev_micro_batch_id = -1
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        for step_id in range(total_steps):
+            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
+
+            cmds = []
+            # exchange activations/gradients
+            if self._valid_micro_batch(prev_micro_batch_id) and self._valid_stage(self.prev_stage):
+                if not is_forward:
+                    cmds.append(SendGrad(self._buffer_idx(prev_micro_batch_id)))
+            if self._valid_micro_batch(prev_micro_batch_id) and self._valid_stage(self.next_stage):
+                if is_forward:
+                    cmds.append(SendActivation(self._buffer_idx(prev_micro_batch_id)))
+            if self._valid_micro_batch(micro_batch_id) and self._valid_stage(self.prev_stage):
+                if is_forward:
+                    cmds.append(RecvActivation(self._buffer_idx(micro_batch_id)))
+            if self._valid_micro_batch(micro_batch_id) and self._valid_stage(self.next_stage):
+                if not is_forward:
+                    cmds.append(RecvGrad(self._buffer_idx(micro_batch_id)))
+
+            # computation
+            if self._valid_micro_batch(micro_batch_id):
+                if is_forward:
+                    if self.is_first_stage or self.is_last_stage:
+                        cmds.append(LoadMicroBatch(self._buffer_idx(micro_batch_id)))
+                    cmds.append(ForwardPass(self._buffer_idx(micro_batch_id)))
+                else:
+                    cmds.append(BackwardPass(self._buffer_idx(micro_batch_id)))
+
+            # model step at the end
+            if step_id == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+
+            prev_micro_batch_id = micro_batch_id
+            yield cmds
+
+    def num_pipe_buffers(self):
+        """Reference: bounded by in-flight microbatches = stages - stage_id."""
+        buffers = min(self.stages - self.stage_id, self.micro_batches)
+        return max(2, buffers)
+
+    def _step_to_micro_batch(self, step_id):
+        if _is_even(step_id) and _is_even(self.stage_id):
+            micro_batch_id = self._even_step_forward_id(step_id)
+            is_forward = True
+        elif _is_odd(step_id) and _is_odd(self.stage_id):
+            micro_batch_id = self._odd_step_forward_id(step_id)
+            is_forward = True
+        elif _is_even(step_id) and _is_odd(self.stage_id):
+            micro_batch_id = self._even_step_backward_id(step_id)
+            is_forward = False
+        elif _is_odd(step_id) and _is_even(self.stage_id):
+            micro_batch_id = self._odd_step_backward_id(step_id)
+            is_forward = False
+        else:
+            raise AssertionError()
+        return micro_batch_id, is_forward
+
+    def _even_step_forward_id(self, step_id):
+        base = step_id // 2
+        return int(base - self.stage_id // 2)
+
+    def _odd_step_forward_id(self, step_id):
+        base = (step_id - 1) // 2
+        return int(base - self.stage_id // 2)
+
+    def _even_step_backward_id(self, step_id):
+        base = step_id // 2
+        return int(base - self.stages + (self.stage_id + 1) // 2)
+
+    def _odd_step_backward_id(self, step_id):
+        base = ((step_id - 1) // 2) - self.stages + 1
+        return int(base + self.stage_id // 2)
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Reference schedule.py:301 — degenerate single-stage schedule."""
+
+    def steps(self):
+        for step_id in range(self.micro_batches):
+            cmds = [
+                LoadMicroBatch(buffer_id=0),
+                ForwardPass(buffer_id=0),
+                BackwardPass(buffer_id=0),
+            ]
+            if step_id == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return 1
+
+
+class PipeInstruction:
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for key, val in kwargs.items():
+            setattr(self, key, val)
+
+    def __repr__(self):
+        from deepspeed_tpu.runtime.utils import call_to_str
+        return call_to_str(self.name, **self.kwargs)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.kwargs == other.kwargs
+
+
+class OptimizerStep(PipeInstruction):
+    ...
+
+
+class ReduceGrads(PipeInstruction):
+    ...
+
+
+class ReduceTiedGrads(PipeInstruction):
+    ...
+
+
+class BufferOpInstruction(PipeInstruction):
+
+    def __init__(self, buffer_id, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    ...
+
+
+class ForwardPass(BufferOpInstruction):
+    ...
+
+
+class BackwardPass(BufferOpInstruction):
+    ...
+
+
+class SendActivation(BufferOpInstruction):
+    ...
+
+
+class RecvActivation(BufferOpInstruction):
+    ...
+
+
+class SendGrad(BufferOpInstruction):
+    ...
+
+
+class RecvGrad(BufferOpInstruction):
+    ...
+
+
+def _is_even(x):
+    return x % 2 == 0
+
+
+def _is_odd(x):
+    return x % 2 != 0

@@ -1,0 +1,77 @@
+"""Schedule instruction-stream tests (reference: tests/unit/runtime/pipe/
+test_pipe_schedule.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe import schedule as S
+
+
+def _flat(sched):
+    return [cmd for step in sched for cmd in step]
+
+
+def test_inference_schedule_counts():
+    sched = S.InferenceSchedule(micro_batches=4, stages=2, stage_id=0)
+    cmds = _flat(sched)
+    fwd = [c for c in cmds if isinstance(c, S.ForwardPass)]
+    assert len(fwd) == 4
+    sends = [c for c in cmds if isinstance(c, S.SendActivation)]
+    assert len(sends) == 4  # stage 0 sends every microbatch
+
+
+def test_train_schedule_each_mb_fwd_and_bwd_once():
+    for stages in (2, 4):
+        for stage_id in range(stages):
+            sched = S.TrainSchedule(micro_batches=8, stages=stages, stage_id=stage_id)
+            cmds = _flat(sched)
+            fwd = [c.buffer_id for c in cmds if isinstance(c, S.ForwardPass)]
+            bwd = [c.buffer_id for c in cmds if isinstance(c, S.BackwardPass)]
+            assert len(fwd) == 8, f"stage {stage_id}/{stages}"
+            assert len(bwd) == 8
+            # single optimizer step at the very end
+            steps = [c for c in cmds if isinstance(c, S.OptimizerStep)]
+            assert len(steps) == 1
+            assert isinstance(cmds[-1], S.OptimizerStep)
+
+
+def test_train_schedule_fwd_before_bwd():
+    sched = S.TrainSchedule(micro_batches=4, stages=2, stage_id=1)
+    seen_fwd = set()
+    for step in sched:
+        for cmd in step:
+            if isinstance(cmd, S.ForwardPass):
+                seen_fwd.add(cmd.buffer_id)
+            if isinstance(cmd, S.BackwardPass):
+                assert cmd.buffer_id in seen_fwd  # backward only after its forward
+
+
+def test_train_schedule_1f1b_inflight_bound():
+    """In-flight microbatches never exceed the remaining pipeline depth."""
+    stages, mb = 4, 16
+    for stage_id in range(stages):
+        sched = S.TrainSchedule(micro_batches=mb, stages=stages, stage_id=stage_id)
+        inflight = 0
+        peak = 0
+        for step in sched:
+            for cmd in step:
+                if isinstance(cmd, S.ForwardPass):
+                    inflight += 1
+                if isinstance(cmd, S.BackwardPass):
+                    inflight -= 1
+                peak = max(peak, inflight)
+        assert peak <= stages - stage_id + 1
+
+
+def test_num_pipe_buffers():
+    sched = S.TrainSchedule(micro_batches=8, stages=4, stage_id=0)
+    assert sched.num_pipe_buffers() == 4
+    sched = S.TrainSchedule(micro_batches=8, stages=4, stage_id=3)
+    assert sched.num_pipe_buffers() == 2
+
+
+def test_instruction_repr_and_eq():
+    a = S.ForwardPass(buffer_id=1)
+    b = S.ForwardPass(buffer_id=1)
+    c = S.ForwardPass(buffer_id=2)
+    assert a == b and a != c
+    assert "ForwardPass" in repr(a)

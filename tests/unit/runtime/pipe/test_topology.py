@@ -1,0 +1,73 @@
+"""Pure rank-math tests (reference: tests/unit/runtime/pipe/test_topology.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe.module import partition_balanced, partition_uniform
+from deepspeed_tpu.runtime.pipe.topology import (PipeDataParallelTopology, PipeModelDataParallelTopology,
+                                                 ProcessTopology)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+
+
+def test_topology_dims():
+    topo = ProcessTopology(axes=["a", "b", "c"], dims=[2, 3, 4])
+    assert topo.world_size() == 24
+    assert topo.get_dim("b") == 3
+    assert topo.get_dim("missing") == 0
+
+
+def test_topology_comm_lists():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    assert topo.get_axis_comm_lists("pipe") == [[0, 2], [1, 3]]
+    assert topo.get_axis_comm_lists("data") == [[0, 1], [2, 3]]
+    assert topo.get_axis_comm_lists("bogus") == []
+
+
+def test_topology_filter():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    assert topo.filter_match(pipe=0) == [0, 1]
+    assert topo.filter_match(pipe=1, data=0) == [2]
+
+
+def test_topology_coord():
+    topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 2])
+    c = topo.get_coord(2)
+    assert c.pipe == 1 and c.data == 0
+
+
+def test_topology_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=1)
+    assert topo.get_rank_repr(rank=0) == "model_00"
+    assert topo.get_rank_repr(rank=1) == "model_01"
+
+
+def test_3d_topology():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    # axes order: pipe, data, model
+    assert topo.get_rank(pipe=0, data=0, model=0) == 0
+    assert topo.get_rank(pipe=0, data=0, model=1) == 1
+    assert topo.get_rank(pipe=1, data=1, model=1) == 7
+
+
+def test_partition_uniform():
+    assert partition_uniform(8, 4) == [0, 2, 4, 6, 8]
+    assert partition_uniform(9, 4) == [0, 3, 5, 7, 9]
+    assert partition_uniform(3, 3) == [0, 1, 2, 3]
+
+
+def test_partition_balanced():
+    parts = partition_balanced([1, 1, 1, 1], 2)
+    assert parts[0] == 0 and parts[-1] == 4
+    # heavy first layer should sit alone
+    parts = partition_balanced([10, 1, 1, 1], 2)
+    assert parts == [0, 1, 4]
+    # monotone boundaries
+    parts = partition_balanced([3, 2, 2, 3, 1, 1], 3)
+    assert parts[0] == 0 and parts[-1] == 6
+    assert all(a <= b for a, b in zip(parts, parts[1:]))
