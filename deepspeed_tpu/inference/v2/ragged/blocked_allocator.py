@@ -1,0 +1,43 @@
+"""KV block allocator.
+
+Reference: ``deepspeed/inference/v2/ragged/blocked_allocator.py`` (BlockedAllocator:11
+— a free-list over torch tensors). Pure host logic; numpy-backed here.
+"""
+
+import numpy as np
+
+
+class BlockedAllocator:
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"Blocked allocator requires at least 1 block, got {num_blocks}")
+        self._num_blocks = num_blocks
+        # free-list as a linked list in an array: _next[i] = next free after i
+        self._next = np.arange(1, num_blocks + 1, dtype=np.int64)
+        self._head = 0
+        self._free_blocks = num_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return self._free_blocks
+
+    def allocate(self, num_blocks: int) -> np.ndarray:
+        if num_blocks > self._free_blocks:
+            raise ValueError(f"Allocator has {self._free_blocks} free blocks, but {num_blocks} were requested")
+        out = np.empty(num_blocks, dtype=np.int64)
+        for i in range(num_blocks):
+            out[i] = self._head
+            self._head = int(self._next[self._head])
+        self._free_blocks -= num_blocks
+        return out
+
+    def free(self, blocks) -> None:
+        blocks = np.atleast_1d(np.asarray(blocks, dtype=np.int64))
+        for b in blocks:
+            b = int(b)
+            if b < 0 or b >= self._num_blocks:
+                raise ValueError(f"Block {b} is out of range [0, {self._num_blocks})")
+            self._next[b] = self._head
+            self._head = b
+        self._free_blocks += len(blocks)

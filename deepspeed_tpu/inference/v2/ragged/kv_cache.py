@@ -1,0 +1,77 @@
+"""Blocked (paged) KV cache.
+
+Reference: ``deepspeed/inference/v2/ragged/kv_cache.py`` (BlockedKVCache:40 —
+reserve/free block ids, device cache tensors, offload/restore hooks).
+
+TPU layout: one cache array per allocation group of shape
+``[num_blocks, block_size, 2, num_layers, kv_heads, head_dim]`` — layer-major inside
+a block so a whole block per layer is a contiguous DMA; the KV write/read paths use
+scatter/gather on the leading block dim (XLA lowers to efficient dynamic-slice DMAs;
+a Pallas paged-attention kernel can consume the same layout).
+"""
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.inference.v2.ragged.manager_configs import AllocationMode, KVCacheConfig, MemoryConfig
+from deepspeed_tpu.utils.logging import logger
+
+
+def _dtype_size(name):
+    return {"bfloat16": 2, "float16": 2, "float32": 4, "int8": 1}[name]
+
+
+class BlockedKVCache:
+
+    def __init__(self, config: KVCacheConfig, memory_config: MemoryConfig, mp_group=None, offload: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        self._config = config
+        num_layers, kv_heads, head_dim = config.cache_shape
+        block_bytes = (config.block_size * 2 * num_layers * kv_heads * head_dim *
+                       _dtype_size(config.cache_dtype))
+        if memory_config.mode == AllocationMode.RESERVE:
+            num_blocks = max(1, int(memory_config.size // block_bytes))
+        else:
+            num_blocks = int(memory_config.size)
+        self._num_blocks = num_blocks
+        self._allocator = BlockedAllocator(num_blocks)
+
+        dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.float16, "float32": jnp.float32}[config.cache_dtype]
+        self._cache = jnp.zeros((num_blocks, config.block_size, 2, num_layers, kv_heads, head_dim), dtype)
+        logger.info(f"BlockedKVCache: {num_blocks} blocks x {config.block_size} tokens "
+                    f"({num_blocks * block_bytes / 1e9:.2f} GB)")
+
+    @property
+    def free_blocks(self) -> int:
+        return self._allocator.free_blocks
+
+    @property
+    def num_blocks(self) -> int:
+        return self._num_blocks
+
+    @property
+    def block_size(self) -> int:
+        return self._config.block_size
+
+    @property
+    def cache(self):
+        return self._cache
+
+    def set_cache(self, cache):
+        self._cache = cache
+
+    def reserve(self, num_blocks: int):
+        return self._allocator.allocate(num_blocks)
+
+    def free(self, blocks):
+        self._allocator.free(blocks)
+
+    def offload(self, blocks):
+        raise NotImplementedError("KV block host offload arrives with the AIO tier")
+
+    def restore(self, blocks):
+        raise NotImplementedError("KV block host restore arrives with the AIO tier")

@@ -1,0 +1,39 @@
+"""Ragged engine configs.
+
+Reference: ``deepspeed/inference/v2/ragged/manager_configs.py`` (KVCacheConfig,
+DSStateManagerConfig, AllocationMode).
+"""
+
+from enum import Enum
+from typing import Tuple
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class AllocationMode(Enum):
+    RESERVE = "reserve"
+    ALLOCATE = "allocate"
+
+
+class KVCacheConfig(DeepSpeedConfigModel):
+    block_size: int = 128
+    num_allocation_groups: int = Field(1, gt=0)
+    cache_shape: Tuple[int, int, int] = (0, 0, 0)  # (num_layers, num_heads, head_size)
+    cache_dtype: str = "bfloat16"
+    max_blocks_per_allocation_group: int = Field(0, ge=0)
+
+
+class MemoryConfig(DeepSpeedConfigModel):
+    mode: AllocationMode = AllocationMode.RESERVE
+    size: int = Field(int(1e9), gt=0)  # bytes reserved / blocks allocated
+
+
+class DSStateManagerConfig(DeepSpeedConfigModel):
+    max_tracked_sequences: int = Field(2048, gt=0)
+    max_ragged_batch_size: int = Field(768, gt=0)
+    max_ragged_sequence_count: int = Field(512, gt=0)
+    max_context: int = Field(8192, gt=0)
+    memory_config: MemoryConfig = MemoryConfig()
+    offload: bool = Field(False)
