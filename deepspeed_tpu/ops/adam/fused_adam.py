@@ -5,8 +5,7 @@ Reference: ``deepspeed/ops/adam/fused_adam.py:18`` (FusedAdam over
 moment/bias-correction/update chain compiles to one fused elementwise pass per
 parameter, executed in the sharded layout chosen by the ZeRO policy (each chip
 updates only its optimizer-state partition, exactly like the reference's partitioned
-optimizer.step). A Pallas multi-tensor variant lives in
-``deepspeed_tpu/ops/pallas/fused_adam.py`` for the flat-buffer path.
+optimizer.step).
 """
 
 from typing import NamedTuple

@@ -109,7 +109,6 @@ class DeepSpeedEngine:
         self.global_steps = 0
         self.global_samples = 0
         self.micro_steps = 0
-        self.skipped_steps = 0
         self._global_grad_norm = None
         self.training = True
         self.data_iterator = None
@@ -395,6 +394,30 @@ class DeepSpeedEngine:
         self._compiled["grad"] = jax.jit(fn, out_shardings=(None, self._grad_shardings))
         return self._compiled["grad"]
 
+    def _eval_fn(self):
+        """Loss-only deterministic pass for eval mode (no value_and_grad, rng=None).
+
+        Loss functions that *require* a key (use the rng unconditionally) get a
+        fixed key instead — still deterministic across calls, and no crash for
+        rng-taking loss fns written before eval mode existed."""
+        import jax
+
+        if "eval" not in self._compiled:
+            loss_fn = self.loss_fn
+            takes_rng = self._loss_fn_takes_rng
+            compute_dtype = self.compute_dtype
+
+            def make(rng_value):
+                def fn(params, batch):
+                    cp = cast_tree(params, compute_dtype)
+                    out = loss_fn(cp, batch, rng_value) if takes_rng else loss_fn(cp, batch)
+                    return out[0] if isinstance(out, tuple) else out
+                return jax.jit(fn)
+
+            self._compiled["eval"] = make(None)
+            self._compiled["eval_fallback"] = (lambda: make(jax.random.PRNGKey(0))) if takes_rng else None
+        return self._compiled["eval"]
+
     def _accum_fn(self):
         import jax
         if "accum" not in self._compiled:
@@ -497,9 +520,33 @@ class DeepSpeedEngine:
 
     # --------------------------------------------------------- train-step API --
     def forward(self, batch):
-        """Compute the loss (and cache grads for backward). Reference engine.py:1781."""
+        """Compute the loss (and cache grads for backward). Reference engine.py:1781.
+
+        In eval mode (``engine.eval()``) this is a plain deterministic inference
+        pass — no grads, no dropout/gating rngs — matching the reference's eval
+        forward."""
         self.timers(FORWARD_MICRO_TIMER).start()
         batch = self.shard_batch(batch)
+        if not self.training:
+            self._cached_grads = None  # eval invalidates any pending backward()
+            try:
+                loss = self._eval_fn()(self.params, batch)
+            except Exception:
+                # loss_fn may use its rng unconditionally: retry with a fixed key
+                # (still deterministic across calls). Swap the compiled fn only
+                # once the fallback actually succeeds, so unrelated errors (bad
+                # batch shapes etc.) don't silently commit the stochastic path.
+                fallback = self._compiled.get("eval_fallback")
+                if fallback is None:
+                    raise
+                fn = fallback()
+                loss = fn(self.params, batch)
+                logger.warning("eval(): loss_fn requires an rng; using a fixed key "
+                               "(deterministic, but stochastic layers stay active)")
+                self._compiled["eval"] = fn
+                self._compiled.pop("eval_fallback", None)
+            self.timers(FORWARD_MICRO_TIMER).stop()
+            return loss
         rng = self._next_rng()
         loss, grads = self._grad_fn()(self.params, batch, rng, self.scale_state.cur_scale)
         self._cached_grads = grads
@@ -537,14 +584,23 @@ class DeepSpeedEngine:
             self._overflow_count = self._overflow_count + overflow.astype(jnp.int32)
             self.global_steps += 1
             self.global_samples += self.train_batch_size()
-            if self.lr_scheduler is not None:
-                self.lr_scheduler.step(**(lr_kwargs or {}))
-                self._current_lr = self.lr_scheduler.get_last_lr()[0]
+            self._step_lr_scheduler(overflow, **(lr_kwargs or {}))
             if self.monitor is not None and self.monitor.enabled and self.global_steps % max(
                     1, self._config.steps_per_print) == 0:
                 self._write_monitor()
         self.micro_steps += 1
         self.timers(STEP_MICRO_TIMER).stop()
+
+    def _step_lr_scheduler(self, overflow, **lr_kwargs):
+        """Advance the LR schedule unless this step overflowed (reference
+        _take_model_step, engine.py:2100-2106: overflow-skipped steps must not
+        advance warmup/decay). The host read of the overflow flag — a device
+        sync — only happens under fp16; bf16 stays fully async."""
+        if self._fp16 and bool(overflow):
+            return  # skipped step: schedule frozen; count lives in _overflow_count
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step(**lr_kwargs)
+            self._current_lr = self.lr_scheduler.get_last_lr()[0]
 
     def train_batch(self, data_iter=None, batch=None):
         """Fused path: full global batch [gas*micro_global, ...] (or an iterator
@@ -570,9 +626,7 @@ class DeepSpeedEngine:
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
         self.micro_steps += gas
-        if self.lr_scheduler is not None:
-            self.lr_scheduler.step()
-            self._current_lr = self.lr_scheduler.get_last_lr()[0]
+        self._step_lr_scheduler(overflow)
         self.tput_timer.stop(global_step=True)
         if self.monitor is not None and self.monitor.enabled and self.global_steps % max(
                 1, self._config.steps_per_print) == 0:
@@ -593,6 +647,12 @@ class DeepSpeedEngine:
     @property
     def overflow(self):
         return bool(self._overflow_count > 0)
+
+    @property
+    def skipped_steps(self):
+        """Single source of truth: the on-device overflow counter (survives
+        checkpoint resume; reference exposes the same public attribute)."""
+        return int(self._overflow_count)
 
     def get_skipped_steps(self):
         return int(self._overflow_count)
@@ -635,13 +695,38 @@ class DeepSpeedEngine:
         # multi-host agreement is checked through the coordination service.
 
     def save_16bit_model(self, save_dir, save_filename="pytorch_model.bin", exclude_frozen_parameters=False):
-        """Reference engine.py:3479 _zero3_consolidated_16bit_state_dict."""
+        """Reference engine.py:3479 _zero3_consolidated_16bit_state_dict.
+
+        ZeRO-3-sharded params are not fully addressable on a multi-host mesh, so
+        consolidate by resharding to replicated first (jit with replicated
+        out_shardings = the allgather), then write from process 0 only."""
         import jax
-        os.makedirs(save_dir, exist_ok=True)
-        gathered = jax.device_get(cast_tree(self.params, self.compute_dtype))
-        np.savez(os.path.join(save_dir, save_filename + ".npz"),
-                 **{"/".join(map(str, k)): v
-                    for k, v in _flatten_dict(gathered).items()})
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        replicated = NamedSharding(self.mesh, P())
+        # Consolidate leaf-by-leaf so peak HBM is one parameter, not the whole
+        # model replicated per chip (the reference consolidates param-by-param
+        # to rank 0 for the same reason).
+        dtype = self.compute_dtype
+        gather_leaf = jax.jit(lambda x: x.astype(dtype),
+                              out_shardings=replicated)
+        writer = jax.process_index() == 0
+
+        def consolidate(x):
+            # every process participates in the allgather; only process 0 pulls
+            # the result into host RAM
+            g = gather_leaf(x)
+            if writer:
+                return jax.device_get(g)
+            g.block_until_ready()
+            return None
+
+        gathered = jax.tree.map(consolidate, self.params)
+        if writer:
+            os.makedirs(save_dir, exist_ok=True)
+            np.savez(os.path.join(save_dir, save_filename + ".npz"),
+                     **{"/".join(map(str, k)): v
+                        for k, v in _flatten_dict(gathered).items()})
         return True
 
 

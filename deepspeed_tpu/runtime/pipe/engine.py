@@ -201,13 +201,19 @@ class PipelineEngine(DeepSpeedEngine):
                                             [(i, i + 1) for i in range(P_stages - 1)])
                     t_in = jnp.clip(t, 0, M - 1)
                     x_t = jax.lax.dynamic_index_in_dim(x_mb, t_in, axis=0, keepdims=False)
-                    inp = jnp.where(stage == 0, embed(x_t), recv)
+                    # lax.cond on the per-shard stage id (valid under shard_map):
+                    # only stage 0 pays for the embedding, only the last stage pays
+                    # for the head + full-vocab loss — the module contract. Neither
+                    # branch contains collectives, so per-stage divergence is safe.
+                    inp = jax.lax.cond(stage == 0, lambda: embed(x_t), lambda: recv)
                     out = stage_fn(inp)
                     mb_idx = t - (P_stages - 1)
                     mb_safe = jnp.clip(mb_idx, 0, M - 1)
                     y_t = jax.lax.dynamic_index_in_dim(y_mb, mb_safe, axis=0, keepdims=False)
-                    l_t = head_loss(out, y_t).astype(jnp.float32)
                     valid = (stage == P_stages - 1) & (mb_idx >= 0)
+                    l_t = jax.lax.cond(valid,
+                                       lambda: head_loss(out, y_t).astype(jnp.float32),
+                                       lambda: jnp.float32(0.0))
                     losses = jnp.where(valid, losses.at[mb_safe].set(l_t), losses)
                     return (out, losses), None
 
@@ -246,9 +252,7 @@ class PipelineEngine(DeepSpeedEngine):
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
         self.micro_steps += self._micro_batches
-        if self.lr_scheduler is not None:
-            self.lr_scheduler.step()
-            self._current_lr = self.lr_scheduler.get_last_lr()[0]
+        self._step_lr_scheduler(overflow)
         return loss
 
     def eval_batch(self, data_iter=None, batch=None, compute_loss=True, reduce_output="avg"):

@@ -1,26 +1,57 @@
 """Pipeline schedules as instruction streams.
 
-Reference: ``deepspeed/runtime/pipe/schedule.py`` (PipeSchedule, InferenceSchedule:135,
-TrainSchedule:189 — 1F1B, DataParallelSchedule:301; PipeInstruction command objects).
+Capability parity with ``deepspeed/runtime/pipe/schedule.py`` (PipeSchedule,
+InferenceSchedule:135, TrainSchedule:189, DataParallelSchedule:301) but derived
+in-house from a single closed-form tick equation rather than the reference's four
+parity-cased index helpers.
 
-On TPU the *execution* of a schedule is a jitted scan with ppermute (XLA overlaps
-compute and stage transfers itself; see pipe/engine.py), but the instruction-stream
-generators are kept with reference semantics: they document and test the 1F1B
-ordering, and drive the host-level fallback executor.
+Derivation (non-interleaved 1F1B over P stages, M microbatches):
+
+The pipeline runs on a global clock of ``T = 2*(M + P - 1)`` ticks. Forward work
+for microbatch ``f`` enters stage 0 at tick ``2f`` and moves down one stage per
+tick, so stage ``s`` runs forward(f) at tick ``t = s + 2f``. Backward work for
+microbatch ``b`` leaves the last stage and climbs one stage per tick such that
+stage ``s`` runs backward(b) at tick ``t = 2*(b + P) - s - 1``. Solving both for
+the work at (t, s):
+
+    (t - s) even  ->  FORWARD  on  f = (t - s) // 2
+    (t - s) odd   ->  BACKWARD on  b = (t + s + 1) // 2 - P
+
+Each is executed only when the microbatch index lies in [0, M). Every stage
+alternates forward/backward ticks (1F1B steady state), warmup/drain fall out of
+the validity window automatically.
+
+Communication pairing (the invariant a step-synchronized executor needs): at any
+tick t, a send on stage s must pair with the neighbor's recv at the *same* t.
+  - Stage s forwards f at tick t; stage s+1 forwards f at t+1. The activation
+    computed at tick t therefore has to be shipped during tick t+1 — which is a
+    backward tick for s (and a forward tick for s+1). Hence on a BACKWARD tick,
+    stage s emits SendActivation(f_prev) where f_prev is its forward work of the
+    previous tick, while s+1 (on its forward tick) emits RecvActivation(f_prev).
+  - Symmetrically, stage s backwards b at tick t; stage s-1 needs that gradient
+    at t+1 (its backward tick), so on a FORWARD tick stage s emits
+    SendGrad(b_prev) and s-1 emits RecvGrad(b_prev) at the same tick.
+
+On TPU the hot-path *execution* of a schedule is a jitted scan with ppermute
+(XLA overlaps compute and stage transfers; see pipe/engine.py); these streams
+document/test the ordering and drive the host-level fallback executor.
 """
 
 from abc import ABC, abstractmethod
 
+FORWARD = "forward"
+BACKWARD = "backward"
+
 
 class PipeSchedule(ABC):
-    """Reference schedule.py PipeSchedule: yields lists of PipeInstruction per step."""
+    """Yields, per clock tick, the list of PipeInstructions for one stage."""
 
     def __init__(self, micro_batches, stages, stage_id):
         self.micro_batches = micro_batches
         self.stages = stages
         self.stage_id = stage_id
-        self.prev_stage = self.stage_id - 1
-        self.next_stage = self.stage_id + 1
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
 
     @abstractmethod
     def steps(self):
@@ -69,115 +100,93 @@ class PipeSchedule(ABC):
         return next(self.it)
 
 
-class InferenceSchedule(PipeSchedule):
-    """Reference schedule.py:135 — forward-only pipelining."""
+class TrainSchedule(PipeSchedule):
+    """1F1B instruction stream from the closed-form tick equation above."""
+
+    def work_at(self, tick):
+        """(direction, micro_batch_id) of this stage's compute slot at ``tick``.
+
+        The microbatch may be outside [0, M) — warmup/drain ticks — in which
+        case the slot is idle but the tick still has a well-defined direction.
+        """
+        if (tick - self.stage_id) % 2 == 0:
+            return FORWARD, (tick - self.stage_id) // 2
+        return BACKWARD, (tick + self.stage_id + 1) // 2 - self.stages
 
     def steps(self):
-        total_steps = self.micro_batches + self.stages - 1
-        for step_id in range(total_steps):
+        total_ticks = 2 * (self.micro_batches + self.stages - 1)
+        for tick in range(total_ticks):
+            direction, mb = self.work_at(tick)
             cmds = []
-            micro_batch_id = step_id - self.stage_id
 
-            if self._valid_micro_batch(micro_batch_id):
+            if direction == FORWARD:
+                # Ship the gradient produced on the previous (backward) tick
+                # upstream; the upstream stage recvs it on this same tick.
+                if tick > 0 and self._valid_stage(self.prev_stage):
+                    _, prev_b = self.work_at(tick - 1)
+                    if self._valid_micro_batch(prev_b):
+                        cmds.append(SendGrad(self._buffer_idx(prev_b)))
+                if self._valid_micro_batch(mb):
+                    if self._valid_stage(self.prev_stage):
+                        cmds.append(RecvActivation(self._buffer_idx(mb)))
+                    if self.is_first_stage or self.is_last_stage:
+                        cmds.append(LoadMicroBatch(self._buffer_idx(mb)))
+                    cmds.append(ForwardPass(self._buffer_idx(mb)))
+            else:
+                if self._valid_micro_batch(mb) and self._valid_stage(self.next_stage):
+                    cmds.append(RecvGrad(self._buffer_idx(mb)))
+                # Ship the activation produced on the previous (forward) tick
+                # downstream; the downstream stage recvs it on this same tick.
+                if tick > 0 and self._valid_stage(self.next_stage):
+                    _, prev_f = self.work_at(tick - 1)
+                    if self._valid_micro_batch(prev_f):
+                        cmds.append(SendActivation(self._buffer_idx(prev_f)))
+                if self._valid_micro_batch(mb):
+                    cmds.append(BackwardPass(self._buffer_idx(mb)))
+
+            if tick == total_ticks - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+
+            yield cmds
+
+    def num_pipe_buffers(self):
+        """In-flight activations at stage s peak at the number of forwards that
+        run before the first backward = min(P - s, M); floor of 2 so the
+        send/compute double-buffering never aliases."""
+        return max(2, min(self.stages - self.stage_id, self.micro_batches))
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only pipelining: microbatch f hits stage s at tick s + f.
+
+    The activation computed at tick t is sent at tick t+1, pairing with the
+    downstream stage's RecvActivation at that same tick (downstream forwards mb
+    at tick s+1+mb) — the same same-tick send/recv invariant as TrainSchedule.
+    """
+
+    def steps(self):
+        for tick in range(self.micro_batches + self.stages - 1):
+            mb = tick - self.stage_id
+            cmds = []
+            prev_mb = mb - 1
+            if self._valid_micro_batch(prev_mb) and self._valid_stage(self.next_stage):
+                cmds.append(SendActivation(self._buffer_idx(prev_mb)))
+            if self._valid_micro_batch(mb):
                 if self.is_first_stage or self.is_last_stage:
-                    cmds.append(LoadMicroBatch(self._buffer_idx(micro_batch_id)))
+                    cmds.append(LoadMicroBatch(self._buffer_idx(mb)))
                 if self._valid_stage(self.prev_stage):
-                    cmds.append(RecvActivation(self._buffer_idx(micro_batch_id)))
-
-            if self._valid_micro_batch(micro_batch_id):
-                cmds.append(ForwardPass(self._buffer_idx(micro_batch_id)))
-                if self._valid_stage(self.next_stage):
-                    cmds.append(SendActivation(self._buffer_idx(micro_batch_id)))
+                    cmds.append(RecvActivation(self._buffer_idx(mb)))
+                cmds.append(ForwardPass(self._buffer_idx(mb)))
             yield cmds
 
     def num_pipe_buffers(self):
         return 2
 
 
-class TrainSchedule(PipeSchedule):
-    """Reference schedule.py:189 — 1F1B: each stage alternates forward/backward
-    once warm, bounding in-flight activations to the pipeline depth."""
-
-    def steps(self):
-        prev_micro_batch_id = -1
-        total_steps = 2 * (self.micro_batches + self.stages - 1)
-        for step_id in range(total_steps):
-            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
-
-            cmds = []
-            # exchange activations/gradients
-            if self._valid_micro_batch(prev_micro_batch_id) and self._valid_stage(self.prev_stage):
-                if not is_forward:
-                    cmds.append(SendGrad(self._buffer_idx(prev_micro_batch_id)))
-            if self._valid_micro_batch(prev_micro_batch_id) and self._valid_stage(self.next_stage):
-                if is_forward:
-                    cmds.append(SendActivation(self._buffer_idx(prev_micro_batch_id)))
-            if self._valid_micro_batch(micro_batch_id) and self._valid_stage(self.prev_stage):
-                if is_forward:
-                    cmds.append(RecvActivation(self._buffer_idx(micro_batch_id)))
-            if self._valid_micro_batch(micro_batch_id) and self._valid_stage(self.next_stage):
-                if not is_forward:
-                    cmds.append(RecvGrad(self._buffer_idx(micro_batch_id)))
-
-            # computation
-            if self._valid_micro_batch(micro_batch_id):
-                if is_forward:
-                    if self.is_first_stage or self.is_last_stage:
-                        cmds.append(LoadMicroBatch(self._buffer_idx(micro_batch_id)))
-                    cmds.append(ForwardPass(self._buffer_idx(micro_batch_id)))
-                else:
-                    cmds.append(BackwardPass(self._buffer_idx(micro_batch_id)))
-
-            # model step at the end
-            if step_id == total_steps - 1:
-                cmds.append(ReduceTiedGrads())
-                cmds.append(ReduceGrads())
-                cmds.append(OptimizerStep())
-
-            prev_micro_batch_id = micro_batch_id
-            yield cmds
-
-    def num_pipe_buffers(self):
-        """Reference: bounded by in-flight microbatches = stages - stage_id."""
-        buffers = min(self.stages - self.stage_id, self.micro_batches)
-        return max(2, buffers)
-
-    def _step_to_micro_batch(self, step_id):
-        if _is_even(step_id) and _is_even(self.stage_id):
-            micro_batch_id = self._even_step_forward_id(step_id)
-            is_forward = True
-        elif _is_odd(step_id) and _is_odd(self.stage_id):
-            micro_batch_id = self._odd_step_forward_id(step_id)
-            is_forward = True
-        elif _is_even(step_id) and _is_odd(self.stage_id):
-            micro_batch_id = self._even_step_backward_id(step_id)
-            is_forward = False
-        elif _is_odd(step_id) and _is_even(self.stage_id):
-            micro_batch_id = self._odd_step_backward_id(step_id)
-            is_forward = False
-        else:
-            raise AssertionError()
-        return micro_batch_id, is_forward
-
-    def _even_step_forward_id(self, step_id):
-        base = step_id // 2
-        return int(base - self.stage_id // 2)
-
-    def _odd_step_forward_id(self, step_id):
-        base = (step_id - 1) // 2
-        return int(base - self.stage_id // 2)
-
-    def _even_step_backward_id(self, step_id):
-        base = step_id // 2
-        return int(base - self.stages + (self.stage_id + 1) // 2)
-
-    def _odd_step_backward_id(self, step_id):
-        base = ((step_id - 1) // 2) - self.stages + 1
-        return int(base + self.stage_id // 2)
-
-
 class DataParallelSchedule(PipeSchedule):
-    """Reference schedule.py:301 — degenerate single-stage schedule."""
+    """Degenerate single-stage schedule (pure gradient accumulation)."""
 
     def steps(self):
         for step_id in range(self.micro_batches):
@@ -254,11 +263,3 @@ class SendGrad(BufferOpInstruction):
 
 class RecvGrad(BufferOpInstruction):
     ...
-
-
-def _is_even(x):
-    return x % 2 == 0
-
-
-def _is_odd(x):
-    return x % 2 != 0

@@ -75,3 +75,83 @@ def test_instruction_repr_and_eq():
     c = S.ForwardPass(buffer_id=2)
     assert a == b and a != c
     assert "ForwardPass" in repr(a)
+
+
+@pytest.mark.parametrize("stages,mb", [(2, 4), (4, 4), (4, 8), (8, 3)])
+def test_train_schedule_cross_stage_pairing(stages, mb):
+    """Every send at tick t pairs with the neighbor stage's recv at the SAME t
+    for the SAME microbatch — required by a step-synchronized executor.
+
+    Buffer ids are stage-local (num_pipe_buffers differs per stage), so pairing
+    is checked on microbatch ids recovered from the work_at tick equation:
+    SendActivation at tick t carries the sender's forward work of tick t-1;
+    the receiver's RecvActivation at tick t targets its own current forward mb.
+    SendGrad symmetrically carries the backward work of tick t-1.
+    """
+    scheds = [S.TrainSchedule(micro_batches=mb, stages=stages, stage_id=s)
+              for s in range(stages)]
+    streams = [list(s) for s in scheds]
+    n_ticks = len(streams[0])
+    assert all(len(st) == n_ticks for st in streams)
+
+    for t in range(n_ticks):
+        for s in range(stages):
+            for cmd in streams[s][t]:
+                if isinstance(cmd, S.SendActivation):
+                    _, sent_mb = scheds[s].work_at(t - 1)
+                    recvs = [c for c in streams[s + 1][t] if isinstance(c, S.RecvActivation)]
+                    assert len(recvs) == 1, f"tick {t}: stage {s} SendActivation unpaired"
+                    _, recv_mb = scheds[s + 1].work_at(t)
+                    assert recv_mb == sent_mb, f"tick {t}: act mb {sent_mb} vs {recv_mb}"
+                if isinstance(cmd, S.SendGrad):
+                    _, sent_mb = scheds[s].work_at(t - 1)
+                    recvs = [c for c in streams[s - 1][t] if isinstance(c, S.RecvGrad)]
+                    assert len(recvs) == 1, f"tick {t}: stage {s} SendGrad unpaired"
+                    _, recv_mb = scheds[s - 1].work_at(t)
+                    assert recv_mb == sent_mb, f"tick {t}: grad mb {sent_mb} vs {recv_mb}"
+    # conversely: every recv is fed by exactly one send at the same tick
+    for s in range(stages):
+        for t in range(n_ticks):
+            for cmd in streams[s][t]:
+                if isinstance(cmd, S.RecvActivation):
+                    assert sum(isinstance(c, S.SendActivation) for c in streams[s - 1][t]) == 1
+                if isinstance(cmd, S.RecvGrad):
+                    assert sum(isinstance(c, S.SendGrad) for c in streams[s + 1][t]) == 1
+    # and globally: each of the mb microbatches crosses each boundary exactly once
+    for s in range(1, stages):
+        n_recv = sum(isinstance(c, S.RecvActivation) for st in streams[s] for c in st)
+        assert n_recv == mb
+
+
+@pytest.mark.parametrize("stages,mb", [(2, 4), (4, 6)])
+def test_train_schedule_work_equation(stages, mb):
+    """The closed-form work_at equation: forwards arrive in order, one tick
+    later per stage; backwards climb one tick per stage."""
+    for s in range(stages):
+        sched = S.TrainSchedule(micro_batches=mb, stages=stages, stage_id=s)
+        fwd_ticks = {}
+        bwd_ticks = {}
+        for t in range(2 * (mb + stages - 1)):
+            d, m = sched.work_at(t)
+            if 0 <= m < mb:
+                (fwd_ticks if d == S.FORWARD else bwd_ticks)[m] = t
+        assert fwd_ticks[0] == s
+        assert all(fwd_ticks[m + 1] - fwd_ticks[m] == 2 for m in range(mb - 1))
+        assert bwd_ticks[0] == 2 * stages - s - 1
+
+
+@pytest.mark.parametrize("stages,mb", [(2, 4), (4, 3)])
+def test_inference_schedule_cross_stage_pairing(stages, mb):
+    """Same same-tick send/recv invariant as TrainSchedule, forward-only."""
+    streams = [list(S.InferenceSchedule(micro_batches=mb, stages=stages, stage_id=s))
+               for s in range(stages)]
+    n_ticks = len(streams[0])
+    for t in range(n_ticks):
+        for s in range(stages):
+            n_send = sum(isinstance(c, S.SendActivation) for c in streams[s][t])
+            if s + 1 < stages:
+                n_recv = sum(isinstance(c, S.RecvActivation) for c in streams[s + 1][t])
+                assert n_send == n_recv, f"tick {t}, boundary {s}->{s+1}"
+    for s in range(1, stages):
+        assert sum(isinstance(c, S.RecvActivation) for st in streams[s] for c in st) == mb
+        assert sum(isinstance(c, S.SendActivation) for st in streams[s - 1] for c in st) == mb

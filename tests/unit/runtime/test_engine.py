@@ -286,3 +286,51 @@ def test_lr_scheduler_integration():
         engine.train_batch(batch=b)
         lrs.append(engine.get_lr()[0])
     assert lrs[-1] == pytest.approx(0.1)
+
+
+def test_fp16_overflow_does_not_advance_lr_schedule():
+    """Reference _take_model_step (engine.py:2100-2106): overflow-skipped steps
+    leave warmup/decay schedules untouched."""
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=8)
+    engine, _, _, sched = deepspeed_tpu.initialize(
+        model=model, model_parameters=params0,
+        config=_engine_config(stage=0, micro=1, extra={
+            "fp16": {"enabled": True, "initial_scale_power": 4, "hysteresis": 1},
+            "scheduler": {"type": "WarmupLR",
+                          "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 0.01,
+                                     "warmup_num_steps": 10}},
+        }))
+    it0 = sched.last_batch_iteration
+
+    x = np.full((8, HIDDEN), 1e30, dtype=np.float32)  # overflow in fp16 compute
+    y = np.ones((8, ), dtype=np.float32)
+    engine.backward(engine.forward((x, y)))
+    engine.step()
+    assert engine.get_skipped_steps() == 1
+    assert sched.last_batch_iteration == it0  # schedule frozen on skipped step
+
+    bx = np.random.default_rng(0).normal(size=(8, HIDDEN)).astype(np.float32)
+    engine.backward(engine.forward((bx, y)))
+    engine.step()
+    assert sched.last_batch_iteration == it0 + 1  # healthy step advances
+
+
+def test_eval_forward_deterministic_no_grads():
+    """ADVICE: eval() forward is a plain loss pass — no cached grads, deterministic."""
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=8)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params0, config=_engine_config(stage=0, micro=1))
+    bx = np.random.default_rng(0).normal(size=(8, HIDDEN)).astype(np.float32)
+    y = np.ones((8, ), dtype=np.float32)
+    engine.eval()
+    l1 = float(engine.forward((bx, y)))
+    l2 = float(engine.forward((bx, y)))
+    assert l1 == l2
+    assert engine._cached_grads is None
+    engine.train()
+    l3 = engine.forward((bx, y))
+    assert engine._cached_grads is not None
+    engine.backward(l3)
+    engine.step()
