@@ -1,8 +1,24 @@
 """Benchmark harness (driver contract: print ONE JSON line).
 
-Measures single-chip Llama training-step throughput (tokens/sec) and derives MFU
-against the chip's bf16 peak. ``vs_baseline`` = MFU / 0.45 — the BASELINE.json
-north-star is ZeRO-3 Llama SFT at >=45% MFU, so 1.0 means parity with the target.
+Measures single-chip Llama training-step throughput (tokens/sec) and MFU against
+the chip's bf16 peak. ``vs_baseline`` = MFU / 0.45 — the BASELINE.json north-star
+is ZeRO-3 Llama SFT at >=45% MFU, so 1.0 means parity with the target.
+
+Config (chosen by sweep on a real v5e chip, 2026-07):
+- 530M-param Llama (hidden 2048, 8 layers, heads 16/128) — the largest
+  Llama-class model that fits one 16 GB chip with fp32 master + Adam moments
+  (ZeRO-3 semantics; on one chip the sharding is trivial but the config matches
+  BASELINE.md milestone #2/#3 shape).
+- seq 1024, micro-batch 8, GAS 8: gradient accumulation amortizes the
+  optimizer/master-weight HBM traffic (~25 GB/step) over 8 micro-steps — the
+  same reason the reference overlaps its optimizer with comm.
+- remat with the dots-saveable policy (recompute elementwise only); plain XLA
+  attention — measured faster than the Pallas flash path at S<=2048 (flash wins
+  at long sequence where the S^2 buffers stop fitting; see
+  ops/pallas/flash_attention.py).
+
+FLOPs model: 6*(N - N_embed) dense (fwd+bwd) + 12*L*S*H attention per token
+(PaLM-appendix MFU convention, causal not discounted; embedding lookup excluded).
 """
 
 import json
@@ -38,36 +54,43 @@ def main():
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
-        B, S = 8, 1024
-        cfg = llama.LlamaConfig(vocab_size=32000, hidden_size=768, intermediate_size=2048,
-                                num_hidden_layers=12, num_attention_heads=12, num_key_value_heads=12,
-                                max_position_embeddings=S, remat=False, dtype=jnp.bfloat16)
-        steps, warmup = 20, 3
+        B, S, GAS, STAGE = 8, 1024, 8, 3
+        cfg = llama.LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5376,
+                                num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=16,
+                                max_position_embeddings=S, remat=True, remat_policy="dots",
+                                dtype=jnp.bfloat16, use_flash_attention=False)
+        steps, warmup = 12, 3
     else:  # smoke-test shape for CPU runs
-        B, S = 2, 128
+        B, S, GAS, STAGE = 2, 128, 1, 3
         cfg = llama.LlamaConfig.tiny()
         steps, warmup = 8, 1
 
     model, params = llama.init_params(cfg, batch_size=B, seq_len=S)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    n_embed = cfg.vocab_size * cfg.hidden_size  # embed_tokens (lm_head stays: it's a matmul)
 
     groups.initialize_mesh(force=True)
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model, model_parameters=params,
         config={
             "train_micro_batch_size_per_gpu": B,
+            "gradient_accumulation_steps": GAS,
             "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
-            "zero_optimization": {"stage": 0},
+            "zero_optimization": {"stage": STAGE},
             "bf16": {"enabled": True},
         })
 
+    # Pre-generate host batches (the input pipeline must not sit inside the
+    # measured loop; train_batch's device_put overlaps the previous step's
+    # compute because dispatch is async).
     rng = np.random.default_rng(0)
-    def make_batch():
-        ids = rng.integers(0, cfg.vocab_size, size=(B, S + 1), dtype=np.int64)
-        return ids[:, :-1].astype(np.int32), ids[:, 1:].astype(np.int32)
+    batches = []
+    for _ in range(8):
+        ids = rng.integers(0, cfg.vocab_size, size=(B * GAS, S + 1), dtype=np.int64)
+        batches.append((ids[:, :-1].astype(np.int32), ids[:, 1:].astype(np.int32)))
 
-    for _ in range(warmup):
-        float(engine.train_batch(batch=make_batch()))  # host fetch = true barrier
+    for i in range(warmup):
+        float(engine.train_batch(batch=batches[i % len(batches)]))  # host fetch = true barrier
 
     # Two-point measurement: total(N) = N*step + RTT. The steps chain through the
     # donated params, so ONE final scalar fetch forces the whole chain; differencing
@@ -75,8 +98,8 @@ def main():
     def run(n):
         t0 = time.perf_counter()
         loss = None
-        for _ in range(n):
-            loss = engine.train_batch(batch=make_batch())
+        for i in range(n):
+            loss = engine.train_batch(batch=batches[i % len(batches)])
         float(loss)
         return time.perf_counter() - t0, loss
 
@@ -86,8 +109,9 @@ def main():
     step_time = (t2 - t1) / (steps - n1)
     if step_time <= 0:  # timing noise (fast local backends) — fall back to plain avg
         step_time = t2 / steps
-    tokens_per_sec = B * S / step_time
-    flops_per_token = 6.0 * n_params  # fwd+bwd dense-transformer estimate
+    tokens_per_sec = B * GAS * S / step_time
+    flops_per_token = 6.0 * (n_params - n_embed) \
+        + 12.0 * cfg.num_hidden_layers * S * cfg.hidden_size
     mfu = tokens_per_sec * flops_per_token / _peak_flops()
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -98,7 +122,9 @@ def main():
             "mfu": round(mfu, 4),
             "n_params": n_params,
             "batch": B,
+            "gas": GAS,
             "seq": S,
+            "zero_stage": STAGE,
             "backend": jax.default_backend(),
             "device": str(jax.devices()[0]),
             "loss_final": float(loss),

@@ -34,6 +34,9 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = True
+    # "nothing": recompute everything (min memory); "dots": save matmul outputs,
+    # recompute elementwise only (cheap recompute — the usual transformer policy)
+    remat_policy: str = "nothing"
     sequence_parallel: bool = False
     use_flash_attention: bool = False
 
@@ -162,7 +165,10 @@ class LlamaModel(nn.Module):
         if cfg.remat:
             # activation recomputation: keep only block boundaries
             # (reference activation_checkpointing/checkpointing.py role)
-            block = nn.remat(LlamaBlock, policy=jax.checkpoint_policies.nothing_saveable)
+            assert cfg.remat_policy in ("nothing", "dots"), cfg.remat_policy
+            policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                      if cfg.remat_policy == "dots" else jax.checkpoint_policies.nothing_saveable)
+            block = nn.remat(LlamaBlock, policy=policy)
         for i in range(cfg.num_hidden_layers):
             x = block(cfg, name=f"layers_{i}")(x, cos, sin)
 

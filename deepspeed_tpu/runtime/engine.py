@@ -402,7 +402,7 @@ class DeepSpeedEngine:
         rng-taking loss fns written before eval mode existed."""
         import jax
 
-        if "eval" not in self._compiled:
+        if "eval_loss" not in self._compiled:
             loss_fn = self.loss_fn
             takes_rng = self._loss_fn_takes_rng
             compute_dtype = self.compute_dtype
@@ -414,9 +414,9 @@ class DeepSpeedEngine:
                     return out[0] if isinstance(out, tuple) else out
                 return jax.jit(fn)
 
-            self._compiled["eval"] = make(None)
+            self._compiled["eval_loss"] = make(None)
             self._compiled["eval_fallback"] = (lambda: make(jax.random.PRNGKey(0))) if takes_rng else None
-        return self._compiled["eval"]
+        return self._compiled["eval_loss"]
 
     def _accum_fn(self):
         import jax
@@ -543,7 +543,7 @@ class DeepSpeedEngine:
                 loss = fn(self.params, batch)
                 logger.warning("eval(): loss_fn requires an rng; using a fixed key "
                                "(deterministic, but stochastic layers stay active)")
-                self._compiled["eval"] = fn
+                self._compiled["eval_loss"] = fn
                 self._compiled.pop("eval_fallback", None)
             self.timers(FORWARD_MICRO_TIMER).stop()
             return loss
