@@ -1,0 +1,111 @@
+"""Llama ragged inference model.
+
+Reference: ``deepspeed/inference/v2/model_implementations/llama_v2/model.py``
+(LlamaV2InferenceModel — per-layer qkv → blocked-kv rotary → blocked flash attn →
+gated MLP over the ragged batch).
+
+Consumes the TRAINING param tree of :class:`deepspeed_tpu.models.llama.LlamaModel`
+verbatim (``{"model": {embed_tokens, layers_i{self_attn,mlp,*layernorm}, norm},
+lm_head}``) so inference logits are testable bit-for-bit against the training
+forward — the reference needs a LayerContainer mapping step instead
+(``layer_container_base.py:164``); a functional pytree makes it a no-op.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.model_implementations.transformer_base import DSTransformerModelBase
+from deepspeed_tpu.inference.v2.tracer import record
+from deepspeed_tpu.models.llama import LlamaConfig, rotary_embedding
+
+
+def _rms(x, w, eps):
+    x32 = x.astype(jnp.float32)
+    normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (normed * w).astype(x.dtype)
+
+
+def _rotary_at(x, pos, cos_tab, sin_tab):
+    """x: [T, H, D] with per-token absolute positions [T]."""
+    cos = cos_tab[pos][:, None, :]  # [T, 1, D/2]
+    sin = sin_tab[pos][:, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+class LlamaV2Model(DSTransformerModelBase):
+
+    def __init__(self, params, config: LlamaConfig, engine_config, state_manager=None):
+        super().__init__(params, config, engine_config, state_manager)
+        D = config.hidden_size // config.num_attention_heads
+        self._cos, self._sin = rotary_embedding(engine_config.state_manager.max_context, D,
+                                                config.rope_theta, jnp.float32)
+
+    @property
+    def num_layers(self):
+        return self._config.num_hidden_layers
+
+    @property
+    def num_heads(self):
+        return self._config.num_attention_heads
+
+    @property
+    def num_kv_heads(self):
+        return self._config.num_key_value_heads
+
+    @property
+    def head_dim(self):
+        return self._config.hidden_size // self._config.num_attention_heads
+
+    @property
+    def vocab_size(self):
+        return self._config.vocab_size
+
+    # --------------------------------------------------------------- phases --
+    def embed(self, params, ids):
+        emb = params["model"]["embed_tokens"]["embedding"]
+        return emb[ids].astype(self._config.dtype)
+
+    def unembed(self, params, x):
+        x = _rms(x, params["model"]["norm"]["weight"], self._config.rms_norm_eps)
+        return x @ params["lm_head"]["kernel"].astype(x.dtype)
+
+    def _attn_phase(self, params, li, x, cache, attn_fn, batch):
+        cfg = self._config
+        lp = params["model"][f"layers_{li}"]
+        H, KVH, D = self.num_heads, self.num_kv_heads, self.head_dim
+        h = _rms(x, lp["input_layernorm"]["weight"], cfg.rms_norm_eps)
+        ap = lp["self_attn"]
+        q = (h @ ap["q_proj"]["kernel"].astype(h.dtype)).reshape(-1, H, D)
+        k = (h @ ap["k_proj"]["kernel"].astype(h.dtype)).reshape(-1, KVH, D)
+        v = (h @ ap["v_proj"]["kernel"].astype(h.dtype)).reshape(-1, KVH, D)
+        pos = batch["token_pos"]
+        q = _rotary_at(q, pos, self._cos, self._sin)
+        k = _rotary_at(k, pos, self._cos, self._sin)
+        out, cache = attn_fn(q, k, v, cache, li)
+        out = out.reshape(x.shape[0], H * D)
+        return x + out @ ap["o_proj"]["kernel"].astype(h.dtype), cache
+
+    def _ffn_phase(self, params, li, x):
+        cfg = self._config
+        lp = params["model"][f"layers_{li}"]
+        h = _rms(x, lp["post_attention_layernorm"]["weight"], cfg.rms_norm_eps)
+        mp = lp["mlp"]
+        gate = h @ mp["gate_proj"]["kernel"].astype(h.dtype)
+        up = h @ mp["up_proj"]["kernel"].astype(h.dtype)
+        return x + (jax.nn.silu(gate) * up) @ mp["down_proj"]["kernel"].astype(h.dtype)
+
+    def layer_forward(self, params, li, x, cache, attn_fn, batch):
+        x, cache = self._attn_phase(params, li, x, cache, attn_fn, batch)
+        return self._ffn_phase(params, li, x), cache
+
+    def layer_forward_traced(self, params, li, x, cache, attn_fn, batch):
+        with record("attn"):
+            x, cache = self._attn_phase(params, li, x, cache, attn_fn, batch)
+            x.block_until_ready()
+        with record("ffn"):
+            x = self._ffn_phase(params, li, x)
+            x.block_until_ready()
+        return x, cache

@@ -1,0 +1,62 @@
+"""Mixtral ragged inference model with expert parallelism (fork feature).
+
+Reference: ``deepspeed/inference/v2/model_implementations/mixtral/`` + the fork's
+``DSMultiGemmMoEEp`` MoE path (``cutlass_multi_gemm_ep.py:32``).
+
+Consumes the TRAINING param tree of :class:`deepspeed_tpu.models.mixtral.
+MixtralForCausalLM` (``layers_i.block_sparse_moe.{gate, ExpertFFN_0.{wi,wo}}``),
+so EP inference logits can be tested against the single-device training forward.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.model_implementations.llama_v2 import LlamaV2Model, _rms
+from deepspeed_tpu.inference.v2.modules.moe import RaggedMoE
+from deepspeed_tpu.inference.v2.tracer import record
+from deepspeed_tpu.models.mixtral import MixtralConfig
+
+
+class MixtralV2Model(LlamaV2Model):
+
+    def __init__(self, params, config: MixtralConfig, engine_config, state_manager=None):
+        super().__init__(params, config.as_llama(), engine_config, state_manager)
+        self._moe_config = config
+        ep_cfg = getattr(engine_config, "expert_parallel", None)
+        self._moes = [
+            RaggedMoE(num_experts=config.num_local_experts,
+                      top_k=config.num_experts_per_tok,
+                      capacity_factor=(ep_cfg.capacity_factor if ep_cfg is not None else 2.0),
+                      layer_id=li) for li in range(config.num_hidden_layers)
+        ]
+
+    @property
+    def num_layers(self):
+        return self._moe_config.num_hidden_layers
+
+    def _moe_params(self, params, li):
+        mp = params["model"][f"layers_{li}"]["block_sparse_moe"]
+        return mp["gate"], mp["ExpertFFN_0"]["wi"], mp["ExpertFFN_0"]["wo"]
+
+    def _ffn_phase(self, params, li, x, batch=None):
+        cfg = self._moe_config
+        lp = params["model"][f"layers_{li}"]
+        h = _rms(x, lp["post_attention_layernorm"]["weight"], cfg.rms_norm_eps)
+        gate_w, wi, wo = self._moe_params(params, li)
+        token_valid = None if batch is None else batch["token_valid"]
+        out = self._moes[li](h, gate_w, wi, wo, token_valid=token_valid,
+                             activation=jax.nn.silu)
+        return x + out.astype(x.dtype)
+
+    def layer_forward(self, params, li, x, cache, attn_fn, batch):
+        x, cache = self._attn_phase(params, li, x, cache, attn_fn, batch)
+        return self._ffn_phase(params, li, x, batch=batch), cache
+
+    def layer_forward_traced(self, params, li, x, cache, attn_fn, batch):
+        with record("attn"):
+            x, cache = self._attn_phase(params, li, x, cache, attn_fn, batch)
+            x.block_until_ready()
+        with record("moe_ffn"):
+            x = self._ffn_phase(params, li, x, batch=batch)
+            x.block_until_ready()
+        return x, cache

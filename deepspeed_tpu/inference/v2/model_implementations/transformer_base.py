@@ -1,0 +1,273 @@
+"""Base ragged transformer model implementation.
+
+Reference: ``deepspeed/inference/v2/model_implementations/inference_transformer_base.py``
+(DSTransformerModelBase:49 — attn/mlp/moe module composition, KV cache config and
+sizing, ``get_kv_requirements``/``maybe_allocate_kv``/``kv_cache_config``) and
+``inference_policy_base.py:104``.
+
+TPU execution model: ``forward(ragged_batch)`` runs ONE jitted program per batch
+*bucket* (padded token/sequence/block counts — see ragged_wrapper.py). The program
+consumes the paged KV cache array functionally (donated in, returned out) and the
+padded metadata arrays; scatter updates into the cache use XLA drop-mode so padding
+never corrupts live blocks. Per-layer compute is supplied by subclasses via
+``layer_forward``; embed/unembed live here, as does the logits gather (only each
+sequence's final token is unembedded — reference ``logits_gather.cu`` semantics).
+"""
+
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.inference.v2.ragged.manager_configs import KVCacheConfig
+from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import DSSequenceDescriptor
+from deepspeed_tpu.inference.v2.tracer import get_tracer, record
+
+
+class DSTransformerModelBase:
+    """Subclasses define: num_layers, num_kv_heads, head_dim, vocab_size,
+    ``embed(params, ids)``, ``layer_forward(params, li, x, attn_fn, batch)`` and
+    ``unembed(params, x)``."""
+
+    def __init__(self, params, config, engine_config, state_manager=None):
+        self._params = params
+        self._config = config
+        self._engine_config = engine_config
+        self._state_manager = None
+        self._compiled = {}
+        if state_manager is not None:
+            self.set_state_manager(state_manager)
+
+    # ------------------------------------------------------------ properties --
+    @property
+    def config(self):
+        return self._config
+
+    @property
+    def num_layers(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def num_kv_heads(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def num_heads(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def head_dim(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def vocab_size(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def max_context(self) -> int:
+        return self._engine_config.state_manager.max_context
+
+    # ------------------------------------------------------------- kv sizing --
+    def kv_cache_config(self) -> KVCacheConfig:
+        sm = self._engine_config.state_manager
+        return KVCacheConfig(block_size=self._engine_config.kv_block_size,
+                             cache_shape=(self.num_layers, self.num_kv_heads, self.head_dim),
+                             cache_dtype="bfloat16",
+                             max_blocks_per_allocation_group=(sm.max_context + self._engine_config.kv_block_size - 1)
+                             // self._engine_config.kv_block_size)
+
+    def set_state_manager(self, state_manager):
+        self._state_manager = state_manager
+
+    @property
+    def state_manager(self):
+        return self._state_manager
+
+    def get_kv_requirements(self, seq_desc: DSSequenceDescriptor, max_new_tokens: int,
+                            max_new_blocks: int) -> Tuple[int, int]:
+        """How many of ``max_new_tokens`` can run given ``max_new_blocks`` free
+        blocks, and how many blocks that takes (reference
+        inference_transformer_base.py get_kv_requirements)."""
+        bs = self._state_manager.kv_block_size
+        total = seq_desc.seen_tokens + max_new_tokens
+        blocks_needed = (total + bs - 1) // bs - seq_desc.cur_allocated_blocks
+        if blocks_needed <= max_new_blocks:
+            return max_new_tokens, max(0, blocks_needed)
+        # clip tokens to what the block budget allows
+        capacity = (seq_desc.cur_allocated_blocks + max_new_blocks) * bs - seq_desc.seen_tokens
+        return max(0, capacity), max_new_blocks
+
+    def get_remaining_block_capacity(self, seq_desc: DSSequenceDescriptor) -> int:
+        bs = self._state_manager.kv_block_size
+        return seq_desc.cur_allocated_blocks * bs - seq_desc.seen_tokens
+
+    def maybe_allocate_kv(self, seq_desc: DSSequenceDescriptor, n_new_tokens: int) -> None:
+        _, n_blocks = self.get_kv_requirements(seq_desc, n_new_tokens, self._state_manager.free_blocks)
+        if n_blocks > 0:
+            seq_desc.extend_kv_cache(self._state_manager.allocate_blocks(n_blocks))
+
+    def maybe_free_kv(self, seq_desc: DSSequenceDescriptor) -> None:
+        """Hook for cache shrinking; paged blocks are retained until flush."""
+
+    # ---------------------------------------------------------------- forward --
+    def prepare_batch(self, ragged_batch) -> None:
+        """Amortized pre-forward work (reference engine_v2.py prepare_batch)."""
+
+    def forward(self, ragged_batch):
+        """Run the ragged forward; returns logits [n_seqs, vocab] (one row per
+        sequence — its final token), and updates the paged KV cache in place."""
+        import jax
+
+        batch = ragged_batch.device_batch if hasattr(ragged_batch, "device_batch") else ragged_batch
+        bucket = (batch["input_ids"].shape[0], batch["seq_seen"].shape[0], batch["block_table"].shape[1])
+        fn = self._get_compiled(bucket)
+        cache = self._state_manager.kv_cache.cache
+        tracer = get_tracer()
+        if tracer is not None:
+            logits, new_cache = self._traced_forward(batch, cache)
+        else:
+            logits, new_cache = fn(self._params, cache, batch)
+        self._state_manager.kv_cache.set_cache(new_cache)
+        n = int(batch["n_seqs"])
+        return logits[:n] if n else logits[:0]
+
+    def empty_run(self) -> None:
+        """Participate in collectives with zero live tokens (fork engine_v2.py:308).
+        Uses the smallest bucket with every validity mask false."""
+        from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import RaggedBatchWrapper
+        wrapper = RaggedBatchWrapper(self._engine_config.state_manager,
+                                     block_size=self._engine_config.kv_block_size)
+        batch = wrapper.finalize()  # zero live sequences/tokens
+        tracer = get_tracer()
+        if tracer is not None:
+            self._traced_forward(batch, self._state_manager.kv_cache.cache)
+            return
+        fn = self._get_compiled((batch["input_ids"].shape[0], batch["seq_seen"].shape[0],
+                                 batch["block_table"].shape[1]))
+        _, new_cache = fn(self._params, self._state_manager.kv_cache.cache, batch)
+        self._state_manager.kv_cache.set_cache(new_cache)
+
+    def _get_compiled(self, bucket):
+        import jax
+        if bucket not in self._compiled:
+            self._compiled[bucket] = jax.jit(self._forward_impl, donate_argnums=(1, ))
+        return self._compiled[bucket]
+
+    def _forward_impl(self, params, cache, batch):
+        import jax.numpy as jnp
+
+        x = self.embed(params, batch["input_ids"])
+        attn = partial(self._paged_attention, batch=batch)
+        for li in range(self.num_layers):
+            x, cache = self.layer_forward(params, li, x, cache, attn, batch)
+        # unembed ONLY each sequence's last token (reference logits_gather)
+        x_last = x[batch["last_tok"]]
+        logits = self.unembed(params, x_last)
+        return logits.astype(jnp.float32), cache
+
+    def _traced_forward(self, batch, cache):
+        """Phase-timed execution for the tracer: embed / per-layer phases /
+        unembed run as separate device computations so host timers see real
+        boundaries (slower than the fused program — tracing mode trades speed
+        for observability; the reference pays CUDA-event overhead instead)."""
+        import jax
+        import jax.numpy as jnp
+
+        batch_j = {k: jnp.asarray(v) for k, v in batch.items()}
+        with record("embed"):
+            x = jax.jit(self.embed)(self._params, batch_j["input_ids"])
+            x.block_until_ready()
+        attn = partial(self._paged_attention, batch=batch_j)
+        for li in range(self.num_layers):
+            x, cache = self.layer_forward_traced(self._params, li, x, cache, attn, batch_j)
+        with record("unembed"):
+            logits = jax.jit(self.unembed)(self._params, x[batch_j["last_tok"]])
+            logits = logits.astype(jnp.float32)
+            logits.block_until_ready()
+        self._state_manager.kv_cache.set_cache(cache)
+        n = int(batch["n_seqs"])
+        return logits[:n], cache
+
+    def layer_forward_traced(self, params, li, x, cache, attn_fn, batch):
+        raise NotImplementedError("tracing requires a model with phase-split layers")
+
+    # -------------------------------------------------------- paged attention --
+    def _paged_attention(self, q, k_new, v_new, cache, li, *, batch):
+        """Scatter new K/V into the paged cache, then attend each query token to
+        its sequence's full history (gather per-sequence K/V from the block
+        table — the XLA lowering of the reference's blocked flash kernel; a
+        Pallas kernel consuming the same layout can swap in here).
+
+        q: [T, H, D]; k_new/v_new: [T, KVH, D];
+        cache: [num_blocks, bs, 2, L, KVH, D]."""
+        import jax
+        import jax.numpy as jnp
+
+        T = q.shape[0]
+        S, MB = batch["block_table"].shape
+        bs = cache.shape[1]
+        H, D = self.num_heads, self.head_dim
+        KVH = self.num_kv_heads
+
+        token_seq = batch["token_seq"]
+        token_pos = batch["token_pos"]
+        token_valid = batch["token_valid"]
+
+        # --- scatter new kv ---------------------------------------------------
+        blk_idx = token_pos // bs
+        blk_ids = batch["block_table"][token_seq, jnp.minimum(blk_idx, MB - 1)]
+        # invalid tokens (padding) or unallocated table slots are -1 -> OOB drop
+        blk_ids = jnp.where(token_valid, blk_ids, -1)
+        offs = token_pos % bs
+        cache = cache.at[blk_ids, offs, 0, li].set(k_new.astype(cache.dtype), mode="drop")
+        cache = cache.at[blk_ids, offs, 1, li].set(v_new.astype(cache.dtype), mode="drop")
+
+        # --- gather per-sequence history -------------------------------------
+        table = jnp.maximum(batch["block_table"], 0)  # [S, MB]
+        k_hist = cache[table, :, 0, li]  # [S, MB, bs, KVH, D]
+        v_hist = cache[table, :, 1, li]
+        KV = MB * bs
+        k_hist = k_hist.reshape(S, KV, KVH, D).astype(q.dtype)
+        v_hist = v_hist.reshape(S, KV, KVH, D).astype(q.dtype)
+        if KVH != H:  # GQA
+            rep = H // KVH
+            k_hist = jnp.repeat(k_hist, rep, axis=2)
+            v_hist = jnp.repeat(v_hist, rep, axis=2)
+
+        # --- densify queries per sequence ------------------------------------
+        local_q = token_pos - batch["seq_seen"][token_seq]
+        Qm = int(np.max([1, q.shape[0]]))  # dense q rows per seq, bounded by T
+        q_dense = jnp.zeros((S, Qm, H, D), q.dtype)
+        seq_ids = jnp.where(token_valid, token_seq, S)  # OOB drop for padding
+        q_dense = q_dense.at[seq_ids, jnp.minimum(local_q, Qm - 1)].set(q, mode="drop")
+
+        scale = 1.0 / (D**0.5)
+        logits = jnp.einsum("sqhd,skhd->shqk", q_dense, k_hist).astype(jnp.float32) * scale
+        kv_pos = jnp.arange(KV)[None, None, None, :]              # [1,1,1,KV]
+        q_pos = (batch["seq_seen"][:, None] + jnp.arange(Qm)[None, :])[:, None, :, None]
+        valid_kv = kv_pos <= q_pos                                # causal incl. self
+        seq_len = (batch["seq_seen"] + batch["seq_ntok"])[:, None, None, None]
+        valid_kv &= kv_pos < seq_len
+        logits = jnp.where(valid_kv, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        out_dense = jnp.einsum("shqk,skhd->sqhd", probs, v_hist)
+
+        # --- back to token-major ---------------------------------------------
+        out = out_dense[token_seq, jnp.minimum(local_q, Qm - 1)]  # [T, H, D]
+        out = jnp.where(token_valid[:, None, None], out, 0.0)
+        return out, cache
+
+    # ------------------------------------------------------------- serialize --
+    def flattened_params(self):
+        import jax
+        return jax.tree.leaves(self._params)
+
+    # Subclass hooks -----------------------------------------------------------
+    def embed(self, params, ids):
+        raise NotImplementedError
+
+    def layer_forward(self, params, li, x, cache, attn_fn, batch):
+        raise NotImplementedError
+
+    def unembed(self, params, x):
+        raise NotImplementedError

@@ -1,0 +1,1 @@
+from deepspeed_tpu.inference.v2.modules.moe import RaggedMoE

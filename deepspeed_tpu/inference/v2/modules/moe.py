@@ -1,0 +1,136 @@
+"""Ragged MoE for inference, with expert parallelism (the fork's core feature).
+
+Reference: ``deepspeed/inference/v2/modules/implementations/moe/cutlass_multi_gemm.py``
+(DSMultiGemmMoE:28) and the fork's ``cutlass_multi_gemm_ep.py`` (DSMultiGemmMoEEp:32)
+— top-k gating → moe_scatter → [EP: variable all_to_all x2 for counts+tokens] →
+grouped GEMM → moe_gather → [EP: all_to_all back], with ``empty_run`` participation.
+
+TPU translation: XLA collectives are shape-static, so the fork's *variable-size*
+all-to-alls become fixed-capacity ``lax.all_to_all`` over the ``expert`` mesh axis
+(capacity = ceil(T * k / E) * factor). Dispatch packs each expert's tokens into its
+capacity slots (the reference's moe_scatter), the all_to_all exchanges expert-major
+buffers across EP ranks, each rank runs its local experts' grouped GEMM, and the
+reverse all_to_all + combine weights reproduce moe_gather. ``empty_run`` is a
+forward with zero live tokens: every rank still enters the same collectives —
+exactly the deadlock-avoidance contract of the fork (engine_v2.py:308).
+
+Simulated gating (fork ``top_k_gating/expert_probs.py``): when enabled, router
+logits are replaced by a per-layer synthetic distribution with a temperature knob,
+decoupling load-balance experiments from real router weights. The reference ships
+measured Mixtral expert-count tables; we synthesize a skewed per-layer
+distribution from a seeded Dirichlet instead (same knob semantics, no dataset
+dependency), sharpened/flattened by ``softmax(log(p)/temperature)``.
+"""
+
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils import groups
+
+_SIMULATED_GATING = {"enabled": False, "temperature": 1.0}
+
+
+def enable_simulated_gating(temperature: float = 1.0) -> None:
+    _SIMULATED_GATING["enabled"] = True
+    _SIMULATED_GATING["temperature"] = float(temperature)
+
+
+def disable_simulated_gating() -> None:
+    _SIMULATED_GATING["enabled"] = False
+
+
+def simulated_gating_enabled() -> bool:
+    return _SIMULATED_GATING["enabled"]
+
+
+def simulated_expert_probs(layer_id: int, num_experts: int, temperature: Optional[float] = None):
+    """Per-layer synthetic expert distribution (seeded, deterministic)."""
+    import jax.numpy as jnp
+    if temperature is None:
+        temperature = _SIMULATED_GATING["temperature"]
+    rng = np.random.default_rng(1000 + layer_id)
+    p = rng.dirichlet(np.full(num_experts, 2.0))
+    logp = np.log(np.maximum(p, 1e-9)) / max(temperature, 1e-6)
+    e = np.exp(logp - logp.max())
+    return jnp.asarray(e / e.sum(), jnp.float32)
+
+
+class RaggedMoE:
+    """Functional top-k MoE over flat tokens [T, M] with optional EP sharding."""
+
+    def __init__(self, num_experts: int, top_k: int = 2, capacity_factor: float = 2.0,
+                 expert_axis: str = groups.EXPERT_AXIS, layer_id: int = 0):
+        assert top_k in (1, 2), "ragged MoE supports top-1/top-2"
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.expert_axis = expert_axis
+        self.layer_id = layer_id
+
+    def _router_probs(self, h, gate_w):
+        import jax
+        import jax.numpy as jnp
+        if simulated_gating_enabled():
+            # Load-testing mode: every token draws from the synthetic per-layer
+            # distribution; token index seeds the draw so batches are diverse.
+            probs = simulated_expert_probs(self.layer_id, self.num_experts)
+            T = h.shape[0]
+            u = jax.random.uniform(jax.random.PRNGKey(self.layer_id), (T, self.num_experts))
+            # Gumbel trick over the fixed distribution
+            logits = jnp.log(probs)[None, :] - jnp.log(-jnp.log(jnp.maximum(u, 1e-9)))
+            return jax.nn.softmax(logits, axis=-1)
+        logits = h.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+        return jax.nn.softmax(logits, axis=-1)
+
+    def __call__(self, h, gate_w, wi, wo, token_valid=None, activation=None, mesh=None):
+        """h: [T, M]; gate_w: [M, E]; wi: [E, M, F]; wo: [E, F, M] (the training
+        ExpertFFN bank layout — EP-shards on the leading dim)."""
+        import jax
+        import jax.numpy as jnp
+        from deepspeed_tpu.sequence.layer import _constrain
+
+        if activation is None:
+            activation = jax.nn.silu
+        T, M = h.shape
+        E = self.num_experts
+        C = max(4, int(np.ceil(T * self.top_k / E * self.capacity_factor)))
+
+        probs = self._router_probs(h, gate_w)  # [T, E]
+        if token_valid is not None:
+            probs = probs * token_valid[:, None]
+
+        # top-k assignment with capacity packing (reference moe_scatter)
+        combine = jnp.zeros((T, E, C), jnp.float32)
+        dispatch = jnp.zeros((T, E, C), h.dtype)
+        topk_p, topk_e = jax.lax.top_k(probs, self.top_k)  # [T, k]
+        if self.top_k == 2:
+            denom = jnp.maximum(topk_p.sum(-1, keepdims=True), 1e-9)
+            topk_p = topk_p / denom  # Mixtral renormalizes over the chosen 2
+        for j in range(self.top_k):
+            e_j = topk_e[:, j]  # [T]
+            if token_valid is not None:
+                # invalid tokens must not consume capacity slots: route them OOB
+                e_j = jnp.where(token_valid, e_j, E)
+            onehot = jax.nn.one_hot(e_j, E, dtype=jnp.int32)  # [T, E]; OOB -> all-zero
+            slot = jnp.cumsum(onehot, axis=0) * onehot - 1  # position within expert
+            slot_t = slot.max(axis=1)  # [T]; -1 for OOB tokens
+            ok = (slot_t < C) & (slot_t >= 0)
+            t_idx = jnp.arange(T)
+            slot_c = jnp.where(ok, slot_t, C)  # OOB slot -> dropped by scatter
+            combine = combine.at[t_idx, e_j, slot_c].add(
+                jnp.where(ok, topk_p[:, j], 0.0), mode="drop")
+            dispatch = dispatch.at[t_idx, e_j, slot_c].add(
+                jnp.where(ok, 1.0, 0.0).astype(h.dtype), mode="drop")
+
+        # dispatch: [E, C, M] expert-major buffer -> the (fixed-capacity) a2a
+        buf = jnp.einsum("tec,tm->ecm", dispatch, h)
+
+        def expert_sharded(t):
+            return _constrain(t, (self.expert_axis, ) + (None, ) * (t.ndim - 1), mesh)
+
+        buf = expert_sharded(buf)  # a2a #2 analog: tokens to expert shards
+        hmid = activation(jnp.einsum("ecm,emf->ecf", buf, wi.astype(buf.dtype)))
+        out = jnp.einsum("ecf,efm->ecm", hmid, wo.astype(buf.dtype))
+        out = expert_sharded(out)  # a2a #3 analog: results back
+        return jnp.einsum("tec,ecm->tm", combine.astype(h.dtype), out)

@@ -1,0 +1,131 @@
+"""Ragged batch container.
+
+Reference: ``deepspeed/inference/v2/ragged/ragged_wrapper.py`` (RaggedBatchWrapper:31
+— host shadow buffers for input ids / token→sequence map / per-sequence descriptors /
+KV block lists, finalized into device tensors once per forward).
+
+TPU design: XLA needs static shapes, so ``finalize()`` pads every buffer to a
+*bucket*: token count rounded up with :func:`to_padded`, sequence count to a
+multiple of 8, per-sequence block count to a multiple of 4. Each distinct bucket
+shape compiles once; steady-state decode reuses one bucket. Padded token slots
+carry an out-of-range KV block id so cache scatters drop them (XLA scatter
+``mode=drop`` — no masking pass needed).
+"""
+
+from typing import List
+
+import numpy as np
+
+from deepspeed_tpu.inference.v2.ragged.manager_configs import DSStateManagerConfig
+from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import DSSequenceDescriptor
+
+
+def to_padded(original_size: int) -> int:
+    """Pad a token count to a compile-friendly granularity (64 below 512, 128 above)."""
+    granularity = 64 if original_size <= 512 else 128
+    return (original_size + granularity - 1) // granularity * granularity
+
+
+def _pad_to(n: int, mult: int) -> int:
+    return max(mult, (n + mult - 1) // mult * mult)
+
+
+class RaggedBatchWrapper:
+    """Host-side composition of one ragged forward batch."""
+
+    def __init__(self, config: DSStateManagerConfig, block_size: int = 128) -> None:
+        self._config = config
+        self._block_size = block_size
+        self.clear()
+
+    def clear(self) -> None:
+        self._token_ids: List[int] = []
+        self._token_seq: List[int] = []      # token -> index of its sequence in this batch
+        self._token_pos: List[int] = []      # absolute position within the sequence
+        self._seq_descs: List[DSSequenceDescriptor] = []
+        self._seq_seen: List[int] = []
+        self._seq_ntok: List[int] = []
+        self._seq_blocks: List[np.ndarray] = []
+        self._device_batch = None
+
+    @property
+    def current_sequences(self) -> int:
+        return len(self._seq_descs)
+
+    @property
+    def current_tokens(self) -> int:
+        return len(self._token_ids)
+
+    def insert_sequence(self, seq_desc: DSSequenceDescriptor, tokens, do_checks: bool = True) -> None:
+        tokens = np.atleast_1d(np.asarray(tokens)).astype(np.int32)
+        if do_checks:
+            if self.current_tokens + tokens.size > self._config.max_ragged_batch_size:
+                raise ValueError("ragged batch token budget exceeded")
+            if self.current_sequences + 1 > self._config.max_ragged_sequence_count:
+                raise ValueError("ragged batch sequence budget exceeded")
+        seq_idx = len(self._seq_descs)
+        seen = seq_desc.seen_tokens
+        self._seq_descs.append(seq_desc)
+        self._seq_seen.append(seen)
+        self._seq_ntok.append(int(tokens.size))
+        self._seq_blocks.append(seq_desc.kv_blocks)
+        self._token_ids.extend(int(t) for t in tokens)
+        self._token_seq.extend([seq_idx] * tokens.size)
+        self._token_pos.extend(range(seen, seen + tokens.size))
+
+    def finalize(self):
+        """Pad to the bucket and build the device-ready numpy struct."""
+        T = to_padded(max(1, self.current_tokens))
+        S = _pad_to(max(1, self.current_sequences), 8)
+        mb = max((len(b) for b in self._seq_blocks), default=1)
+        MB = _pad_to(mb, 4)
+        n_tok = self.current_tokens
+        n_seq = self.current_sequences
+
+        input_ids = np.zeros(T, np.int32)
+        token_seq = np.full(T, S - 1, np.int32)
+        token_pos = np.zeros(T, np.int32)
+        token_valid = np.zeros(T, bool)
+        input_ids[:n_tok] = self._token_ids
+        token_seq[:n_tok] = self._token_seq
+        token_pos[:n_tok] = self._token_pos
+        token_valid[:n_tok] = True
+
+        seq_seen = np.zeros(S, np.int32)
+        seq_ntok = np.zeros(S, np.int32)
+        last_tok = np.zeros(S, np.int32)
+        seq_valid = np.zeros(S, bool)
+        # padded/invalid slots point one past the last block -> scatters drop
+        block_table = np.full((S, MB), -1, np.int32)
+        cursor = 0
+        for i in range(n_seq):
+            seq_seen[i] = self._seq_seen[i]
+            seq_ntok[i] = self._seq_ntok[i]
+            cursor += self._seq_ntok[i]
+            last_tok[i] = cursor - 1
+            seq_valid[i] = True
+            blocks = self._seq_blocks[i]
+            block_table[i, :len(blocks)] = blocks
+
+        self._device_batch = dict(
+            input_ids=input_ids,
+            token_seq=token_seq,
+            token_pos=token_pos,
+            token_valid=token_valid,
+            seq_seen=seq_seen,
+            seq_ntok=seq_ntok,
+            last_tok=last_tok,
+            seq_valid=seq_valid,
+            block_table=block_table,
+            n_tokens=np.int32(n_tok),
+            n_seqs=np.int32(n_seq),
+        )
+        return self._device_batch
+
+    @property
+    def device_batch(self):
+        assert self._device_batch is not None, "finalize() the batch first"
+        return self._device_batch
+
+    def masked_input_ids(self) -> np.ndarray:
+        return self.device_batch["input_ids"][:self.current_tokens]

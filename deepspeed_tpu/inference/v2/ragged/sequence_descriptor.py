@@ -51,3 +51,11 @@ class DSSequenceDescriptor:
         """Reference: commit in-flight tokens to seen after the forward."""
         self._seen_tokens += self._in_flight_tokens
         self._in_flight_tokens = 0
+
+
+class PlaceholderSequenceDescriptor(DSSequenceDescriptor):
+    """Ephemeral stand-in used by ``engine.query``/``can_schedule`` for uids the
+    engine does not know yet (reference sequence_descriptor.py Placeholder...)."""
+
+    def __init__(self, tracking_id: int = -1, max_blocks_per_seq: int = 2**30):
+        super().__init__(tracking_id, max_blocks_per_seq=max_blocks_per_seq)
