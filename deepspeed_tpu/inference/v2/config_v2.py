@@ -1,0 +1,43 @@
+"""Ragged inference engine config.
+
+Reference: ``deepspeed/inference/v2/config_v2.py`` (RaggedInferenceEngineConfig:29,
+DeepSpeedTPConfig:12, the fork's DeepSpeedEPConfig:18 with ``replica_num``, and the
+``simulated_gating``/``trace_enabled`` fork flags).
+"""
+
+from pydantic import Field
+
+from deepspeed_tpu.inference.v2.ragged.manager_configs import DSStateManagerConfig
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    """Tensor-parallel settings: model params sharded over the ``model`` mesh axis."""
+
+    tp_size: int = 1
+
+
+class DeepSpeedEPConfig(DeepSpeedConfigModel):
+    """Expert-parallel settings (fork addition). Each replica serves
+    ``num_experts // replica_num`` experts; the dispatch/return all-to-alls run
+    over the ``expert`` mesh axis."""
+
+    enabled: bool = False
+    replica_num: int = 1
+    capacity_factor: float = 2.0
+    """Fixed-capacity slack for the XLA (shape-static) all-to-all; the reference's
+    variable-size a2a needs no capacity but pays a host-side size exchange."""
+
+
+class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
+    """Top-level FastGen engine config."""
+
+    tensor_parallel: DeepSpeedTPConfig = Field(default_factory=DeepSpeedTPConfig, alias="tp")
+    expert_parallel: DeepSpeedEPConfig = Field(default_factory=DeepSpeedEPConfig, alias="ep")
+    state_manager: DSStateManagerConfig = Field(default_factory=DSStateManagerConfig, alias="manager")
+
+    kv_block_size: int = 64
+
+    simulated_gating: bool = False
+    simulated_gating_temperature: float = 1.0
+    trace_enabled: bool = False

@@ -1,0 +1,107 @@
+"""Engine construction + generation driver.
+
+Reference: ``deepspeed/inference/v2/engine_factory.py`` (build_hf_engine:66 picks an
+InferenceV2Policy by HF ``model_type``). Here model classes consume the training
+pytree directly, so the "policy" is a config-type → model-class dispatch.
+
+The decode loop (``generate``) is the serving-side driver the reference leaves to
+MII: continuous-batching greedy/temperature sampling over ``engine.put()``.
+"""
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+
+
+def build_engine(params, model_config, engine_config: Optional[RaggedInferenceEngineConfig] = None):
+    """Build an InferenceEngineV2 for a training param tree + model config."""
+    from deepspeed_tpu.models.llama import LlamaConfig
+    from deepspeed_tpu.models.mixtral import MixtralConfig
+
+    if engine_config is None:
+        engine_config = RaggedInferenceEngineConfig()
+
+    if isinstance(model_config, MixtralConfig):
+        from deepspeed_tpu.inference.v2.model_implementations.mixtral_v2 import MixtralV2Model
+        model = MixtralV2Model(params, model_config, engine_config)
+    elif isinstance(model_config, LlamaConfig):
+        from deepspeed_tpu.inference.v2.model_implementations.llama_v2 import LlamaV2Model
+        model = LlamaV2Model(params, model_config, engine_config)
+    else:
+        raise ValueError(f"no inference-v2 model implementation for {type(model_config).__name__}")
+    return InferenceEngineV2(model, engine_config)
+
+
+def build_hf_engine(path: str, engine_config: Optional[RaggedInferenceEngineConfig] = None):
+    """Load an HF checkpoint directory and build an engine (reference
+    engine_factory.py:66). Supports llama/mixtral-architecture configs."""
+    from deepspeed_tpu.inference.checkpoint import load_hf_checkpoint
+
+    params, model_config = load_hf_checkpoint(path)
+    return build_engine(params, model_config, engine_config)
+
+
+def generate(engine: InferenceEngineV2,
+             prompts: Sequence[Sequence[int]],
+             max_new_tokens: int = 16,
+             temperature: float = 0.0,
+             eos_token_id: Optional[int] = None,
+             seed: int = 0) -> List[List[int]]:
+    """Continuous-batching decode: prefill all prompts (token budget permitting),
+    then decode step-by-step; finished sequences are flushed and their KV blocks
+    recycled. Greedy when ``temperature == 0``."""
+    rng = np.random.default_rng(seed)
+    uids = list(range(len(prompts)))
+    outputs: Dict[int, List[int]] = {u: [] for u in uids}
+    pending = {u: np.asarray(p, np.int32) for u, p in zip(uids, prompts)}
+    live: Dict[int, np.ndarray] = {}  # uid -> next token to feed
+    done: set = set()
+
+    def sample(row: np.ndarray) -> int:
+        if temperature <= 0.0:
+            return int(np.argmax(row))
+        z = row.astype(np.float64) / temperature
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(rng.choice(row.shape[0], p=p))
+
+    while len(done) < len(uids):
+        batch_uids, batch_tokens = [], []
+        budget = engine._config.state_manager.max_ragged_batch_size
+        # admit pending prefills first (SplitFuse-style: chunk to fit the budget)
+        for u in list(pending):
+            if budget <= 1:
+                break
+            chunk, rest = pending[u][:budget], pending[u][budget:]
+            batch_uids.append(u)
+            batch_tokens.append(chunk)
+            budget -= chunk.size
+            if rest.size:
+                pending[u] = rest
+            else:
+                del pending[u]
+                live[u] = None  # logits from this put() seed decode
+        for u, tok in live.items():
+            if tok is not None and budget > 0 and u not in batch_uids:
+                batch_uids.append(u)
+                batch_tokens.append(np.asarray([tok], np.int32))
+                budget -= 1
+        if not batch_uids:
+            break
+        logits = np.asarray(engine.put(batch_uids, batch_tokens))
+        for i, u in enumerate(batch_uids):
+            if u in pending:  # mid-prefill: ignore logits until prompt is consumed
+                continue
+            nxt = sample(logits[i])
+            outputs[u].append(nxt)
+            if (eos_token_id is not None and nxt == eos_token_id) or len(outputs[u]) >= max_new_tokens:
+                done.add(u)
+                live.pop(u, None)
+                engine.flush(u)
+            else:
+                live[u] = nxt
+    return [outputs[u] for u in uids]

@@ -1,0 +1,203 @@
+"""FastGen ragged inference engine.
+
+Reference: ``deepspeed/inference/v2/engine_v2.py`` (InferenceEngineV2:32 —
+``put()``:135 inserts ragged sequences and runs one forward; ``query``/
+``can_schedule`` token/KV-block occupancy logic; ``flush``; ``serialize``; the
+fork's ``empty_run``:308 participating in EP collectives with zero tokens).
+
+TPU execution model: the engine composes a :class:`RaggedBatchWrapper` on the
+host, the model runs ONE jitted program per padded batch *bucket* (static
+shapes), and the paged KV cache flows through the program functionally
+(donated). TP/EP sharding is carried by the global device mesh
+(``deepspeed_tpu.utils.groups``) — param/activation sharding constraints inside
+the model program replace the reference's explicit process-group collectives.
+"""
+
+import json
+import os
+import pickle
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.ragged.ragged_manager import DSStateManager
+from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import RaggedBatchWrapper
+from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import PlaceholderSequenceDescriptor
+from deepspeed_tpu.inference.v2.scheduling_utils import SchedulingError, SchedulingResult
+from deepspeed_tpu.inference.v2.tracer import Tracer, set_tracer
+from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.logging import logger
+
+
+class InferenceEngineV2:
+
+    def __init__(self, model, engine_config: RaggedInferenceEngineConfig) -> None:
+        """``model`` is a built :class:`DSTransformerModelBase` subclass (the
+        engine_factory constructs it from a policy; the reference builds it from
+        ``policy.build_model`` — here the model consumes training pytrees
+        directly so no container-mapping step exists)."""
+        self._config = engine_config
+
+        if engine_config.simulated_gating:
+            from deepspeed_tpu.inference.v2.modules.moe import enable_simulated_gating
+            enable_simulated_gating(engine_config.simulated_gating_temperature)
+
+        if engine_config.expert_parallel.enabled:
+            assert engine_config.tensor_parallel.tp_size == 1, \
+                "TP + EP is currently not supported"  # reference engine_v2.py:85
+
+        self._model = model
+        self._initialize_comm_groups()
+
+        self._batch = RaggedBatchWrapper(engine_config.state_manager,
+                                         block_size=engine_config.kv_block_size)
+        self._state_manager = DSStateManager(engine_config.state_manager,
+                                             model.kv_cache_config())
+        self._model.set_state_manager(self._state_manager)
+
+        if engine_config.trace_enabled:
+            self._tracer = Tracer()
+            set_tracer(self._tracer)
+        else:
+            self._tracer = None
+
+    # ------------------------------------------------------------------ groups --
+    def _initialize_comm_groups(self) -> None:
+        """Reference engine_v2.py:108 creates TP (and fork: EP-replica) process
+        groups; here both are axes of the one global mesh."""
+        tp = self._config.tensor_parallel.tp_size
+        ep = self._config.expert_parallel.replica_num if self._config.expert_parallel.enabled else 1
+        if groups.mesh_is_initialized():
+            mesh = groups.get_mesh()
+            if tp > 1:
+                assert mesh.shape[groups.MODEL_AXIS] == tp, \
+                    f"mesh model axis {mesh.shape[groups.MODEL_AXIS]} != tp_size {tp}"
+            if ep > 1:
+                assert mesh.shape[groups.EXPERT_AXIS] == ep, \
+                    f"mesh expert axis {mesh.shape[groups.EXPERT_AXIS]} != replica_num {ep}"
+        elif tp > 1 or ep > 1:
+            groups.initialize_mesh(model_parallel_size=tp, expert_parallel_size=ep)
+
+    # ------------------------------------------------------------ properties --
+    @property
+    def free_blocks(self) -> int:
+        return self._state_manager.free_blocks
+
+    @property
+    def n_kv_cache_groups(self) -> int:
+        return 1
+
+    @property
+    def model(self):
+        return self._model
+
+    @property
+    def tracer(self) -> Optional[Tracer]:
+        return self._tracer
+
+    # ----------------------------------------------------------------- put() --
+    def put(self, batch_uids: Iterable[int], batch_tokens: Iterable, do_checks: bool = True):
+        """Run one ragged forward over ``batch_uids``/``batch_tokens``; returns
+        logits ``[len(batch_uids), vocab]`` — each sequence's final token only."""
+        batch_uids = list(batch_uids)
+        batch_tokens = [np.atleast_1d(np.asarray(t)) for t in batch_tokens]
+
+        if do_checks:
+            schedule_check = self.can_schedule(batch_uids, [t.size for t in batch_tokens])
+            if schedule_check != SchedulingResult.Success:
+                raise SchedulingError(schedule_check)
+
+        self._batch.clear()
+        if self._tracer:
+            self._tracer.init_batch(is_empty_run=False, num_layers=self._model.num_layers)
+        for uid, tokens in zip(batch_uids, batch_tokens):
+            seq_desc = self._state_manager.get_or_create_sequence(uid)
+            self._model.maybe_allocate_kv(seq_desc, tokens.size)
+            seq_desc.pre_forward(tokens.size)
+            self._batch.insert_sequence(seq_desc, tokens, do_checks=do_checks)
+            if self._tracer:
+                self._tracer.add_sequence(seq_desc)
+
+        self._batch.finalize()
+        self._model.prepare_batch(self._batch)
+        logits = self._model.forward(self._batch)
+        assert logits.shape[0] == self._batch.current_sequences
+
+        for uid in batch_uids:
+            seq_desc = self._state_manager.get_sequence(uid)
+            seq_desc.post_forward()
+            self._model.maybe_free_kv(seq_desc)
+        return logits
+
+    # ------------------------------------------------------------- scheduling --
+    def query(self, uid: int, max_request_tokens: int, max_request_blocks: int) -> Tuple[int, int]:
+        """(tokens schedulable, blocks required) for a hypothetical request."""
+        seq_desc = self._state_manager.get_sequence(uid)
+        if seq_desc is None:
+            if self._state_manager.n_tracked_sequences >= self._config.state_manager.max_tracked_sequences:
+                return (0, 0)
+            seq_desc = PlaceholderSequenceDescriptor()
+        return self._model.get_kv_requirements(seq_desc, max_request_tokens, max_request_blocks)
+
+    def can_schedule(self, uids: Iterable[int], lengths: Iterable[int]) -> SchedulingResult:
+        uids, lengths = list(uids), list(lengths)
+        cur_seqs = self._state_manager.n_tracked_sequences
+        free_blocks = self._state_manager.free_blocks
+        batch_len = 0
+
+        if len(uids) > self._config.state_manager.max_ragged_sequence_count:
+            return SchedulingResult.BatchSequenceLimitExceeded
+
+        for uid, length in zip(uids, lengths):
+            seq_desc = self._state_manager.get_sequence(uid)
+            if seq_desc is None:
+                cur_seqs += 1
+                seq_desc = PlaceholderSequenceDescriptor()
+            sched_len, sched_blocks = self._model.get_kv_requirements(seq_desc, length, free_blocks)
+            if sched_len != length:
+                return SchedulingResult.KVCacheLimitExceeded
+            batch_len += length
+            free_blocks -= sched_blocks
+
+        if cur_seqs > self._config.state_manager.max_tracked_sequences:
+            return SchedulingResult.EngineSequenceLimitExceeded
+        if batch_len > self._config.state_manager.max_ragged_batch_size:
+            return SchedulingResult.BatchTokenLimitExceeded
+        return SchedulingResult.Success
+
+    def get_remaining_block_capacity(self, uid: int) -> int:
+        seq_desc = self._state_manager.get_sequence(uid)
+        if seq_desc is None:
+            return 0
+        return self._model.get_remaining_block_capacity(seq_desc)
+
+    def flush(self, uid: int) -> None:
+        self._state_manager.flush_sequence(uid)
+
+    # -------------------------------------------------------------- empty_run --
+    def empty_run(self) -> None:
+        """Participate in EP collectives with zero live tokens (fork
+        engine_v2.py:308) — keeps idle replicas in lock-step with busy ones."""
+        if self._tracer:
+            self._tracer.init_batch(is_empty_run=True, num_layers=self._model.num_layers)
+        self._model.empty_run()
+
+    # -------------------------------------------------------------- serialize --
+    def serialize(self, save_path: str) -> None:
+        """Write flattened params + metadata (reference engine_v2.py:289)."""
+        import jax
+
+        os.makedirs(save_path, exist_ok=True)
+        leaves_with_paths = jax.tree_util.tree_flatten_with_path(self._model._params)[0]
+        arrays, meta = {}, []
+        for i, (path, leaf) in enumerate(leaves_with_paths):
+            arrays[f"p{i}"] = np.asarray(jax.device_get(leaf))
+            meta.append({"path": jax.tree_util.keystr(path), "shape": list(leaf.shape),
+                         "dtype": str(leaf.dtype)})
+        np.savez(os.path.join(save_path, "params_rank0.npz"), **arrays)
+        with open(os.path.join(save_path, "metadata_rank0.json"), "w") as f:
+            json.dump(meta, f)
+        with open(os.path.join(save_path, "ds_model_config.pkl"), "wb") as f:
+            pickle.dump(self._model.config, f)
+        logger.info(f"serialized {len(arrays)} param tensors to {save_path}")

@@ -27,6 +27,12 @@ def _rms(x, w, eps):
     return (normed * w).astype(x.dtype)
 
 
+def _root(params):
+    """Normalize the two training-tree layouts: LlamaForCausalLM nests everything
+    under "model"; MixtralForCausalLM's tree is flat."""
+    return params["model"] if "model" in params else params
+
+
 def _rotary_at(x, pos, cos_tab, sin_tab):
     """x: [T, H, D] with per-token absolute positions [T]."""
     cos = cos_tab[pos][:, None, :]  # [T, 1, D/2]
@@ -65,16 +71,17 @@ class LlamaV2Model(DSTransformerModelBase):
 
     # --------------------------------------------------------------- phases --
     def embed(self, params, ids):
-        emb = params["model"]["embed_tokens"]["embedding"]
+        emb = _root(params)["embed_tokens"]["embedding"]
         return emb[ids].astype(self._config.dtype)
 
     def unembed(self, params, x):
-        x = _rms(x, params["model"]["norm"]["weight"], self._config.rms_norm_eps)
-        return x @ params["lm_head"]["kernel"].astype(x.dtype)
+        r = _root(params)
+        x = _rms(x, r["norm"]["weight"], self._config.rms_norm_eps)
+        return x @ r["lm_head"]["kernel"].astype(x.dtype)
 
     def _attn_phase(self, params, li, x, cache, attn_fn, batch):
         cfg = self._config
-        lp = params["model"][f"layers_{li}"]
+        lp = _root(params)[f"layers_{li}"]
         H, KVH, D = self.num_heads, self.num_kv_heads, self.head_dim
         h = _rms(x, lp["input_layernorm"]["weight"], cfg.rms_norm_eps)
         ap = lp["self_attn"]
@@ -90,7 +97,7 @@ class LlamaV2Model(DSTransformerModelBase):
 
     def _ffn_phase(self, params, li, x):
         cfg = self._config
-        lp = params["model"][f"layers_{li}"]
+        lp = _root(params)[f"layers_{li}"]
         h = _rms(x, lp["post_attention_layernorm"]["weight"], cfg.rms_norm_eps)
         mp = lp["mlp"]
         gate = h @ mp["gate_proj"]["kernel"].astype(h.dtype)

@@ -11,7 +11,7 @@ so EP inference logits can be tested against the single-device training forward.
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.inference.v2.model_implementations.llama_v2 import LlamaV2Model, _rms
+from deepspeed_tpu.inference.v2.model_implementations.llama_v2 import LlamaV2Model, _rms, _root
 from deepspeed_tpu.inference.v2.modules.moe import RaggedMoE
 from deepspeed_tpu.inference.v2.tracer import record
 from deepspeed_tpu.models.mixtral import MixtralConfig
@@ -35,12 +35,12 @@ class MixtralV2Model(LlamaV2Model):
         return self._moe_config.num_hidden_layers
 
     def _moe_params(self, params, li):
-        mp = params["model"][f"layers_{li}"]["block_sparse_moe"]
+        mp = _root(params)[f"layers_{li}"]["block_sparse_moe"]
         return mp["gate"], mp["ExpertFFN_0"]["wi"], mp["ExpertFFN_0"]["wo"]
 
     def _ffn_phase(self, params, li, x, batch=None):
         cfg = self._moe_config
-        lp = params["model"][f"layers_{li}"]
+        lp = _root(params)[f"layers_{li}"]
         h = _rms(x, lp["post_attention_layernorm"]["weight"], cfg.rms_norm_eps)
         gate_w, wi, wo = self._moe_params(params, li)
         token_valid = None if batch is None else batch["token_valid"]

@@ -69,10 +69,14 @@ class DSTransformerModelBase:
 
     # ------------------------------------------------------------- kv sizing --
     def kv_cache_config(self) -> KVCacheConfig:
+        import jax.numpy as jnp
         sm = self._engine_config.state_manager
+        model_dtype = getattr(self._config, "dtype", jnp.bfloat16)
+        cache_dtype = {jnp.bfloat16: "bfloat16", jnp.float16: "float16",
+                       jnp.float32: "float32"}.get(model_dtype, "bfloat16")
         return KVCacheConfig(block_size=self._engine_config.kv_block_size,
                              cache_shape=(self.num_layers, self.num_kv_heads, self.head_dim),
-                             cache_dtype="bfloat16",
+                             cache_dtype=cache_dtype,
                              max_blocks_per_allocation_group=(sm.max_context + self._engine_config.kv_block_size - 1)
                              // self._engine_config.kv_block_size)
 

@@ -107,6 +107,11 @@ class RaggedMoE:
         if self.top_k == 2:
             denom = jnp.maximum(topk_p.sum(-1, keepdims=True), 1e-9)
             topk_p = topk_p / denom  # Mixtral renormalizes over the chosen 2
+        # Slot counters are SHARED across the k choices (reference top2gating:
+        # locations2 += sum(mask1)) — otherwise a first-choice and a
+        # second-choice token land in the same capacity slot and their hidden
+        # states sum in the expert buffer.
+        base = jnp.zeros((E, ), jnp.int32)
         for j in range(self.top_k):
             e_j = topk_e[:, j]  # [T]
             if token_valid is not None:
@@ -114,7 +119,7 @@ class RaggedMoE:
                 e_j = jnp.where(token_valid, e_j, E)
             onehot = jax.nn.one_hot(e_j, E, dtype=jnp.int32)  # [T, E]; OOB -> all-zero
             slot = jnp.cumsum(onehot, axis=0) * onehot - 1  # position within expert
-            slot_t = slot.max(axis=1)  # [T]; -1 for OOB tokens
+            slot_t = slot.max(axis=1) + (onehot @ base)  # [T]; -1 for OOB tokens
             ok = (slot_t < C) & (slot_t >= 0)
             t_idx = jnp.arange(T)
             slot_c = jnp.where(ok, slot_t, C)  # OOB slot -> dropped by scatter
@@ -122,6 +127,7 @@ class RaggedMoE:
                 jnp.where(ok, topk_p[:, j], 0.0), mode="drop")
             dispatch = dispatch.at[t_idx, e_j, slot_c].add(
                 jnp.where(ok, 1.0, 0.0).astype(h.dtype), mode="drop")
+            base = base + onehot.sum(axis=0)
 
         # dispatch: [E, C, M] expert-major buffer -> the (fixed-capacity) a2a
         buf = jnp.einsum("tec,tm->ecm", dispatch, h)
@@ -130,7 +136,12 @@ class RaggedMoE:
             return _constrain(t, (self.expert_axis, ) + (None, ) * (t.ndim - 1), mesh)
 
         buf = expert_sharded(buf)  # a2a #2 analog: tokens to expert shards
-        hmid = activation(jnp.einsum("ecm,emf->ecf", buf, wi.astype(buf.dtype)))
+        hpre = jnp.einsum("ecm,emf->ecf", buf, wi.astype(buf.dtype))
+        if wi.shape[-1] == 2 * wo.shape[-2]:  # fused (gate|up) SwiGLU bank
+            from deepspeed_tpu.moe.layer import gated_expert_act
+            hmid = gated_expert_act(hpre, activation)
+        else:
+            hmid = activation(hpre)
         out = jnp.einsum("ecf,efm->ecm", hmid, wo.astype(buf.dtype))
         out = expert_sharded(out)  # a2a #3 analog: results back
         return jnp.einsum("tec,ecm->tm", combine.astype(h.dtype), out)

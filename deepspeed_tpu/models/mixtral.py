@@ -34,6 +34,7 @@ class MixtralConfig:
     rope_theta: float = 1e6
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = True
+    gated_experts: bool = True  # Mixtral experts are SwiGLU (HF w1/w3 fused)
 
     @staticmethod
     def tiny(**kw):
@@ -68,6 +69,7 @@ class MixtralBlock(nn.Module):
                                 capacity_factor=cfg.capacity_factor,
                                 activation=nn.silu,
                                 dtype=cfg.dtype,
+                                gated=cfg.gated_experts,
                                 name="block_sparse_moe")(h)
         return x + moe_out, l_aux
 

@@ -19,22 +19,33 @@ from deepspeed_tpu.moe.sharded_moe import TopKGate, moe_dispatch_combine
 from deepspeed_tpu.utils import groups
 
 
+def gated_expert_act(h, activation):
+    """SwiGLU-family expert activation over a fused [.., 2H] projection laid out
+    as (gate | up) halves — Mixtral's w1/w3 fused into one bank."""
+    gate, up = jnp.split(h, 2, axis=-1)
+    return activation(gate) * up
+
+
 class ExpertFFN(nn.Module):
-    """Stacked expert MLPs: params have a leading expert dim (sharded over EP)."""
+    """Stacked expert MLPs: params have a leading expert dim (sharded over EP).
+    ``gated=True`` uses a fused (gate|up) wi bank of width 2*d_hidden (Mixtral's
+    SwiGLU experts, HF w1/w3); otherwise a plain 2-matrix MLP."""
     num_experts: int
     d_model: int
     d_hidden: int
     activation: Callable = nn.gelu
     dtype: jnp.dtype = jnp.float32
+    gated: bool = False
 
     @nn.compact
     def __call__(self, x):  # x: [E, C, M]
-        wi = self.param("wi", nn.initializers.lecun_normal(), (self.num_experts, self.d_model, self.d_hidden),
+        wi_h = 2 * self.d_hidden if self.gated else self.d_hidden
+        wi = self.param("wi", nn.initializers.lecun_normal(), (self.num_experts, self.d_model, wi_h),
                         self.dtype)
         wo = self.param("wo", nn.initializers.lecun_normal(), (self.num_experts, self.d_hidden, self.d_model),
                         self.dtype)
         h = jnp.einsum("ecm,emh->ech", x, wi.astype(x.dtype))
-        h = self.activation(h)
+        h = gated_expert_act(h, self.activation) if self.gated else self.activation(h)
         return jnp.einsum("ech,ehm->ecm", h, wo.astype(x.dtype))
 
 
@@ -57,6 +68,7 @@ class MoE(nn.Module):
     use_rts: bool = True
     activation: Callable = nn.gelu
     dtype: jnp.dtype = jnp.float32
+    gated: bool = False
 
     @nn.compact
     def __call__(self, x, used_token=None, training: bool = True):
@@ -70,7 +82,8 @@ class MoE(nn.Module):
         rng = self.make_rng("gating") if self.has_rng("gating") else None
         l_aux, combine, dispatch, exp_counts = gate(wg, tokens, rng=rng, used_token=used_token, training=training)
 
-        experts = ExpertFFN(self.num_experts, M, self.ffn_hidden_size or 4 * M, self.activation, self.dtype)
+        experts = ExpertFFN(self.num_experts, M, self.ffn_hidden_size or 4 * M, self.activation, self.dtype,
+                            gated=self.gated)
         out = moe_dispatch_combine(tokens, combine, dispatch, experts)
 
         if self.use_residual:

@@ -1,0 +1,153 @@
+"""FastGen engine end-to-end tests.
+
+Reference coverage model: ``tests/unit/inference/v2/`` (ragged machinery +
+module-level + model tests). The acceptance test from VERDICT item 3: prefill +
+decode mixed-length sequences and match the training model's logits.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.engine_factory import build_engine, generate
+from deepspeed_tpu.inference.v2.ragged.manager_configs import (AllocationMode, DSStateManagerConfig,
+                                                               MemoryConfig)
+from deepspeed_tpu.inference.v2.scheduling_utils import SchedulingError, SchedulingResult
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+
+def _f32_tiny(**kw):
+    return LlamaConfig.tiny(dtype=jnp.float32, **kw)
+
+
+def _engine_config(num_blocks=64, block_size=16, **kw):
+    mgr = DSStateManagerConfig(memory_config=MemoryConfig(mode=AllocationMode.ALLOCATE, size=num_blocks),
+                               max_context=512, **kw)
+    return RaggedInferenceEngineConfig(state_manager=mgr, kv_block_size=block_size)
+
+
+@pytest.fixture(scope="module")
+def llama_setup():
+    cfg = _f32_tiny()
+    model = LlamaModel(cfg)
+    rng = jax.random.PRNGKey(0)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = {"model": model.init(rng, ids)["params"]}
+    return cfg, model, params
+
+
+def _reference_logits(model, params, token_ids):
+    """Training-model logits for a full sequence [S] -> [S, V]."""
+    return np.asarray(model.apply({"params": params["model"]}, jnp.asarray(token_ids)[None])[0],
+                      np.float32)
+
+
+def test_prefill_matches_training_logits(llama_setup):
+    cfg, model, params = llama_setup
+    engine = build_engine(params, cfg, _engine_config())
+    rng = np.random.default_rng(0)
+    seqs = {0: rng.integers(0, cfg.vocab_size, 17), 1: rng.integers(0, cfg.vocab_size, 5),
+            2: rng.integers(0, cfg.vocab_size, 33)}
+
+    logits = np.asarray(engine.put(list(seqs), list(seqs.values())))
+    assert logits.shape == (3, cfg.vocab_size)
+    for i, (uid, toks) in enumerate(seqs.items()):
+        ref = _reference_logits(model, params, toks)[-1]
+        np.testing.assert_allclose(logits[i], ref, rtol=2e-4, atol=2e-4)
+
+
+def test_decode_matches_training_logits(llama_setup):
+    """Mixed prefill + several decode steps: paged-KV logits == full-context logits."""
+    cfg, model, params = llama_setup
+    engine = build_engine(params, cfg, _engine_config())
+    rng = np.random.default_rng(1)
+    ctx = {0: list(rng.integers(0, cfg.vocab_size, 9)), 1: list(rng.integers(0, cfg.vocab_size, 21))}
+
+    out = engine.put(list(ctx), [np.asarray(v) for v in ctx.values()])
+    for step in range(4):
+        nxt = {u: int(np.argmax(np.asarray(out)[i])) for i, u in enumerate(ctx)}
+        for u in ctx:
+            ctx[u].append(nxt[u])
+        out = engine.put(list(ctx), [np.asarray([nxt[u]]) for u in ctx])
+        for i, u in enumerate(ctx):
+            ref = _reference_logits(model, params, ctx[u])[-1]
+            np.testing.assert_allclose(np.asarray(out)[i], ref, rtol=2e-4, atol=2e-4,
+                                       err_msg=f"uid {u} step {step}")
+
+
+def test_generate_greedy_matches_reference(llama_setup):
+    cfg, model, params = llama_setup
+    engine = build_engine(params, cfg, _engine_config())
+    rng = np.random.default_rng(2)
+    prompts = [list(rng.integers(0, cfg.vocab_size, n)) for n in (4, 11)]
+
+    outs = generate(engine, prompts, max_new_tokens=5, temperature=0.0)
+
+    for prompt, out in zip(prompts, outs):
+        toks = list(prompt)
+        for expected in out:
+            ref = _reference_logits(model, params, toks)[-1]
+            assert int(np.argmax(ref)) == expected
+            toks.append(expected)
+
+
+def test_scheduling_limits(llama_setup):
+    cfg, _, params = llama_setup
+    engine = build_engine(params, cfg, _engine_config(num_blocks=4, block_size=16,
+                                                      max_ragged_batch_size=32,
+                                                      max_ragged_sequence_count=2))
+    # KV budget: 80 tokens needs 5 blocks, only 4 exist
+    assert engine.can_schedule([0], [80]) == SchedulingResult.KVCacheLimitExceeded
+    # sequence-count budget
+    assert engine.can_schedule([0, 1, 2], [1, 1, 1]) == SchedulingResult.BatchSequenceLimitExceeded
+    # batch token budget (fits KV, exceeds ragged batch size)
+    assert engine.can_schedule([0, 1], [32, 16]) == SchedulingResult.BatchTokenLimitExceeded
+    assert engine.can_schedule([0], [16]) == SchedulingResult.Success
+    with pytest.raises(SchedulingError):
+        engine.put([0], [np.arange(64) % cfg.vocab_size])
+
+
+def test_flush_recycles_blocks(llama_setup):
+    cfg, _, params = llama_setup
+    engine = build_engine(params, cfg, _engine_config(num_blocks=8, block_size=16))
+    free0 = engine.free_blocks
+    engine.put([7], [np.arange(40) % cfg.vocab_size])
+    assert engine.free_blocks == free0 - 3  # ceil(40/16)
+    # query: known sequence needs 1 more block for 10 tokens (40+10 -> 4 blocks)
+    toks, blocks = engine.query(7, 10, engine.free_blocks)
+    assert (toks, blocks) == (10, 1)
+    engine.flush(7)
+    assert engine.free_blocks == free0
+    assert engine._state_manager.get_sequence(7) is None
+
+
+def test_tracer_records_per_layer(llama_setup):
+    cfg, _, params = llama_setup
+    ec = _engine_config()
+    ec.trace_enabled = True
+    engine = build_engine(params, cfg, ec)
+    engine.put([0], [np.arange(12) % cfg.vocab_size])
+    engine.empty_run()
+    summaries = list(engine.tracer.batch_summaries())
+    assert len(summaries) == 2
+    real, empty = summaries
+    assert not real.is_empty_run and empty.is_empty_run
+    assert real.num_layers == cfg.num_hidden_layers
+    assert real.seen_tokens == [0] and real.in_flight_tokens == [12]
+    # per-layer phase timings recorded for attn+ffn
+    times = np.asarray(real.record_exec_times)
+    assert times.shape[0] == cfg.num_hidden_layers
+    assert (times[:, real.record_names.index("attn")] > 0).all()
+    assert real.embed > 0 and real.unembed > 0
+
+
+def test_serialize_roundtrip(llama_setup, tmp_path):
+    cfg, _, params = llama_setup
+    engine = build_engine(params, cfg, _engine_config())
+    engine.serialize(str(tmp_path))
+    data = np.load(tmp_path / "params_rank0.npz")
+    flat = jax.tree.leaves(params)
+    assert len(data.files) == len(flat)
