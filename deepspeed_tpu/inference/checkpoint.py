@@ -36,9 +36,13 @@ def _iterate_hf_tensors(path: str):
 
 
 def _model_config_from_hf(cfg: dict):
+    import jax.numpy as jnp
     arch = (cfg.get("architectures") or [""])[0].lower()
     model_type = cfg.get("model_type", "").lower()
-    common = dict(vocab_size=cfg["vocab_size"],
+    dtype = {"float32": jnp.float32, "float16": jnp.float16,
+             "bfloat16": jnp.bfloat16}.get(cfg.get("torch_dtype", "bfloat16"), jnp.bfloat16)
+    common = dict(dtype=dtype,
+                  vocab_size=cfg["vocab_size"],
                   hidden_size=cfg["hidden_size"],
                   intermediate_size=cfg["intermediate_size"],
                   num_hidden_layers=cfg["num_hidden_layers"],
@@ -73,7 +77,9 @@ def _map_hf_name(name: str, n_experts: int):
     if name == "norm.weight":
         return ("model", "norm", "weight"), False
     if name == "lm_head.weight":
-        return ("lm_head", "kernel"), True
+        # lm_head lives INSIDE the "model" subtree in the training layout
+        # (models/llama.py nests it with everything else _root/unembed read).
+        return ("model", "lm_head", "kernel"), True
     if not name.startswith("layers."):
         return None
     parts = name.split(".")
@@ -102,6 +108,9 @@ def load_hf_checkpoint(path: str):
     with open(os.path.join(path, "config.json")) as f:
         cfg = _model_config_from_hf(json.load(f))
     n_experts = getattr(cfg, "num_local_experts", 0)
+    # store tensors in the checkpoint's own dtype (a f16 7B model must occupy
+    # 14GB, not 28GB); jnp handles ml_dtypes bfloat16 numpy arrays natively
+    target_dtype = jnp.dtype(cfg.dtype)
 
     params: Dict = {}
     experts: Dict = {}  # (layer, w1/w2/w3) -> {expert_idx: array}
@@ -110,8 +119,8 @@ def load_hf_checkpoint(path: str):
         if mapped is None:
             continue
         pth, transpose = mapped
-        if arr.dtype == np.float32 or arr.dtype == np.float16:
-            arr = arr.astype(np.float32)
+        if arr.dtype != target_dtype:
+            arr = arr.astype(target_dtype)
         if transpose and arr.ndim == 2:
             arr = arr.T
         if pth[0] == "__expert__":
@@ -119,6 +128,12 @@ def load_hf_checkpoint(path: str):
             experts.setdefault((layer, wname), {})[int(eidx)] = arr
         else:
             _set_path(params, pth, jnp.asarray(arr))
+
+    # Tied embeddings (tie_word_embeddings=true ships no lm_head.weight): the
+    # unembed projection is the embedding matrix transposed ([V, M] -> [M, V]).
+    root = params.setdefault("model", {})
+    if "lm_head" not in root and "embed_tokens" in root:
+        root["lm_head"] = {"kernel": root["embed_tokens"]["embedding"].T}
 
     # Stack per-expert w1 (gate->wi half), w3 (up->wi half), w2 (down->wo) into
     # the training ExpertFFN bank layout: wi [E, M, 2F] (gate|up), wo [E, F, M].

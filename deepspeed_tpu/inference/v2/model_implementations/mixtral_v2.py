@@ -44,8 +44,13 @@ class MixtralV2Model(LlamaV2Model):
         h = _rms(x, lp["post_attention_layernorm"]["weight"], cfg.rms_norm_eps)
         gate_w, wi, wo = self._moe_params(params, li)
         token_valid = None if batch is None else batch["token_valid"]
+        # Data-dependent gating seed: live token positions differ every decode
+        # step, so simulated-gating routing varies across forwards (the fork's
+        # load-testing intent) without threading a host counter through jit.
+        gate_seed = None if batch is None else jnp.sum(
+            jnp.where(batch["token_valid"], batch["token_pos"], 0)).astype(jnp.int32)
         out = self._moes[li](h, gate_w, wi, wo, token_valid=token_valid,
-                             activation=jax.nn.silu)
+                             activation=jax.nn.silu, gate_seed=gate_seed)
         return x + out.astype(x.dtype)
 
     def layer_forward(self, params, li, x, cache, attn_fn, batch):

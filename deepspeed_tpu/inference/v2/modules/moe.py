@@ -1,17 +1,21 @@
-"""Ragged MoE for inference, with expert parallelism (the fork's core feature).
+"""Ragged MoE for inference, with disaggregated expert parallelism (the fork's
+core feature).
 
 Reference: ``deepspeed/inference/v2/modules/implementations/moe/cutlass_multi_gemm.py``
 (DSMultiGemmMoE:28) and the fork's ``cutlass_multi_gemm_ep.py`` (DSMultiGemmMoEEp:32)
-— top-k gating → moe_scatter → [EP: variable all_to_all x2 for counts+tokens] →
-grouped GEMM → moe_gather → [EP: all_to_all back], with ``empty_run`` participation.
+— top-k gating → moe_scatter → EP all_to_all dispatch → grouped GEMM → all_to_all
+return → moe_gather, with ``empty_run`` participation.
 
-TPU translation: XLA collectives are shape-static, so the fork's *variable-size*
-all-to-alls become fixed-capacity ``lax.all_to_all`` over the ``expert`` mesh axis
-(capacity = ceil(T * k / E) * factor). Dispatch packs each expert's tokens into its
-capacity slots (the reference's moe_scatter), the all_to_all exchanges expert-major
-buffers across EP ranks, each rank runs its local experts' grouped GEMM, and the
-reverse all_to_all + combine weights reproduce moe_gather. ``empty_run`` is a
-forward with zero live tokens: every rank still enters the same collectives —
+TPU formulation of the fork's architecture: each EP replica *owns its own slice of
+the flat token dim* (the reference's per-rank ragged batches). Under ``shard_map``
+over the ``expert`` mesh axis, every replica routes its local tokens, packs them
+into fixed-capacity per-destination-rank buffers (XLA collectives are shape-static,
+so the fork's variable-size ``all_to_all_single`` of counts+tokens
+(cutlass_multi_gemm_ep.py:311,340) becomes one capacity-padded ``lax.all_to_all``),
+runs its local experts' grouped GEMM over tokens received from *all* replicas, and
+a second ``lax.all_to_all`` (cutlass_multi_gemm_ep.py:389) returns results to the
+token owners, where the top-k combine weights are applied. ``empty_run`` is a
+forward with zero live tokens: every replica still enters both collectives —
 exactly the deadlock-avoidance contract of the fork (engine_v2.py:308).
 
 Simulated gating (fork ``top_k_gating/expert_probs.py``): when enabled, router
@@ -19,7 +23,10 @@ logits are replaced by a per-layer synthetic distribution with a temperature kno
 decoupling load-balance experiments from real router weights. The reference ships
 measured Mixtral expert-count tables; we synthesize a skewed per-layer
 distribution from a seeded Dirichlet instead (same knob semantics, no dataset
-dependency), sharpened/flattened by ``softmax(log(p)/temperature)``.
+dependency), sharpened/flattened by ``softmax(log(p)/temperature)``. The draw is
+seeded per (layer, batch, replica): callers thread a data-dependent ``gate_seed``
+(the model passes the sum of live token positions, so successive decode steps
+route differently) and the EP body folds in the replica index.
 """
 
 from typing import Optional
@@ -57,7 +64,7 @@ def simulated_expert_probs(layer_id: int, num_experts: int, temperature: Optiona
 
 
 class RaggedMoE:
-    """Functional top-k MoE over flat tokens [T, M] with optional EP sharding."""
+    """Functional top-k MoE over flat tokens [T, M] with disaggregated EP."""
 
     def __init__(self, num_experts: int, top_k: int = 2, capacity_factor: float = 2.0,
                  expert_axis: str = groups.EXPERT_AXIS, layer_id: int = 0):
@@ -68,49 +75,46 @@ class RaggedMoE:
         self.expert_axis = expert_axis
         self.layer_id = layer_id
 
-    def _router_probs(self, h, gate_w):
+    # ------------------------------------------------------------------ gating --
+    def _router_probs(self, h, gate_w, gate_seed=None, replica=None):
         import jax
         import jax.numpy as jnp
         if simulated_gating_enabled():
             # Load-testing mode: every token draws from the synthetic per-layer
-            # distribution; token index seeds the draw so batches are diverse.
+            # distribution; the batch seed + replica index diversify the draw.
             probs = simulated_expert_probs(self.layer_id, self.num_experts)
             T = h.shape[0]
-            u = jax.random.uniform(jax.random.PRNGKey(self.layer_id), (T, self.num_experts))
+            key = jax.random.PRNGKey(1000 + self.layer_id)
+            if gate_seed is not None:
+                key = jax.random.fold_in(key, gate_seed)
+            if replica is not None:
+                key = jax.random.fold_in(key, replica)
+            u = jax.random.uniform(key, (T, self.num_experts))
             # Gumbel trick over the fixed distribution
             logits = jnp.log(probs)[None, :] - jnp.log(-jnp.log(jnp.maximum(u, 1e-9)))
             return jax.nn.softmax(logits, axis=-1)
         logits = h.astype(jnp.float32) @ gate_w.astype(jnp.float32)
         return jax.nn.softmax(logits, axis=-1)
 
-    def __call__(self, h, gate_w, wi, wo, token_valid=None, activation=None, mesh=None):
-        """h: [T, M]; gate_w: [M, E]; wi: [E, M, F]; wo: [E, F, M] (the training
-        ExpertFFN bank layout — EP-shards on the leading dim)."""
+    # ------------------------------------------------------- capacity packing --
+    def _pack(self, probs, token_valid, C, dtype):
+        """Top-k assignment with capacity packing (reference moe_scatter).
+
+        Returns combine [T, E, C] (f32 routing weights) and dispatch [T, E, C]
+        (0/1 in ``dtype``). Slot counters are SHARED across the k choices
+        (reference top2gating: locations2 += sum(mask1)) — otherwise a
+        first-choice and a second-choice token land in the same capacity slot
+        and their hidden states sum in the expert buffer."""
         import jax
         import jax.numpy as jnp
-        from deepspeed_tpu.sequence.layer import _constrain
 
-        if activation is None:
-            activation = jax.nn.silu
-        T, M = h.shape
-        E = self.num_experts
-        C = max(4, int(np.ceil(T * self.top_k / E * self.capacity_factor)))
-
-        probs = self._router_probs(h, gate_w)  # [T, E]
-        if token_valid is not None:
-            probs = probs * token_valid[:, None]
-
-        # top-k assignment with capacity packing (reference moe_scatter)
+        T, E = probs.shape
         combine = jnp.zeros((T, E, C), jnp.float32)
-        dispatch = jnp.zeros((T, E, C), h.dtype)
+        dispatch = jnp.zeros((T, E, C), dtype)
         topk_p, topk_e = jax.lax.top_k(probs, self.top_k)  # [T, k]
         if self.top_k == 2:
             denom = jnp.maximum(topk_p.sum(-1, keepdims=True), 1e-9)
             topk_p = topk_p / denom  # Mixtral renormalizes over the chosen 2
-        # Slot counters are SHARED across the k choices (reference top2gating:
-        # locations2 += sum(mask1)) — otherwise a first-choice and a
-        # second-choice token land in the same capacity slot and their hidden
-        # states sum in the expert buffer.
         base = jnp.zeros((E, ), jnp.int32)
         for j in range(self.top_k):
             e_j = topk_e[:, j]  # [T]
@@ -126,22 +130,119 @@ class RaggedMoE:
             combine = combine.at[t_idx, e_j, slot_c].add(
                 jnp.where(ok, topk_p[:, j], 0.0), mode="drop")
             dispatch = dispatch.at[t_idx, e_j, slot_c].add(
-                jnp.where(ok, 1.0, 0.0).astype(h.dtype), mode="drop")
+                jnp.where(ok, 1.0, 0.0).astype(dtype), mode="drop")
             base = base + onehot.sum(axis=0)
+        return combine, dispatch
 
-        # dispatch: [E, C, M] expert-major buffer -> the (fixed-capacity) a2a
-        buf = jnp.einsum("tec,tm->ecm", dispatch, h)
-
-        def expert_sharded(t):
-            return _constrain(t, (self.expert_axis, ) + (None, ) * (t.ndim - 1), mesh)
-
-        buf = expert_sharded(buf)  # a2a #2 analog: tokens to expert shards
+    def _expert_ffn(self, buf, wi, wo, activation):
+        """Grouped expert GEMM over an expert-major buffer [E?, C?, M] (the
+        reference's CUTLASS multi-GEMM, moe_gemm.cu:175 role)."""
+        import jax.numpy as jnp
         hpre = jnp.einsum("ecm,emf->ecf", buf, wi.astype(buf.dtype))
         if wi.shape[-1] == 2 * wo.shape[-2]:  # fused (gate|up) SwiGLU bank
             from deepspeed_tpu.moe.layer import gated_expert_act
             hmid = gated_expert_act(hpre, activation)
         else:
             hmid = activation(hpre)
-        out = jnp.einsum("ecf,efm->ecm", hmid, wo.astype(buf.dtype))
-        out = expert_sharded(out)  # a2a #3 analog: results back
+        return jnp.einsum("ecf,efm->ecm", hmid, wo.astype(buf.dtype))
+
+    # ----------------------------------------------------------------- forward --
+    def __call__(self, h, gate_w, wi, wo, token_valid=None, activation=None, mesh=None,
+                 gate_seed=None):
+        """h: [T, M]; gate_w: [M, E]; wi: [E, M, F]; wo: [E, F, M] (the training
+        ExpertFFN bank layout — EP-shards on the leading dim). Dispatches to the
+        disaggregated shard_map path when the mesh has an expert axis > 1."""
+        import jax
+
+        if activation is None:
+            activation = jax.nn.silu
+        if mesh is None:
+            try:
+                mesh = groups.get_mesh()
+            except Exception:
+                mesh = None
+        ep = int(mesh.shape.get(self.expert_axis, 1)) if mesh is not None else 1
+        if ep > 1 and self.num_experts % ep == 0:
+            return self._ep_forward(h, gate_w, wi, wo, token_valid, activation, mesh,
+                                    ep, gate_seed)
+        if ep > 1:
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(f"RaggedMoE: {self.num_experts} experts not divisible by EP "
+                           f"degree {ep}; falling back to GSPMD expert-sharded compute "
+                           f"(no token disaggregation)")
+        return self._dense_forward(h, gate_w, wi, wo, token_valid, activation, gate_seed,
+                                   mesh if ep > 1 else None)
+
+    def _dense_forward(self, h, gate_w, wi, wo, token_valid, activation, gate_seed,
+                       mesh=None):
+        """Single-replica path: all tokens local, no explicit collectives. When a
+        degenerate EP mesh is passed (experts not divisible), the expert buffers
+        are still constraint-sharded so GSPMD partitions the grouped GEMM."""
+        import jax.numpy as jnp
+        from deepspeed_tpu.sequence.layer import _constrain
+
+        T, M = h.shape
+        E = self.num_experts
+        C = max(4, int(np.ceil(T * self.top_k / E * self.capacity_factor)))
+        probs = self._router_probs(h, gate_w, gate_seed=gate_seed)  # [T, E]
+        if token_valid is not None:
+            probs = probs * token_valid[:, None]
+        combine, dispatch = self._pack(probs, token_valid, C, h.dtype)
+        buf = jnp.einsum("tec,tm->ecm", dispatch, h)  # [E, C, M]
+        if mesh is not None:
+            buf = _constrain(buf, (self.expert_axis, None, None), mesh)
+        out = self._expert_ffn(buf, wi, wo, activation)
+        if mesh is not None:
+            out = _constrain(out, (self.expert_axis, None, None), mesh)
         return jnp.einsum("tec,ecm->tm", combine.astype(h.dtype), out)
+
+    def _ep_forward(self, h, gate_w, wi, wo, token_valid, activation, mesh, ep, gate_seed):
+        """Disaggregated EP: each replica owns T/ep tokens and its E/ep experts.
+
+        The fork's data flow (cutlass_multi_gemm_ep.py):
+          1. local top-k routing + capacity packing of OWN tokens
+          2. all_to_all #1: per-destination-replica expert buffers out, every
+             replica's tokens for MY experts in   (ref :311,:340 — counts are
+             subsumed by the static capacity padding)
+          3. local grouped GEMM over [E_local, ep*C] received tokens
+          4. all_to_all #2: results back to token owners (ref :389)
+          5. local combine with the saved top-k weights (moe_gather)
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        ax = self.expert_axis
+        T, M = h.shape
+        E = self.num_experts
+        El = E // ep
+        Tp = -(-T // ep) * ep  # pad so every replica owns the same token count
+        if token_valid is None:
+            token_valid = jnp.ones((T, ), bool)
+        if Tp != T:
+            h = jnp.pad(h, ((0, Tp - T), (0, 0)))
+            token_valid = jnp.pad(token_valid, (0, Tp - T))
+        Tl = Tp // ep
+        C = max(4, int(np.ceil(Tl * self.top_k / E * self.capacity_factor)))
+        seed = jnp.asarray(0 if gate_seed is None else gate_seed, jnp.int32)
+
+        def body(h_l, gate_w, wi_l, wo_l, tv_l, seed_l):
+            replica = jax.lax.axis_index(ax)
+            probs = self._router_probs(h_l, gate_w, gate_seed=seed_l, replica=replica)
+            probs = probs * tv_l[:, None]
+            combine, dispatch = self._pack(probs, tv_l, C, h_l.dtype)
+            buf = jnp.einsum("tec,tm->ecm", dispatch, h_l)       # [E, C, M]
+            buf = buf.reshape(ep, El, C, M)                      # dest-replica major
+            buf = jax.lax.all_to_all(buf, ax, 0, 0, tiled=True)  # a2a #1: dispatch
+            merged = buf.transpose(1, 0, 2, 3).reshape(El, ep * C, M)
+            out = self._expert_ffn(merged, wi_l, wo_l, activation)
+            out = out.reshape(El, ep, C, M).transpose(1, 0, 2, 3)
+            ret = jax.lax.all_to_all(out, ax, 0, 0, tiled=True)  # a2a #2: return
+            ret = ret.reshape(E, C, M)                           # global-expert major
+            return jnp.einsum("tec,ecm->tm", combine.astype(h_l.dtype), ret)
+
+        shmap = jax.shard_map(body, mesh=mesh,
+                              in_specs=(P(ax), P(), P(ax), P(ax), P(ax), P()),
+                              out_specs=P(ax), check_vma=False)
+        out = shmap(h, gate_w, wi, wo, token_valid, seed)
+        return out[:T]

@@ -1,0 +1,148 @@
+"""HF-checkpoint loader round-trip (ADVICE r2: the lm_head path was untested and
+mapped outside the "model" subtree, crashing every build_hf_engine-loaded model).
+
+Strategy: export a tiny training tree to HF tensor naming (the inverse of
+``inference/checkpoint.py``'s mapping), write a safetensors shard + config.json,
+reload with ``load_hf_checkpoint`` and demand the trees match leaf-for-leaf —
+then run the loaded tree through ``build_hf_engine`` and compare logits against
+an engine built directly on the original params."""
+
+import json
+import os
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.checkpoint import load_hf_checkpoint
+from deepspeed_tpu.models.llama import LlamaConfig, init_params as llama_init
+from deepspeed_tpu.models.mixtral import MixtralConfig, init_params as mixtral_init
+from deepspeed_tpu.utils import groups
+
+
+def _hf_config_dict(cfg, model_type):
+    d = dict(model_type=model_type,
+             architectures=[{"llama": "LlamaForCausalLM", "mistral": "MistralForCausalLM",
+                             "mixtral": "MixtralForCausalLM"}[model_type]],
+             vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+             intermediate_size=cfg.intermediate_size,
+             num_hidden_layers=cfg.num_hidden_layers,
+             num_attention_heads=cfg.num_attention_heads,
+             num_key_value_heads=cfg.num_key_value_heads,
+             max_position_embeddings=cfg.max_position_embeddings,
+             rms_norm_eps=cfg.rms_norm_eps, rope_theta=cfg.rope_theta,
+             torch_dtype="float32")
+    if model_type == "mixtral":
+        d["num_local_experts"] = cfg.num_local_experts
+        d["num_experts_per_tok"] = cfg.num_experts_per_tok
+    return d
+
+
+def _export_hf(params, cfg, path, model_type):
+    """Write the training tree as an HF-named safetensors checkpoint."""
+    from safetensors.numpy import save_file
+
+    def _c(x):  # safetensors writes the raw buffer: views must be materialized
+        return np.ascontiguousarray(x)
+    root = params["model"] if "model" in params else params
+    out = {}
+    out["model.embed_tokens.weight"] = _c(np.asarray(root["embed_tokens"]["embedding"]))
+    out["model.norm.weight"] = _c(np.asarray(root["norm"]["weight"]))
+    out["lm_head.weight"] = _c(np.asarray(root["lm_head"]["kernel"]).T)
+    for li in range(cfg.num_hidden_layers):
+        lp = root[f"layers_{li}"]
+        pre = f"model.layers.{li}"
+        out[f"{pre}.input_layernorm.weight"] = _c(np.asarray(lp["input_layernorm"]["weight"]))
+        out[f"{pre}.post_attention_layernorm.weight"] = _c(np.asarray(lp["post_attention_layernorm"]["weight"]))
+        for w in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            out[f"{pre}.self_attn.{w}.weight"] = _c(np.asarray(lp["self_attn"][w]["kernel"]).T)
+        if "mlp" in lp:
+            for w in ("gate_proj", "up_proj", "down_proj"):
+                out[f"{pre}.mlp.{w}.weight"] = _c(np.asarray(lp["mlp"][w]["kernel"]).T)
+        if "block_sparse_moe" in lp:
+            moe = lp["block_sparse_moe"]
+            out[f"{pre}.block_sparse_moe.gate.weight"] = _c(np.asarray(moe["gate"]).T)
+            wi = np.asarray(moe["ExpertFFN_0"]["wi"])  # [E, M, 2F] (gate|up)
+            wo = np.asarray(moe["ExpertFFN_0"]["wo"])  # [E, F, M]
+            F = wo.shape[1]
+            for e in range(wi.shape[0]):
+                out[f"{pre}.block_sparse_moe.experts.{e}.w1.weight"] = _c(wi[e, :, :F].T)
+                out[f"{pre}.block_sparse_moe.experts.{e}.w3.weight"] = _c(wi[e, :, F:].T)
+                out[f"{pre}.block_sparse_moe.experts.{e}.w2.weight"] = _c(wo[e].T)
+    save_file(out, os.path.join(path, "model.safetensors"))
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(_hf_config_dict(cfg, model_type), f)
+
+
+def _assert_trees_equal(a, b, path=""):
+    if path == "":  # both layouts are legal (llama nests under "model", mixtral
+        a = a.get("model", a)  # is flat); _root() normalizes them at runtime
+        b = b.get("model", b)
+    assert set(a) == set(b), f"{path}: {set(a)} != {set(b)}"
+    for k in a:
+        if isinstance(a[k], dict):
+            _assert_trees_equal(a[k], b[k], f"{path}/{k}")
+        else:
+            np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]), rtol=0, atol=0,
+                                       err_msg=f"{path}/{k}")
+
+
+def test_llama_roundtrip(tmp_path):
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    _, params = llama_init(cfg)
+    _export_hf(params, cfg, str(tmp_path), "llama")
+    loaded, loaded_cfg = load_hf_checkpoint(str(tmp_path))
+    assert loaded_cfg.num_hidden_layers == cfg.num_hidden_layers
+    _assert_trees_equal(params, loaded)
+
+
+def test_mixtral_roundtrip(tmp_path):
+    cfg = MixtralConfig.tiny(dtype=jnp.float32)
+    _, params = mixtral_init(cfg)
+    _export_hf(params, cfg, str(tmp_path), "mixtral")
+    loaded, loaded_cfg = load_hf_checkpoint(str(tmp_path))
+    assert loaded_cfg.num_local_experts == cfg.num_local_experts
+    _assert_trees_equal(params, loaded)
+
+
+def test_tied_embeddings(tmp_path):
+    """tie_word_embeddings checkpoints ship no lm_head.weight; the loader must
+    derive the unembed kernel from the embedding (code-review r3 finding #1)."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    _, params = llama_init(cfg)
+    _export_hf(params, cfg, str(tmp_path), "llama")
+    # rewrite the shard without lm_head, as a tied checkpoint would be
+    from safetensors.numpy import load_file, save_file
+    shard = os.path.join(str(tmp_path), "model.safetensors")
+    tensors = load_file(shard)
+    del tensors["lm_head.weight"]
+    save_file(tensors, shard)
+
+    loaded, _ = load_hf_checkpoint(str(tmp_path))
+    got = np.asarray(loaded["model"]["lm_head"]["kernel"])
+    want = np.asarray(params["model"]["embed_tokens"]["embedding"]).T
+    np.testing.assert_array_equal(got, want)
+
+
+def test_build_hf_engine_logits(tmp_path):
+    """End-to-end: the loader's tree must drive the v2 engine (this is the path
+    that crashed with KeyError 'lm_head' before the fix)."""
+    from deepspeed_tpu.inference.v2.engine_factory import build_engine, build_hf_engine
+
+    groups.initialize_mesh(force=True)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    _, params = llama_init(cfg)
+    _export_hf(params, cfg, str(tmp_path), "llama")
+
+    from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.ragged.manager_configs import (AllocationMode,
+                                                                   DSStateManagerConfig,
+                                                                   MemoryConfig)
+    mgr = DSStateManagerConfig(memory_config=MemoryConfig(mode=AllocationMode.ALLOCATE, size=64),
+                               max_context=512)
+    ecfg = RaggedInferenceEngineConfig(state_manager=mgr, kv_block_size=16)
+
+    toks = np.random.default_rng(0).integers(0, cfg.vocab_size, 12)
+    ref = np.asarray(build_engine(params, cfg, ecfg).put([0], [toks]))
+    out = np.asarray(build_hf_engine(str(tmp_path), ecfg).put([0], [toks]))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
