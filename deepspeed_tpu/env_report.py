@@ -1,0 +1,58 @@
+"""``ds_report`` analog: environment / compatibility report.
+
+Reference: ``deepspeed/env_report.py:182`` — prints the op-compat matrix,
+torch/cuda versions and install paths. The TPU report covers what matters
+here: JAX backend + devices, default mesh axes, library versions, and which
+native/pallas subsystems are usable on this backend.
+"""
+
+import importlib
+import sys
+
+
+def _version(mod):
+    try:
+        return importlib.import_module(mod).__version__
+    except Exception:
+        return "not installed"
+
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[93m[NO]\033[0m"
+
+
+def main(argv=None):
+    import deepspeed_tpu
+    print("-" * 60)
+    print("DeepSpeed-TPU C++/JAX environment report")
+    print("-" * 60)
+    print(f"deepspeed_tpu version ... {deepspeed_tpu.__version__}")
+    print(f"python ................. {sys.version.split()[0]}")
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint", "numpy"):
+        print(f"{mod:<22} ... {_version(mod)}")
+    print("-" * 60)
+    try:
+        import jax
+        devs = jax.devices()
+        print(f"backend ................ {jax.default_backend()}")
+        print(f"devices ................ {len(devs)}: {devs[0].device_kind if devs else '-'}")
+        print(f"process count .......... {jax.process_count()}")
+        mems = [m.kind for m in devs[0].addressable_memories()] if devs else []
+        print(f"memory kinds ........... {mems}")
+        print(f"host offload ........... "
+              f"{GREEN_OK if 'pinned_host' in mems else RED_NO}")
+    except Exception as e:
+        print(f"backend ................ ERROR: {e}")
+    print("-" * 60)
+    from deepspeed_tpu.utils import groups
+    print(f"mesh axes .............. {groups.MESH_AXES}")
+    if groups.mesh_is_initialized():
+        print(f"mesh ................... {dict(groups.get_mesh().shape)}")
+    else:
+        print("mesh ................... not initialized (created at engine init)")
+    print("-" * 60)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

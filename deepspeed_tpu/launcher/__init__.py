@@ -1,0 +1,1 @@
+from deepspeed_tpu.launcher.runner import fetch_hostfile, main, parse_resource_filter

@@ -1,0 +1,107 @@
+"""Per-node process spawner.
+
+Reference: ``deepspeed/launcher/launch.py:132`` (main) — one child process per
+local slot, RANK/LOCAL_RANK/MASTER_* env injected, process-tree cleanup on
+signal/failure (reference launch.py:118).
+
+TPU translation: children rendezvous through JAX's coordination service instead
+of torch.distributed; the exported contract is what
+``deepspeed_tpu.comm.init_distributed`` reads — ``DSTPU_COORDINATOR``,
+``DSTPU_NUM_PROCESSES``, ``DSTPU_PROCESS_ID`` (plus the torch-compatible
+RANK/LOCAL_RANK/WORLD_SIZE/MASTER_ADDR/MASTER_PORT aliases).
+"""
+
+import argparse
+import base64
+import json
+import os
+import signal
+import subprocess
+import sys
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(description="per-node dstpu launcher")
+    parser.add_argument("--world_info", type=str, required=True,
+                        help="base64 json {hostname: [global ranks]}")
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--master_addr", type=str, default="127.0.0.1")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--module", action="store_true",
+                        help="run the training script as a python module")
+    parser.add_argument("--no_python", action="store_true",
+                        help="run the training script directly, not via python")
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(argv)
+
+
+def decode_world_info(encoded: str):
+    return json.loads(base64.urlsafe_b64decode(encoded).decode())
+
+
+def encode_world_info(world_info: dict) -> str:
+    return base64.urlsafe_b64encode(json.dumps(world_info).encode()).decode()
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    world_info = decode_world_info(args.world_info)
+    hosts = list(world_info.keys())
+    this_host = hosts[args.node_rank]
+    local_ranks = world_info[this_host]
+    world_size = sum(len(r) for r in world_info.values())
+    coordinator = f"{args.master_addr}:{args.master_port}"
+
+    children = []
+
+    def kill_children(*_):
+        # reference launch.py:118 terminate_process_tree
+        for p in children:
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    p.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, kill_children)
+    signal.signal(signal.SIGTERM, kill_children)
+
+    for local_rank, global_rank in enumerate(local_ranks):
+        env = os.environ.copy()
+        env.update({
+            "DSTPU_COORDINATOR": coordinator,
+            "DSTPU_NUM_PROCESSES": str(world_size),
+            "DSTPU_PROCESS_ID": str(global_rank),
+            # torch-compatible aliases (reference launch.py exports these)
+            "RANK": str(global_rank),
+            "LOCAL_RANK": str(local_rank),
+            "WORLD_SIZE": str(world_size),
+            "LOCAL_SIZE": str(len(local_ranks)),
+            "MASTER_ADDR": args.master_addr,
+            "MASTER_PORT": str(args.master_port),
+        })
+        if args.no_python:
+            cmd = [args.training_script]
+        elif args.module:
+            cmd = [sys.executable, "-m", args.training_script]
+        else:
+            cmd = [sys.executable, "-u", args.training_script]
+        cmd += list(args.training_script_args)
+        logger.info(f"launch: rank {global_rank} (local {local_rank}): {' '.join(cmd)}")
+        children.append(subprocess.Popen(cmd, env=env, start_new_session=True))
+
+    rc = 0
+    for p in children:
+        p.wait()
+        if p.returncode != 0:
+            rc = p.returncode
+            kill_children()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

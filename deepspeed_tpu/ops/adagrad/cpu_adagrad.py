@@ -19,6 +19,7 @@ class AdagradState(NamedTuple):
 class DeepSpeedCPUAdagrad(TpuOptimizer):
 
     name = "adagrad"
+    offload = True  # reference CPU-Adagrad state always lives in host memory
 
     def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0):
         super().__init__(lr=lr, weight_decay=weight_decay)

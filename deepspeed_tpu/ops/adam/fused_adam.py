@@ -86,11 +86,15 @@ class FusedAdam(TpuOptimizer):
 
 
 class DeepSpeedCPUAdam(FusedAdam):
-    """Reference: ops/adam/cpu_adam.py:13 (AVX cpu_adam). With ZeRO-offload the
-    engine keeps optimizer state in host memory and runs this update on the host
-    CPU backend; numerics are identical to FusedAdam."""
+    """Reference: ops/adam/cpu_adam.py:13 (AVX cpu_adam). ``offload = True``
+    tells the engine to build an :class:`~deepspeed_tpu.runtime.zero.offload.
+    OptimizerOffloadPlan`: moments live in pinned host memory between steps and
+    (on TPU) the whole update runs as an XLA host computation — the same
+    grads-down / params-up data flow as the reference's AVX kernel, with
+    identical numerics to FusedAdam."""
 
     name = "cpuadam"
+    offload = True
 
     def __init__(self, *args, adamw_mode=True, fp32_optimizer_states=True, **kwargs):
         kwargs.pop("adam_w_mode", None)

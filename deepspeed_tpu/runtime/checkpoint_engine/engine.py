@@ -125,8 +125,9 @@ def load_engine_state(engine, load_dir, tag, load_optimizer_states=True, load_lr
     restored = ck.load(os.path.join(path, "state"), target=target)
     engine.params = jax.device_put(restored["params"], engine._param_shardings)
     if load_optimizer_states and not load_module_only:
+        # restore straight into the at-rest placement (pinned host when offloaded)
         engine.opt_state = jax.device_put(type(engine.opt_state)(**restored["opt_state"]),
-                                          engine._opt_shardings)
+                                          engine._offload.rest_shardings)
         from deepspeed_tpu.runtime.fp16.loss_scaler import LossScaleState
         engine.scale_state = LossScaleState(**{k: restored["scale_state"][k] for k in ("cur_scale", "good_steps",
                                                                                        "hysteresis")})

@@ -184,7 +184,21 @@ class DeepSpeedEngine:
         opt_base = _broadcast_param_specs(opt_shapes, self.params, self.param_specs) \
             if self.param_specs is not None else None
         self._opt_shardings = self.zero_policy.opt_shardings(opt_shapes, opt_base)
+
+        # ZeRO-Offload: optimizer states in pinned host memory (reference
+        # stage3.py:1816 + partitioned_optimizer_swapper.py:29; cpuadam implies it)
+        from deepspeed_tpu.runtime.zero.offload import OptimizerOffloadPlan
+        offload_cfg = self._config.zero_config.offload_optimizer
+        offload_enabled = getattr(self.optimizer, "offload", False)
+        if offload_cfg is not None and str(offload_cfg.device) != "none":
+            if str(offload_cfg.device) == "nvme":
+                raise NotImplementedError("offload_optimizer.device=nvme is not implemented; "
+                                          "use device=cpu (pinned host memory)")
+            offload_enabled = True
+        self._offload = OptimizerOffloadPlan(self._opt_shardings, offload_enabled, mesh=self.mesh)
+        self._opt_shardings = self._offload.compute_shardings
         self.opt_state = jax.jit(self.optimizer.init, out_shardings=self._opt_shardings)(self.params)
+        self.opt_state = self._offload.stage_out(self.opt_state)
 
         # grad accumulation buffer
         self._grad_shardings = self.zero_policy.grad_shardings(params, self.param_specs)
@@ -492,6 +506,9 @@ class DeepSpeedEngine:
         fp16 = self._fp16
         dynamic = self._dynamic_scale
         fp16_cfg = self._config.fp16_config
+        offload = self._offload
+        param_shardings = self._param_shardings
+        grad_shardings = self._grad_shardings
         gas = self._apply_gas_divisor if self._apply_gas_divisor is not None \
             else float(self.gradient_accumulation_steps())
 
@@ -502,10 +519,10 @@ class DeepSpeedEngine:
             norm = global_norm(grads)
             if clip > 0.0:
                 grads, norm = clip_grads_by_global_norm(grads, clip, norm=norm)
-            new_params, new_opt = optimizer.update(grads, opt_state, params, lr)
+            new_params, new_opt = offload.run_update(optimizer, grads, opt_state, params, lr,
+                                                     param_shardings, grad_shardings,
+                                                     finite=finite if fp16 else None)
             if fp16:
-                new_params = tree_select(finite, new_params, params)
-                new_opt = tree_select(finite, new_opt, opt_state)
                 scale_state = update_scale(scale_state,
                                            ~finite,
                                            scale_window=fp16_cfg.loss_scale_window,
@@ -578,8 +595,10 @@ class DeepSpeedEngine:
         if self.is_gradient_accumulation_boundary():
             assert self.acc_grads is not None, "step() with no accumulated gradients"
             lr = jnp.asarray(self._current_lr, jnp.float32)
+            opt_in = self._offload.stage_in(self.opt_state)
             (self.params, self.opt_state, self.acc_grads, self.scale_state, norm,
-             overflow) = self._apply_fn()(self.params, self.opt_state, self.acc_grads, self.scale_state, lr)
+             overflow) = self._apply_fn()(self.params, opt_in, self.acc_grads, self.scale_state, lr)
+            self.opt_state = self._offload.stage_out(self.opt_state)
             self._global_grad_norm = norm
             self._overflow_count = self._overflow_count + overflow.astype(jnp.int32)
             self.global_steps += 1
@@ -618,9 +637,11 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         import jax.numpy as jnp
         lr = jnp.asarray(self._current_lr, jnp.float32)
+        opt_in = self._offload.stage_in(self.opt_state)
         (self.params, self.opt_state, self.scale_state, loss, norm,
-         overflow) = self._train_batch_fn()(self.params, self.opt_state, self.scale_state, batch,
+         overflow) = self._train_batch_fn()(self.params, opt_in, self.scale_state, batch,
                                             self._next_rng(), lr)
+        self.opt_state = self._offload.stage_out(self.opt_state)
         self._global_grad_norm = norm
         self._overflow_count = self._overflow_count + overflow.astype(jnp.int32)
         self.global_steps += 1

@@ -1,0 +1,171 @@
+"""ZeRO-Offload: optimizer states in host memory.
+
+Reference: ``deepspeed/runtime/zero/stage3.py:1816``
+(``_optimizer_states_and_gradient_swap_in``),
+``swap_tensor/partitioned_optimizer_swapper.py:29`` and the AVX CPU Adam kernel
+(``csrc/adam/cpu_adam.cpp``): optimizer state lives off-accelerator, gradients
+stream down at step time, updated parameters stream back up.
+
+TPU-native formulation: optimizer-state arrays carry the ``pinned_host`` memory
+kind (each chip's *shard* of the ZeRO-partitioned state lives in its host's
+pinned DRAM — the per-rank CPU partitions of the reference). Two execution
+paths, chosen by a capability probe:
+
+- **host-compute** (real TPU): the whole optimizer update runs as an XLA host
+  computation (``compute_on('device_host')``) inside the jitted step; XLA
+  streams gradients device→host and the updated parameters host→device — the
+  reference's exact PCIe data flow, with the update on the host CPU so HBM
+  never materializes the states.
+- **choreography** (backends whose SPMD pipeline lacks in-program memory-space
+  transfers, e.g. the virtual CPU test mesh): states are ``device_put`` to
+  device memory before the jitted step and back to ``pinned_host`` after.
+  Same numerics, same at-rest placement; transfers happen at the dispatch
+  boundary instead of inside the program.
+"""
+
+from deepspeed_tpu.utils.logging import logger
+
+_HOST_COMPUTE_CACHE = {}
+
+
+def backend_supports_host_compute(mesh) -> bool:
+    """Can this backend compile+run host-memory operands and host computations
+    under SPMD on this mesh? (True on TPU; the CPU backend's SPMD partitioner
+    rejects the annotate_device_placement custom call.) Probes the exact
+    pattern the offload step uses: host-resident state in, in-program
+    memory-space transfer, compute_on('device_host') region."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+    from jax.experimental import compute_on
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    key = (jax.default_backend(), tuple(sorted(mesh.shape.items())))
+    if key in _HOST_COMPUTE_CACHE:
+        return _HOST_COMPUTE_CACHE[key]
+    try:
+        s_h = NamedSharding(mesh, P(), memory_kind="pinned_host")
+        s_d = NamedSharding(mesh, P())
+        m0 = jax.device_put(jnp.zeros((8, )), s_h)
+        g0 = jax.device_put(jnp.ones((8, )), s_d)
+
+        @partial(jax.jit, in_shardings=(s_h, s_d), out_shardings=(s_h, s_d))
+        def step(m, g):
+            g_h = jax.device_put(g, s_h)
+            with compute_on.compute_on("device_host"):
+                m2 = m + g_h
+            return m2, jax.device_put(m2, s_d)
+
+        a, b = step(m0, g0)
+        a.block_until_ready()
+        ok = True
+    except Exception:
+        ok = False
+    _HOST_COMPUTE_CACHE[key] = ok
+    return ok
+
+
+def with_memory_kind(shardings, memory_kind: str):
+    """Return the sharding tree with every NamedSharding re-kinded."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    def one(s):
+        if isinstance(s, NamedSharding):
+            return NamedSharding(s.mesh, s.spec, memory_kind=memory_kind)
+        return s
+
+    return jax.tree.map(one, shardings)
+
+
+def host_shardings(shardings):
+    return with_memory_kind(shardings, "pinned_host")
+
+
+def device_shardings(shardings):
+    return with_memory_kind(shardings, "device")
+
+
+def to_memory_kind(tree, shardings):
+    """Outside-jit placement move (works on every backend); one batched
+    device_put dispatch for the whole tree."""
+    import jax
+    return jax.device_put(tree, shardings)
+
+
+class OptimizerOffloadPlan:
+    """Placement + execution plan for offloaded optimizer state.
+
+    ``rest_shardings`` — where the state lives between steps (pinned_host).
+    ``compute_shardings`` — what the compiled step program sees: the same
+    host shardings on the host-compute path (state never enters HBM), device
+    shardings on the choreography path.
+    """
+
+    def __init__(self, opt_shardings, enabled: bool, mesh=None):
+        self.enabled = enabled
+        if not enabled:
+            self.host_compute = False
+            self.rest_shardings = opt_shardings
+            self.compute_shardings = opt_shardings
+            return
+        if mesh is None:
+            import jax
+            mesh = jax.tree.leaves(opt_shardings)[0].mesh
+        self.host_compute = backend_supports_host_compute(mesh)
+        self.rest_shardings = host_shardings(opt_shardings)
+        self.compute_shardings = self.rest_shardings if self.host_compute \
+            else device_shardings(opt_shardings)
+        logger.info(f"ZeRO-Offload optimizer states -> pinned_host "
+                    f"({'XLA host compute' if self.host_compute else 'dispatch-boundary staging'})")
+
+    # -- choreography path (no-ops when host_compute or disabled) ----------------
+    def stage_in(self, opt_state):
+        """Host → device before a compiled step (choreography path only)."""
+        if not self.enabled or self.host_compute:
+            return opt_state
+        return to_memory_kind(opt_state, self.compute_shardings)
+
+    def stage_out(self, opt_state):
+        """Device → host after a compiled step (choreography path only)."""
+        if not self.enabled or self.host_compute:
+            return opt_state
+        return to_memory_kind(opt_state, self.rest_shardings)
+
+    # -- host-compute update wrapper ---------------------------------------------
+    def run_update(self, optimizer, grads, opt_state, params, lr,
+                   param_shardings, grad_shardings, finite=None):
+        """Run ``optimizer.update`` with states in their planned memory space.
+
+        On the host-compute path this is the reference's CPU-Adam data flow:
+        grads and (master) params stream to pinned host memory, the update runs
+        on the host CPU, and the new params stream back to device memory. When
+        ``finite`` is given (fp16 overflow gating) the select also runs on the
+        host, so a skipped step never materializes state in HBM either.
+        """
+        import jax
+        from deepspeed_tpu.runtime.utils import tree_select
+
+        if not (self.enabled and self.host_compute):
+            new_params, new_opt = optimizer.update(grads, opt_state, params, lr)
+            if finite is not None:
+                new_params = tree_select(finite, new_params, params)
+                new_opt = tree_select(finite, new_opt, opt_state)
+            return new_params, new_opt
+
+        from jax.experimental import compute_on
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = jax.tree.leaves(param_shardings)[0].mesh
+        s_scalar_h = NamedSharding(mesh, P(), memory_kind="pinned_host")
+        grads_h = to_memory_kind(grads, host_shardings(grad_shardings))
+        params_h = to_memory_kind(params, host_shardings(param_shardings))
+        lr_h = jax.device_put(lr, s_scalar_h)
+        finite_h = jax.device_put(finite, s_scalar_h) if finite is not None else None
+        with compute_on.compute_on("device_host"):
+            new_params_h, new_opt = optimizer.update(grads_h, opt_state, params_h, lr_h)
+            if finite_h is not None:
+                new_params_h = tree_select(finite_h, new_params_h, params_h)
+                new_opt = tree_select(finite_h, new_opt, opt_state)
+        new_params = to_memory_kind(new_params_h, param_shardings)
+        return new_params, new_opt
