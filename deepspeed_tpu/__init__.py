@@ -58,7 +58,8 @@ def initialize(args=None,
         config = args.deepspeed_config
     assert config is not None, "DeepSpeed requires --deepspeed_config to specify configuration file"
 
-    # Pipeline-parallel models route to the pipeline engine (reference :156-196)
+    # Pipeline-parallel models route to the pipeline engine; hybrid_engine.enabled
+    # routes to the RLHF train↔generate engine (reference :156-196)
     engine_cls = DeepSpeedEngine
     try:
         from deepspeed_tpu.runtime.pipe.module import PipelineModule
@@ -67,6 +68,18 @@ def initialize(args=None,
             engine_cls = PipelineEngine
     except ImportError:
         pass
+    if engine_cls is DeepSpeedEngine:
+        cfg_dict = config
+        if isinstance(config, str):  # JSON config files route too
+            try:
+                import json
+                with open(config) as f:
+                    cfg_dict = json.load(f)
+            except Exception:
+                cfg_dict = {}
+        if isinstance(cfg_dict, dict) and cfg_dict.get("hybrid_engine", {}).get("enabled", False):
+            from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+            engine_cls = DeepSpeedHybridEngine
 
     engine = engine_cls(args=args,
                         model=model,

@@ -43,12 +43,9 @@ class InferenceEngineV2:
             from deepspeed_tpu.inference.v2.modules.moe import enable_simulated_gating
             enable_simulated_gating(engine_config.simulated_gating_temperature)
 
-        if engine_config.expert_parallel.enabled:
-            assert engine_config.tensor_parallel.tp_size == 1, \
-                "TP + EP is currently not supported"  # reference engine_v2.py:85
-
         self._model = model
         self._initialize_comm_groups()
+        self._apply_tensor_parallel()
 
         self._batch = RaggedBatchWrapper(engine_config.state_manager,
                                          block_size=engine_config.kv_block_size)
@@ -78,6 +75,25 @@ class InferenceEngineV2:
                     f"mesh expert axis {mesh.shape[groups.EXPERT_AXIS]} != replica_num {ep}"
         elif tp > 1 or ep > 1:
             groups.initialize_mesh(model_parallel_size=tp, expert_parallel_size=ep)
+
+    def _apply_tensor_parallel(self) -> None:
+        """TP>1 (incl. TP+EP, which the reference rejects at engine_v2.py:85):
+        place the param tree with AutoTP-derived shardings; the SPMD partitioner
+        inserts the per-layer all-reduce the reference's ``LinearAllreduce``
+        modules perform (module_inject/layers.py:16). Expert banks stay sharded
+        only on the expert axis — the EP shard_map path owns their layout."""
+        tp = self._config.tensor_parallel.tp_size
+        if tp <= 1:
+            return
+        import jax
+        from jax.sharding import NamedSharding
+        from deepspeed_tpu.module_inject.auto_tp import auto_tp_specs
+
+        mesh = groups.get_mesh()
+        specs = auto_tp_specs(self._model._params)
+        self._model._params = jax.device_put(
+            self._model._params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs))
+        logger.info(f"inference-v2: AutoTP placed params over model axis (tp={tp})")
 
     # ------------------------------------------------------------ properties --
     @property
@@ -174,6 +190,12 @@ class InferenceEngineV2:
 
     def flush(self, uid: int) -> None:
         self._state_manager.flush_sequence(uid)
+
+    def flush_all(self) -> None:
+        """Recycle every tracked sequence's KV blocks (hybrid-engine post-
+        generation cleanup; reference release_inference_cache role)."""
+        for uid in list(self._state_manager.tracked_sequences):
+            self._state_manager.flush_sequence(uid)
 
     # -------------------------------------------------------------- empty_run --
     def empty_run(self) -> None:

@@ -57,15 +57,16 @@ def main(argv=None):
 
     children = []
 
-    def kill_children(*_):
-        # reference launch.py:118 terminate_process_tree
+    def kill_children(*_, rc=1):
+        # reference launch.py:118 terminate_process_tree; exits with the failed
+        # child's code so schedulers can distinguish failure causes
         for p in children:
             if p.poll() is None:
                 try:
                     os.killpg(os.getpgid(p.pid), signal.SIGTERM)
                 except (ProcessLookupError, PermissionError):
                     p.terminate()
-        sys.exit(1)
+        sys.exit(rc)
 
     signal.signal(signal.SIGINT, kill_children)
     signal.signal(signal.SIGTERM, kill_children)
@@ -94,13 +95,11 @@ def main(argv=None):
         logger.info(f"launch: rank {global_rank} (local {local_rank}): {' '.join(cmd)}")
         children.append(subprocess.Popen(cmd, env=env, start_new_session=True))
 
-    rc = 0
     for p in children:
         p.wait()
         if p.returncode != 0:
-            rc = p.returncode
-            kill_children()
-    sys.exit(rc)
+            kill_children(rc=p.returncode)
+    sys.exit(0)
 
 
 if __name__ == "__main__":

@@ -7,6 +7,7 @@ without cluster access.
 """
 
 import os
+import shlex
 import shutil
 import sys
 from abc import ABC, abstractmethod
@@ -65,7 +66,8 @@ class PDSHRunner(MultiNodeRunner):
 
     def get_cmd(self, environment, active_resources):
         hosts = ",".join(active_resources.keys())
-        env_flags = [f"export {k}={v};" for k, v in self.exports(environment).items()]
+        env_flags = [f"export {k}={shlex.quote(str(v))};"
+                     for k, v in self.exports(environment).items()]
         launch = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
                   "--world_info", encode_world_info(self.world_info),
                   "--node_rank", "%n",
@@ -75,7 +77,7 @@ class PDSHRunner(MultiNodeRunner):
             launch.append("--module")
         if getattr(self.args, "no_python", False):
             launch.append("--no_python")
-        launch += [self.user_script] + self.user_arguments
+        launch += [self.user_script] + [shlex.quote(a) for a in self.user_arguments]
         return ["pdsh", "-S", "-f", "1024", "-w", hosts] + env_flags + launch
 
 
@@ -89,10 +91,12 @@ class SSHRunner(MultiNodeRunner):
         return shutil.which("ssh") is not None
 
     def get_cmd_for_node(self, environment, host, node_rank):
-        env_flags = [f"export {k}={v};" for k, v in self.exports(environment).items()]
+        env_flags = [f"export {k}={shlex.quote(str(v))};"
+                     for k, v in self.exports(environment).items()]
         launch = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch"] \
             + self._launch_args(node_rank)
-        return ["ssh", "-o", "StrictHostKeyChecking=no", host] + env_flags + launch
+        return ["ssh", "-o", "StrictHostKeyChecking=no", host] \
+            + env_flags + [shlex.quote(a) for a in launch]
 
     def get_cmd(self, environment, active_resources):
         return [self.get_cmd_for_node(environment, h, i)
@@ -111,14 +115,22 @@ class SlurmRunner(MultiNodeRunner):
         srun = ["srun", "--nodes", str(nnodes), "--ntasks-per-node", "1"]
         if getattr(self.args, "slurm_comment", ""):
             srun += ["--comment", self.args.slurm_comment]
-        # SLURM_NODEID is expanded by a shell wrapper on each task
+        env_flags = [f"export {k}={shlex.quote(str(v))};"
+                     for k, v in self.exports(environment).items()]
+        # SLURM_NODEID is expanded by a shell wrapper on each task; everything
+        # else (incl. --module/--no_python and user args) goes through the same
+        # _launch_args path as the other runners
         launch = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
                   "--world_info", encode_world_info(self.world_info),
                   "--node_rank", "$SLURM_NODEID",
                   "--master_addr", self.args.master_addr,
-                  "--master_port", str(self.args.master_port),
-                  self.user_script] + self.user_arguments
-        return srun + ["bash", "-c", " ".join(launch)]
+                  "--master_port", str(self.args.master_port)]
+        if getattr(self.args, "module", False):
+            launch.append("--module")
+        if getattr(self.args, "no_python", False):
+            launch.append("--no_python")
+        launch += [self.user_script] + [shlex.quote(a) for a in self.user_arguments]
+        return srun + ["bash", "-c", " ".join(env_flags) + " " + " ".join(launch)]
 
 
 class LocalRunner(MultiNodeRunner):

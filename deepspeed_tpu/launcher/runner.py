@@ -62,7 +62,7 @@ def fetch_hostfile(path):
             try:
                 host, slots = line.split()
                 n = int(slots.split("=")[1])
-            except ValueError as e:
+            except (ValueError, IndexError) as e:
                 raise ValueError(f"hostfile line not '<host> slots=<n>': {line!r}") from e
             if host in pool:
                 raise ValueError(f"host {host} repeated in hostfile")

@@ -205,23 +205,8 @@ def init_params(cfg: LlamaConfig, rng=None, batch_size=1, seq_len=None):
 
 
 def llama_param_specs(params, model_axis=groups.MODEL_AXIS):
-    """Megatron-style TP placement over the ``model`` axis: column-parallel
-    q/k/v/gate/up (+embed, lm_head), row-parallel o_proj/down_proj. The reference
-    gets this from megatron mpu / AutoTP (module_inject/auto_tp.py:188)."""
-    from jax.sharding import PartitionSpec as P
-
-    COL = {"q_proj", "k_proj", "v_proj", "gate_proj", "up_proj", "lm_head"}
-    ROW = {"o_proj", "down_proj"}
-
-    def spec(path, leaf):
-        names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
-        if leaf.ndim == 2:
-            if any(n in COL for n in names):
-                return P(None, model_axis)
-            if any(n in ROW for n in names):
-                return P(model_axis, None)
-            if "embed_tokens" in names:
-                return P(None, model_axis)
-        return P()
-
-    return jax.tree_util.tree_map_with_path(spec, params)
+    """Megatron-style TP placement over the ``model`` axis, derived structurally
+    by AutoTP: column-parallel q/k/v/gate/up (+embed, lm_head), row-parallel
+    o_proj/down_proj (reference module_inject/auto_tp.py:188)."""
+    from deepspeed_tpu.module_inject.auto_tp import auto_tp_specs
+    return auto_tp_specs(params, model_axis=model_axis)

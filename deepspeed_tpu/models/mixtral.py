@@ -107,21 +107,7 @@ def init_params(cfg: MixtralConfig, rng=None, batch_size=1, seq_len=16):
 
 
 def mixtral_param_specs(params, model_axis=groups.MODEL_AXIS, expert_axis=groups.EXPERT_AXIS):
-    """TP over attention/lm_head + EP over expert banks."""
-    from jax.sharding import PartitionSpec as P
-
-    COL = {"q_proj", "k_proj", "v_proj", "lm_head"}
-    ROW = {"o_proj"}
-
-    def spec(path, leaf):
-        names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
-        if any(n in ("wi", "wo") for n in names) and leaf.ndim >= 1:
-            return P(expert_axis, *([None] * (leaf.ndim - 1)))
-        if leaf.ndim == 2:
-            if any(n in COL for n in names):
-                return P(None, model_axis)
-            if any(n in ROW for n in names):
-                return P(model_axis, None)
-        return P()
-
-    return jax.tree_util.tree_map_with_path(spec, params)
+    """TP over attention/embed/lm_head + EP over the stacked expert banks,
+    derived structurally by AutoTP (reference module_inject/auto_tp.py:188)."""
+    from deepspeed_tpu.module_inject.auto_tp import auto_tp_specs
+    return auto_tp_specs(params, model_axis=model_axis, expert_axis=expert_axis)

@@ -1,0 +1,134 @@
+"""AutoTP: derive tensor-parallel shardings from the parameter tree alone.
+
+Reference: ``deepspeed/module_inject/auto_tp.py:188`` (AutoTP) — policy-free TP
+by module-graph analysis: find the linears, classify "all-reduce linears"
+(row-parallel, their output re-enters the residual stream) vs column-parallel,
+shard weights and insert collectives (``replace_module.py:182``).
+
+TPU translation: the "module graph" is the parameter pytree. Flax parameter
+dicts preserve *call order*, so each transformer sub-block (attention, MLP)
+appears as a dict of kernels in execution order, and the reference's graph
+walk becomes tree analysis:
+
+- the LAST kernel in a multi-kernel block whose output width equals the
+  residual width is the reference's all-reduce linear → row-parallel
+  ``P(model, None)``; every kernel before it is column-parallel
+  ``P(None, model)``;
+- a single-kernel block is the unembedding iff its output width is the vocab
+  size → column-parallel; otherwise (e.g. MoE router gates) replicated;
+- embeddings (flax ``nn.Embed`` leaves named ``embedding``) shard their
+  feature dim;
+- stacked expert banks (ndim ≥ 3) shard their leading (expert) dim on the
+  expert axis — the reference handles these through EP groups, not TP;
+- 1-D leaves (norms, biases) stay replicated: under GSPMD a replicated bias
+  adds onto a sharded activation without correctness or extra-collective cost.
+
+No collective insertion is needed at all — the XLA SPMD partitioner derives
+the all-reduce after each row-parallel matmul from the shardings (the
+reference's ``LinearAllreduce`` forward, module_inject/layers.py:16).
+"""
+
+from typing import Optional
+
+import jax
+
+from deepspeed_tpu.utils import groups
+
+
+def _names(path):
+    return [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+
+
+def _is_leaf_dict(d):
+    return isinstance(d, dict) and all(not isinstance(v, dict) for v in d.values())
+
+
+def _direct_kernels(node):
+    """2-D kernels owned by this block, in call order: direct 2-D leaf children,
+    or the 2-D leaves of leaf-only child dicts (flax ``Dense_0/{kernel,bias}``)."""
+    out = []
+    for name, child in node.items():
+        if isinstance(child, dict):
+            if _is_leaf_dict(child):
+                for lname, leaf in child.items():
+                    if lname != "embedding" and getattr(leaf, "ndim", 0) == 2:
+                        out.append(((name, lname), leaf))
+        elif name != "embedding" and getattr(child, "ndim", 0) == 2:
+            out.append(((name, ), child))
+    return out
+
+
+def auto_tp_specs(params, model_axis: str = groups.MODEL_AXIS,
+                  expert_axis: str = groups.EXPERT_AXIS,
+                  hidden_size: Optional[int] = None,
+                  vocab_size: Optional[int] = None):
+    """Return a PartitionSpec pytree mirroring ``params`` (reference AutoTP:188).
+
+    ``hidden_size``/``vocab_size`` are inferred from the embedding leaf when
+    not given."""
+    from jax.sharding import PartitionSpec as P
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    # residual + vocab width from the embeddings ([num_embeddings, features]);
+    # the vocab table is the largest one (position tables are much smaller)
+    if hidden_size is None or vocab_size is None:
+        embeds = [l for p, l in flat
+                  if _names(p)[-1] == "embedding" and getattr(l, "ndim", 0) == 2]
+        if embeds:
+            biggest = max(embeds, key=lambda l: l.shape[0])
+            vocab_size = vocab_size or biggest.shape[0]
+            hidden_size = hidden_size or biggest.shape[1]
+    if hidden_size is None:
+        # fallback: the most common output width among 2-D kernels
+        from collections import Counter
+        widths = Counter(l.shape[1] for _, l in flat if getattr(l, "ndim", 0) == 2)
+        hidden_size = widths.most_common(1)[0][0] if widths else -1
+
+    # classify kernels block by block
+    cls = {}  # id(leaf) -> "col" | "row"
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return
+        kernels = _direct_kernels(node)
+        if len(kernels) >= 2:
+            # Scan in call order, segmenting into col*→row sandwiches: a kernel
+            # that projects back to the residual width and is preceded by at
+            # least one column kernel in its segment is the all-reduce linear
+            # (handles flat blocks holding both the attention and MLP pairs).
+            seg_has_col = False
+            for _, leaf in kernels:
+                if leaf.shape[1] == hidden_size and seg_has_col:
+                    cls[id(leaf)] = "row"
+                    seg_has_col = False
+                else:
+                    cls[id(leaf)] = "col"
+                    seg_has_col = True
+        elif len(kernels) == 1:
+            leaf = kernels[0][1]
+            if vocab_size is not None and leaf.shape[1] == vocab_size:
+                cls.setdefault(id(leaf), "col")  # unembedding / lm_head
+        # leaf-only children belong to THIS block; only recurse into structure
+        for child in node.values():
+            if isinstance(child, dict) and not _is_leaf_dict(child):
+                walk(child)
+
+    walk(params)
+
+    def spec(path, leaf):
+        names = _names(path)
+        ndim = getattr(leaf, "ndim", 0)
+        if names[-1] == "embedding" and ndim == 2:
+            return P(None, model_axis)
+        if ndim >= 3:  # stacked expert bank → EP shard on the expert dim
+            return P(expert_axis, *([None] * (ndim - 1)))
+        if ndim == 2:
+            kind = cls.get(id(leaf))
+            if kind == "col":
+                return P(None, model_axis)
+            if kind == "row":
+                return P(model_axis, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)

@@ -144,6 +144,8 @@ class DeepSpeedConfig:
             **pd.get("activation_checkpointing", {}))
         self.monitor_config = DeepSpeedMonitorConfig(**pd.get("monitor", pd))
         self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(**pd.get("flops_profiler", {}))
+        from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngineConfig
+        self.hybrid_engine_config = DeepSpeedHybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.comms_config = CommsConfig(**pd.get("comms_logger", {}))
         self.checkpoint_config = CheckpointConfig(**pd.get(C.CHECKPOINT, {}))
         self.data_types_config = DataTypesConfig(**pd.get(C.DATA_TYPES, {}))

@@ -161,19 +161,36 @@ class DeepSpeedEngine:
 
         # 7. parameters (master fp32, placed per policy)
         if model_parameters is None and example_batch is not None and hasattr(model, "init"):
-            # materialize flax params from the example batch (pipeline engines do
-            # the same; shapes are static under XLA anyway)
+            # Sharded-at-birth init (reference zero.Init, partition_parameters.py:786):
+            # eval_shape gives the abstract tree, the ZeRO policy assigns shardings,
+            # and a single jitted init materializes every parameter directly into
+            # its shard — the full tree never exists on the host, so a 7B model
+            # under ZeRO-3 costs O(shard) host/device memory at init.
             self._rng, sub = jax.random.split(self._rng)
-            model_parameters = model.init(sub, example_batch)["params"]
-        if model_parameters is None:
+            master_dtype = self.master_dtype
+            try:
+                def _born_sharded_init(rng):
+                    return cast_tree(model.init(rng, example_batch)["params"], master_dtype)
+
+                abstract = jax.eval_shape(_born_sharded_init, sub)
+                self._param_shardings = self.zero_policy.param_shardings(abstract, self.param_specs)
+                self.params = jax.jit(_born_sharded_init,
+                                      out_shardings=self._param_shardings)(sub)
+            except Exception as e:
+                # non-traceable init (e.g. host-side setup): eager fallback
+                logger.warning(f"sharded-at-birth init unavailable ({e}); "
+                               f"materializing params eagerly")
+                model_parameters = model.init(sub, example_batch)["params"]
+        if model_parameters is None and not hasattr(self, "params"):
             raise ValueError("model_parameters (the initial parameter pytree) is required "
                              "(or pass example_batch with a flax model to init in-engine)")
-        params = cast_tree(model_parameters, self.master_dtype)
-        self._param_shardings = self.zero_policy.param_shardings(params, self.param_specs)
-        # jit-copy (not plain device_put): the step donates param buffers, and the
-        # caller's pytree must never alias them.
-        self.params = jax.jit(lambda p: jax.tree.map(jax.numpy.asarray, p),
-                              out_shardings=self._param_shardings)(params)
+        if model_parameters is not None:
+            params = cast_tree(model_parameters, self.master_dtype)
+            self._param_shardings = self.zero_policy.param_shardings(params, self.param_specs)
+            # jit-copy (not plain device_put): the step donates param buffers, and
+            # the caller's pytree must never alias them.
+            self.params = jax.jit(lambda p: jax.tree.map(jax.numpy.asarray, p),
+                                  out_shardings=self._param_shardings)(params)
 
         # 8. optimizer (reference _configure_optimizer, engine.py:1219)
         if optimizer is not None and not isinstance(optimizer, str):
@@ -201,7 +218,7 @@ class DeepSpeedEngine:
         self.opt_state = self._offload.stage_out(self.opt_state)
 
         # grad accumulation buffer
-        self._grad_shardings = self.zero_policy.grad_shardings(params, self.param_specs)
+        self._grad_shardings = self.zero_policy.grad_shardings(self.params, self.param_specs)
         self._grad_accum_dtype = {
             None: self.master_dtype,
             "fp32": jnp.float32,
@@ -246,6 +263,7 @@ class DeepSpeedEngine:
         dist.configure(self._config)
 
         self._compiled = {}
+        self._flops_profiled = False
         see_memory_usage("DeepSpeedEngine init complete", force=self._config.memory_breakdown)
 
     # ------------------------------------------------------------------ setup --
@@ -564,6 +582,7 @@ class DeepSpeedEngine:
                 self._compiled.pop("eval_fallback", None)
             self.timers(FORWARD_MICRO_TIMER).stop()
             return loss
+        self._maybe_profile_flops(batch)
         rng = self._next_rng()
         loss, grads = self._grad_fn()(self.params, batch, rng, self.scale_state.cur_scale)
         self._cached_grads = grads
@@ -621,6 +640,34 @@ class DeepSpeedEngine:
             self.lr_scheduler.step(**lr_kwargs)
             self._current_lr = self.lr_scheduler.get_last_lr()[0]
 
+    def _maybe_profile_flops(self, batch, micro_stacked=False):
+        """Print the flops profile at ``profile_step`` (reference engine.py:1793
+        triggers the profiler inside forward)."""
+        cfg = self._config.flops_profiler_config
+        if not cfg.enabled or self._flops_profiled or self.global_steps < cfg.profile_step:
+            return
+        self._flops_profiled = True
+        if micro_stacked:  # [gas, micro, ...] → one microbatch
+            import jax
+            batch = jax.tree.map(lambda x: x[0], batch)
+        try:
+            import flax.linen as _nn
+            if not isinstance(self.module, _nn.Module):
+                logger.warning("flops profiler: model is not a flax module; skipping")
+                return
+            from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+            prof = FlopsProfiler(self.module, ds_engine=self,
+                                 recompute_fwd_factor=cfg.recompute_fwd_factor)
+            prof.start_profile(None, batch)
+            prof.print_model_profile(profile_step=cfg.profile_step,
+                                     module_depth=cfg.module_depth,
+                                     top_modules=cfg.top_modules,
+                                     detailed=cfg.detailed,
+                                     output_file=cfg.output_file)
+            prof.end_profile()
+        except Exception as e:
+            logger.warning(f"flops profiler failed: {e}")
+
     def train_batch(self, data_iter=None, batch=None):
         """Fused path: full global batch [gas*micro_global, ...] (or an iterator
         yielding micro-batches) → one jitted accumulate+step program."""
@@ -634,6 +681,7 @@ class DeepSpeedEngine:
             batch = jax.tree.map(lambda x: np.asarray(x).reshape((gas, -1) + np.asarray(x).shape[1:]), batch)
         batch = jax.tree.map(
             lambda l: jax.device_put(l, self._micro_stack_sharding(l)), batch)
+        self._maybe_profile_flops(batch, micro_stacked=True)
         self.tput_timer.start()
         import jax.numpy as jnp
         lr = jnp.asarray(self._current_lr, jnp.float32)

@@ -1,0 +1,110 @@
+"""Hybrid engine: the RLHF train ↔ generate flip.
+
+Reference: ``deepspeed/runtime/hybrid_engine.py:32`` (DeepSpeedHybridEngine) —
+DS-Chat's engine that trains under ZeRO-3 and flips to injected inference
+kernels for the generation phase, sweating LoRA fuse/unfuse (:138-152),
+inference-container weight sharing (:161) and per-layer param gathers
+(``_zero3_forward:363``).
+
+TPU-native: the flip is nearly free. Training params and the inference-v2
+model read the *same pytree layout*, so ``generate()`` builds (once) an
+:class:`InferenceEngineV2` whose params are a jit-cast view of the live
+training masters — re-cast only when the step counter moved. No module
+surgery, no gather loops: XLA reshards fp32 ZeRO shards → replicated/TP
+compute-dtype arrays in one program. KV-cache blocks are allocated by the
+engine at build and recycled by ``flush`` after every generation
+(reference's ``release_inference_cache`` semantics).
+"""
+
+from typing import Optional, Sequence
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedHybridEngineConfig(DeepSpeedConfigModel):
+    """Reference: ``deepspeed/runtime/config.py`` hybrid_engine block."""
+    enabled: bool = False
+    max_out_tokens: int = Field(512, ge=1)
+    inference_tp_size: int = Field(1, ge=1)
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = Field(8, ge=1)
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+    """Training engine + in-place generation over the live parameters."""
+
+    def __init__(self, *args, model_config=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._he_config = self._config.hybrid_engine_config
+        self._model_config = model_config if model_config is not None \
+            else getattr(self.module, "cfg", None)
+        self._inference_engine = None
+        self._inference_params_step = -1
+        self._cast_fn = None
+
+    # ------------------------------------------------------------ param share --
+    def _inference_params(self):
+        """Live training masters → inference dtype, same tree (the copy the
+        reference's inference containers exist to avoid is one XLA cast here)."""
+        import jax
+        if self._cast_fn is None:
+            dtype = getattr(self._model_config, "dtype", self.compute_dtype)
+            self._cast_fn = jax.jit(lambda p: jax.tree.map(lambda x: x.astype(dtype), p))
+        return self._cast_fn(self.params)
+
+    def _get_inference_engine(self):
+        from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+        from deepspeed_tpu.inference.v2.engine_factory import build_engine
+        from deepspeed_tpu.inference.v2.ragged.manager_configs import (AllocationMode,
+                                                                       DSStateManagerConfig,
+                                                                       MemoryConfig)
+
+        if self._model_config is None:
+            raise ValueError("hybrid engine needs the model config (pass model_config= or "
+                             "use a module exposing .cfg, e.g. LlamaForCausalLM)")
+        if self._inference_engine is None:
+            he = self._he_config
+            blocks = max(8, (2 * he.max_out_tokens) // 16)
+            mgr = DSStateManagerConfig(memory_config=MemoryConfig(mode=AllocationMode.ALLOCATE,
+                                                                  size=blocks),
+                                       max_context=he.max_out_tokens)
+            ecfg = RaggedInferenceEngineConfig(state_manager=mgr, kv_block_size=16)
+            self._inference_engine = build_engine(self._inference_params(), self._model_config,
+                                                  ecfg)
+            self._inference_params_step = self.global_steps
+            logger.info(f"hybrid engine: built inference engine "
+                        f"(max_out_tokens={he.max_out_tokens}, kv blocks={blocks})")
+        elif self._inference_params_step != self.global_steps:
+            # weights moved: re-cast the live masters into the existing engine
+            self._inference_engine._model._params = self._inference_params()
+            self._inference_params_step = self.global_steps
+        return self._inference_engine
+
+    # --------------------------------------------------------------- generate --
+    def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 16,
+                 temperature: float = 0.0, eos_token_id: Optional[int] = None, seed: int = 0):
+        """Reference hybrid_engine.py:174 — generation over the live weights.
+        Returns a list of generated token lists; KV blocks are recycled after."""
+        from deepspeed_tpu.inference.v2 import engine_factory
+
+        was_training = self.training
+        self.eval()
+        engine = self._get_inference_engine()
+        try:
+            return engine_factory.generate(engine, prompts, max_new_tokens=max_new_tokens,
+                                           temperature=temperature, eos_token_id=eos_token_id,
+                                           seed=seed)
+        finally:
+            engine.flush_all()
+            if self._he_config.release_inference_cache:
+                self._inference_engine = None
+            self.train(was_training)
+
+    @property
+    def inference_engine(self):
+        return self._get_inference_engine()

@@ -1,0 +1,65 @@
+"""Inference-v2 tensor parallelism (AutoTP-placed params, GSPMD collectives).
+
+Reference: v1 AutoTP inference (module_inject/auto_tp.py:188); the fork's
+engine_v2.py:85 *rejects* TP+EP — supporting the combination is a
+capability-beyond-parity item from VERDICT r2 #6."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.config_v2 import (DeepSpeedEPConfig, DeepSpeedTPConfig,
+                                                  RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.engine_factory import build_engine
+from deepspeed_tpu.inference.v2.ragged.manager_configs import (AllocationMode,
+                                                               DSStateManagerConfig,
+                                                               MemoryConfig)
+from deepspeed_tpu.models.llama import LlamaConfig, init_params as llama_init
+from deepspeed_tpu.models.mixtral import MixtralConfig, init_params as mixtral_init
+from deepspeed_tpu.utils import groups
+
+
+def _ecfg(tp=1, ep=0):
+    mgr = DSStateManagerConfig(memory_config=MemoryConfig(mode=AllocationMode.ALLOCATE, size=64),
+                               max_context=512)
+    cfg = RaggedInferenceEngineConfig(state_manager=mgr, kv_block_size=16,
+                                      tensor_parallel=DeepSpeedTPConfig(tp_size=tp))
+    if ep:
+        cfg.expert_parallel = DeepSpeedEPConfig(enabled=True, replica_num=ep,
+                                                capacity_factor=4.0)
+    return cfg
+
+
+def test_tp_llama_matches_single():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    _, params = llama_init(cfg)
+    seqs = {0: np.random.default_rng(0).integers(0, cfg.vocab_size, 19),
+            1: np.random.default_rng(1).integers(0, cfg.vocab_size, 7)}
+
+    groups.initialize_mesh(force=True)
+    ref = np.asarray(build_engine(params, cfg, _ecfg()).put(list(seqs), list(seqs.values())))
+
+    groups.initialize_mesh(model_parallel_size=2, force=True)
+    eng = build_engine(params, cfg, _ecfg(tp=2))
+    leaves = jax.tree.leaves(eng.model._params)
+    assert any(not l.sharding.is_fully_replicated for l in leaves), "TP must shard params"
+    out = np.asarray(eng.put(list(seqs), list(seqs.values())))
+    np.testing.assert_allclose(out, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_tp_plus_ep_mixtral():
+    """TP=2 x EP=2 on the 8-device mesh — the combination the reference fork
+    asserts out (engine_v2.py:85)."""
+    cfg = MixtralConfig.tiny(dtype=jnp.float32)
+    _, params = mixtral_init(cfg)
+    seqs = {0: np.random.default_rng(2).integers(0, cfg.vocab_size, 12)}
+
+    groups.initialize_mesh(force=True)
+    ref = np.asarray(build_engine(params, cfg, _ecfg()).put(list(seqs), list(seqs.values())))
+
+    groups.initialize_mesh(model_parallel_size=2, expert_parallel_size=2, force=True)
+    eng = build_engine(params, cfg, _ecfg(tp=2, ep=2))
+    out = np.asarray(eng.put(list(seqs), list(seqs.values())))
+    np.testing.assert_allclose(out, ref, rtol=3e-4, atol=3e-4)

@@ -1,0 +1,107 @@
+"""AutoTP: structural TP-spec derivation (reference module_inject/auto_tp.py:188
++ tests/unit/model_parallelism)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, init_params as gpt2_init
+from deepspeed_tpu.models.llama import LlamaConfig, init_params as llama_init
+from deepspeed_tpu.models.mixtral import MixtralConfig, init_params as mixtral_init
+from deepspeed_tpu.module_inject.auto_tp import auto_tp_specs
+from deepspeed_tpu.utils import groups
+
+
+def _by_path(specs):
+    return {jtu.keystr(k): v for k, v in jtu.tree_flatten_with_path(specs)[0]}
+
+
+def test_llama_matches_hand_written():
+    """VERDICT r3 'done' criterion: auto specs == the (former) hand-written
+    llama mapping, leaf for leaf."""
+    _, params = llama_init(LlamaConfig.tiny(dtype=jnp.float32))
+    got = _by_path(auto_tp_specs(params))
+
+    COL = {"q_proj", "k_proj", "v_proj", "gate_proj", "up_proj", "lm_head"}
+    ROW = {"o_proj", "down_proj"}
+    for path, spec in got.items():
+        if "embedding" in path:
+            assert spec == P(None, "model"), path
+        elif any(f"'{n}'" in path for n in COL) and "kernel" in path:
+            assert spec == P(None, "model"), path
+        elif any(f"'{n}'" in path for n in ROW) and "kernel" in path:
+            assert spec == P("model", None), path
+        else:
+            assert spec == P(), path
+
+
+def test_mixtral_expert_banks_and_attention():
+    _, params = mixtral_init(MixtralConfig.tiny(dtype=jnp.float32))
+    got = _by_path(auto_tp_specs(params))
+    assert got["['layers_0']['block_sparse_moe']['ExpertFFN_0']['wi']"] == P("expert", None, None)
+    assert got["['layers_0']['block_sparse_moe']['ExpertFFN_0']['wo']"] == P("expert", None, None)
+    # router gate must NOT be TP-sharded (its output dim is num_experts)
+    assert got["['layers_0']['block_sparse_moe']['gate']"] == P()
+    assert got["['layers_0']['self_attn']['o_proj']['kernel']"] == P("model", None)
+    assert got["['layers_0']['self_attn']['q_proj']['kernel']"] == P(None, "model")
+
+
+def test_gpt2_flat_blocks():
+    """GPT-2 keeps attention and MLP pairs in ONE flat dict per layer; the
+    segment scan must find both all-reduce linears."""
+    _, params = gpt2_init(GPT2Config.tiny(dtype=jnp.float32))
+    got = _by_path(auto_tp_specs(params))
+    assert got["['h_0']['c_attn']['kernel']"] == P(None, "model")
+    assert got["['h_0']['c_proj']['kernel']"] == P("model", None)
+    assert got["['h_0']['c_fc']['kernel']"] == P(None, "model")
+    assert got["['h_0']['mlp_c_proj']['kernel']"] == P("model", None)
+    assert got["['wte']['embedding']"] == P(None, "model")
+
+
+@pytest.mark.parametrize("model_name", ["llama", "gpt2"])
+def test_tp_training_parity(model_name):
+    """Training with auto-derived TP specs on a model=2 mesh must match the
+    unsharded run (the reference's configurable-parallelism resize tests)."""
+    if model_name == "llama":
+        cfg = LlamaConfig.tiny(dtype=jnp.float32)
+        from deepspeed_tpu.models.llama import LlamaForCausalLM as Model
+    else:
+        cfg = GPT2Config.tiny(dtype=jnp.float32)
+        from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel as Model
+    model = Model(cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(8, 16)).astype(np.int32)
+    batch = (ids, ids.copy())
+
+    # gpt2's replicated c_attn bias gets near-zero grads whose Adam updates are
+    # sign-sensitive to reduction order; SGD keeps that leg's comparison tight
+    # while llama covers the adaptive-optimizer path (fwd loss is bit-equal in
+    # both — verified when this test was introduced).
+    opt = {"type": "AdamW", "params": {"lr": 1e-3}} if model_name == "llama" \
+        else {"type": "sgd", "params": {"lr": 1e-2}}
+    ds_cfg = {"train_micro_batch_size_per_gpu": 2,
+              "optimizer": opt,
+              "zero_optimization": {"stage": 0}}
+
+    groups.initialize_mesh(force=True)
+    _, params0 = (llama_init(cfg) if model_name == "llama" else gpt2_init(cfg))
+    ref, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0, config=ds_cfg)
+    for _ in range(2):
+        ref.train_batch(batch=batch)
+
+    groups.initialize_mesh(model_parallel_size=2, force=True)
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0, config=ds_cfg,
+                                            param_specs=auto_tp_specs(params0))
+    sharded = [l for l in jax.tree.leaves(eng.params) if not l.sharding.is_fully_replicated]
+    assert sharded, "TP specs must actually shard parameters"
+    for _ in range(2):
+        eng.train_batch(batch=batch)
+
+    for a, b in zip(jax.tree.leaves(jax.device_get(eng.params)),
+                    jax.tree.leaves(jax.device_get(ref.params))):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)

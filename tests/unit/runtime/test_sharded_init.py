@@ -1,0 +1,88 @@
+"""Sharded-at-birth parameter init (the zero.Init analog).
+
+Reference: ``deepspeed/runtime/zero/partition_parameters.py:786`` (zero.Init) —
+parameters are partitioned at construction so the full model never
+materializes per-rank. Here: ``engine(example_batch=...)`` jit-inits straight
+into the ZeRO shardings; the test instruments the module to prove init only
+ever ran under trace (no eager host materialization) and that stage-3 leaves
+come out sharded."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+import deepspeed_tpu
+from deepspeed_tpu.utils import groups
+
+HIDDEN = 32
+CALLS = {"eager": 0, "traced": 0}
+
+
+class Probe(nn.Module):
+    """Records whether __call__ executes eagerly or under trace."""
+
+    @nn.compact
+    def __call__(self, batch):
+        x, y = batch
+        if isinstance(jnp.asarray(0.0) + 0.0, jax.core.Tracer) or isinstance(x, jax.core.Tracer):
+            CALLS["traced"] += 1
+        else:
+            CALLS["eager"] += 1
+        h = nn.Dense(HIDDEN)(x)
+        h = nn.relu(h)
+        out = nn.Dense(HIDDEN)(h)
+        return jnp.mean((out - y) ** 2)
+
+
+def _cfg(stage):
+    return {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 0.01}},
+        "zero_optimization": {"stage": stage, "stage3_param_persistence_threshold": 0},
+    }
+
+
+def test_params_born_sharded_stage3():
+    groups.initialize_mesh(force=True)
+    CALLS["eager"] = CALLS["traced"] = 0
+    rng = np.random.default_rng(0)
+    batch = (rng.normal(size=(16, HIDDEN)).astype(np.float32),
+             rng.normal(size=(16, HIDDEN)).astype(np.float32))
+    eng, _, _, _ = deepspeed_tpu.initialize(model=Probe(), config=_cfg(3), example_batch=batch)
+
+    # init executed, but never eagerly: the full tree was never on the host
+    assert CALLS["eager"] == 0, "zero.Init analog must not materialize params eagerly"
+    assert CALLS["traced"] >= 1
+
+    # stage-3: divisible leaves actually sharded over the zero axes
+    sharded = [l for l in jax.tree.leaves(eng.params)
+               if l.ndim > 0 and not l.sharding.is_fully_replicated]
+    assert sharded, "stage 3 must shard parameters"
+
+    # and the engine still trains
+    l0 = float(eng.train_batch(batch=batch))
+    l1 = float(eng.train_batch(batch=batch))
+    assert l1 < l0
+
+
+def test_born_sharded_matches_host_init():
+    """Same rng seed → identical params whether born sharded or passed in."""
+    groups.initialize_mesh(force=True)
+    rng = np.random.default_rng(1)
+    batch = (rng.normal(size=(16, HIDDEN)).astype(np.float32),
+             rng.normal(size=(16, HIDDEN)).astype(np.float32))
+    model = Probe()
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, config=_cfg(3), example_batch=batch,
+                                            rng_seed=7)
+
+    key = jax.random.split(jax.random.PRNGKey(7))[1]
+    host_params = model.init(key, batch)["params"]
+    groups.initialize_mesh(force=True)
+    ref, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=host_params,
+                                            config=_cfg(3))
+    for a, b in zip(jax.tree.leaves(jax.device_get(eng.params)),
+                    jax.tree.leaves(jax.device_get(ref.params))):
+        np.testing.assert_array_equal(a, b)
