@@ -5,6 +5,8 @@ DeepSpeedTPConfig:12, the fork's DeepSpeedEPConfig:18 with ``replica_num``, and 
 ``simulated_gating``/``trace_enabled`` fork flags).
 """
 
+from typing import Optional
+
 from pydantic import Field
 
 from deepspeed_tpu.inference.v2.ragged.manager_configs import DSStateManagerConfig
@@ -37,6 +39,9 @@ class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     state_manager: DSStateManagerConfig = Field(default_factory=DSStateManagerConfig, alias="manager")
 
     kv_block_size: int = 64
+    # Pallas blocked-attention kernel (reference blocked_flash role):
+    # True/False force it; None = auto (TPU decode buckets)
+    use_paged_kernel: Optional[bool] = None
 
     simulated_gating: bool = False
     simulated_gating_temperature: float = 1.0

@@ -123,16 +123,18 @@ class DSTransformerModelBase:
         import jax
 
         batch = ragged_batch.device_batch if hasattr(ragged_batch, "device_batch") else ragged_batch
-        bucket = (batch["input_ids"].shape[0], batch["seq_seen"].shape[0], batch["block_table"].shape[1])
+        bucket = (batch["tok_meta"].shape[1], batch["seq_meta"].shape[0],
+                  batch["seq_meta"].shape[1] - 4)
         fn = self._get_compiled(bucket)
         cache = self._state_manager.kv_cache.cache
         tracer = get_tracer()
-        if tracer is not None:
-            logits, new_cache = self._traced_forward(batch, cache)
-        else:
-            logits, new_cache = fn(self._params, cache, batch)
-        self._state_manager.kv_cache.set_cache(new_cache)
         n = int(batch["n_seqs"])
+        dev = {"tok_meta": batch["tok_meta"], "seq_meta": batch["seq_meta"]}
+        if tracer is not None:
+            logits, new_cache = self._traced_forward(dev, cache, n)
+        else:
+            logits, new_cache = fn(self._params, cache, dev)
+        self._state_manager.kv_cache.set_cache(new_cache)
         return logits[:n] if n else logits[:0]
 
     def empty_run(self) -> None:
@@ -142,13 +144,14 @@ class DSTransformerModelBase:
         wrapper = RaggedBatchWrapper(self._engine_config.state_manager,
                                      block_size=self._engine_config.kv_block_size)
         batch = wrapper.finalize()  # zero live sequences/tokens
+        dev = {"tok_meta": batch["tok_meta"], "seq_meta": batch["seq_meta"]}
         tracer = get_tracer()
         if tracer is not None:
-            self._traced_forward(batch, self._state_manager.kv_cache.cache)
+            self._traced_forward(dev, self._state_manager.kv_cache.cache, 0)
             return
-        fn = self._get_compiled((batch["input_ids"].shape[0], batch["seq_seen"].shape[0],
-                                 batch["block_table"].shape[1]))
-        _, new_cache = fn(self._params, self._state_manager.kv_cache.cache, batch)
+        fn = self._get_compiled((batch["tok_meta"].shape[1], batch["seq_meta"].shape[0],
+                                 batch["seq_meta"].shape[1] - 4))
+        _, new_cache = fn(self._params, self._state_manager.kv_cache.cache, dev)
         self._state_manager.kv_cache.set_cache(new_cache)
 
     def _get_compiled(self, bucket):
@@ -157,9 +160,20 @@ class DSTransformerModelBase:
             self._compiled[bucket] = jax.jit(self._forward_impl, donate_argnums=(1, ))
         return self._compiled[bucket]
 
+    @staticmethod
+    def _unpack_batch(batch):
+        """Packed [4,T]/[S,4+MB] metadata → the named per-field views (built
+        inside jit: free slices, no extra transfers)."""
+        tok, seq = batch["tok_meta"], batch["seq_meta"]
+        return dict(input_ids=tok[0], token_seq=tok[1], token_pos=tok[2],
+                    token_valid=tok[3].astype(bool), seq_seen=seq[:, 0],
+                    seq_ntok=seq[:, 1], last_tok=seq[:, 2],
+                    seq_valid=seq[:, 3].astype(bool), block_table=seq[:, 4:])
+
     def _forward_impl(self, params, cache, batch):
         import jax.numpy as jnp
 
+        batch = self._unpack_batch(batch)
         x = self.embed(params, batch["input_ids"])
         attn = partial(self._paged_attention, batch=batch)
         for li in range(self.num_layers):
@@ -169,7 +183,7 @@ class DSTransformerModelBase:
         logits = self.unembed(params, x_last)
         return logits.astype(jnp.float32), cache
 
-    def _traced_forward(self, batch, cache):
+    def _traced_forward(self, batch, cache, n):
         """Phase-timed execution for the tracer: embed / per-layer phases /
         unembed run as separate device computations so host timers see real
         boundaries (slower than the fused program — tracing mode trades speed
@@ -177,7 +191,7 @@ class DSTransformerModelBase:
         import jax
         import jax.numpy as jnp
 
-        batch_j = {k: jnp.asarray(v) for k, v in batch.items()}
+        batch_j = self._unpack_batch({k: jnp.asarray(v) for k, v in batch.items()})
         with record("embed"):
             x = jax.jit(self.embed)(self._params, batch_j["input_ids"])
             x.block_until_ready()
@@ -189,13 +203,27 @@ class DSTransformerModelBase:
             logits = logits.astype(jnp.float32)
             logits.block_until_ready()
         self._state_manager.kv_cache.set_cache(cache)
-        n = int(batch["n_seqs"])
         return logits[:n], cache
 
     def layer_forward_traced(self, params, li, x, cache, attn_fn, batch):
         raise NotImplementedError("tracing requires a model with phase-split layers")
 
     # -------------------------------------------------------- paged attention --
+    def _use_paged_kernel(self, T: int) -> bool:
+        """Pallas blocked-attention kernel gate: explicit config flag, or auto
+        (TPU + decode-dominated bucket + the kernel's double-buffered K/V
+        scratch fits VMEM). T is the static bucket token count."""
+        flag = getattr(self._engine_config, "use_paged_kernel", None)
+        if flag is not None:
+            return bool(flag)
+        import jax
+        if jax.default_backend() != "tpu" or T > 32:
+            return False
+        from deepspeed_tpu.ops.pallas.paged_attention import CHUNK
+        bs = self._engine_config.kv_block_size
+        scratch_bytes = 2 * 2 * CHUNK * self.num_kv_heads * bs * self.head_dim * 2
+        return scratch_bytes <= 8 * 1024 * 1024  # leave headroom in ~16MB VMEM
+
     def _paged_attention(self, q, k_new, v_new, cache, li, *, batch):
         """Scatter new K/V into the paged cache, then attend each query token to
         its sequence's full history (gather per-sequence K/V from the block
@@ -203,13 +231,13 @@ class DSTransformerModelBase:
         Pallas kernel consuming the same layout can swap in here).
 
         q: [T, H, D]; k_new/v_new: [T, KVH, D];
-        cache: [num_blocks, bs, 2, L, KVH, D]."""
+        cache: [L, 2, num_blocks, KVH, bs, D]."""
         import jax
         import jax.numpy as jnp
 
         T = q.shape[0]
         S, MB = batch["block_table"].shape
-        bs = cache.shape[1]
+        bs = cache.shape[4]
         H, D = self.num_heads, self.head_dim
         KVH = self.num_kv_heads
 
@@ -217,22 +245,34 @@ class DSTransformerModelBase:
         token_pos = batch["token_pos"]
         token_valid = batch["token_valid"]
 
+        if self._use_paged_kernel(T):
+            # fused KV-insert + blocked attention; the cache is aliased through
+            # the kernel (an XLA-side scatter would copy it at the boundary)
+            from deepspeed_tpu.ops.pallas.paged_attention import paged_attention_update
+            return paged_attention_update(q, k_new, v_new, cache, li, batch["block_table"],
+                                          token_seq, token_pos, token_valid)
+
         # --- scatter new kv ---------------------------------------------------
+        NB = cache.shape[2]
         blk_idx = token_pos // bs
         blk_ids = batch["block_table"][token_seq, jnp.minimum(blk_idx, MB - 1)]
-        # invalid tokens (padding) or unallocated table slots are -1 -> OOB drop
-        blk_ids = jnp.where(token_valid, blk_ids, -1)
+        # padding tokens and unallocated (-1) table slots route to NB — a
+        # POSITIVE out-of-bounds index: scatter mode="drop" discards those
+        # writes, whereas -1 would WRAP to block NB-1 and corrupt it
+        blk_ids = jnp.where(token_valid & (blk_ids >= 0), blk_ids, NB)
         offs = token_pos % bs
-        cache = cache.at[blk_ids, offs, 0, li].set(k_new.astype(cache.dtype), mode="drop")
-        cache = cache.at[blk_ids, offs, 1, li].set(v_new.astype(cache.dtype), mode="drop")
+        cache = cache.at[li, 0, blk_ids, :, offs].set(k_new.astype(cache.dtype), mode="drop")
+        cache = cache.at[li, 1, blk_ids, :, offs].set(v_new.astype(cache.dtype), mode="drop")
 
-        # --- gather per-sequence history -------------------------------------
+        # --- gather per-sequence history (XLA fallback) ----------------------
         table = jnp.maximum(batch["block_table"], 0)  # [S, MB]
-        k_hist = cache[table, :, 0, li]  # [S, MB, bs, KVH, D]
-        v_hist = cache[table, :, 1, li]
+        k_hist = cache[li, 0][table]  # [S, MB, KVH, bs, D]
+        v_hist = cache[li, 1][table]
         KV = MB * bs
-        k_hist = k_hist.reshape(S, KV, KVH, D).astype(q.dtype)
-        v_hist = v_hist.reshape(S, KV, KVH, D).astype(q.dtype)
+        k_hist = k_hist.transpose(0, 2, 1, 3, 4).reshape(S, KVH, KV, D) \
+            .transpose(0, 2, 1, 3).astype(q.dtype)
+        v_hist = v_hist.transpose(0, 2, 1, 3, 4).reshape(S, KVH, KV, D) \
+            .transpose(0, 2, 1, 3).astype(q.dtype)
         if KVH != H:  # GQA
             rep = H // KVH
             k_hist = jnp.repeat(k_hist, rep, axis=2)

@@ -4,10 +4,12 @@ Reference: ``deepspeed/inference/v2/ragged/kv_cache.py`` (BlockedKVCache:40 —
 reserve/free block ids, device cache tensors, offload/restore hooks).
 
 TPU layout: one cache array per allocation group of shape
-``[num_blocks, block_size, 2, num_layers, kv_heads, head_dim]`` — layer-major inside
-a block so a whole block per layer is a contiguous DMA; the KV write/read paths use
-scatter/gather on the leading block dim (XLA lowers to efficient dynamic-slice DMAs;
-a Pallas paged-attention kernel can consume the same layout).
+``[num_layers, 2, num_blocks, kv_heads, block_size, head_dim]`` — a (layer, k|v,
+block) triple is one contiguous ``[kv_heads, block_size, head_dim]`` tile, which is
+exactly one DMA for the Pallas paged-attention kernel
+(``ops/pallas/paged_attention.py``) and a clean dynamic-slice for the XLA gather
+fallback. The trailing ``[block_size, head_dim]`` = (16, 128) matches the TPU tile
+so per-block copies are layout-native.
 """
 
 from typing import Optional, Tuple
@@ -41,7 +43,7 @@ class BlockedKVCache:
         self._allocator = BlockedAllocator(num_blocks)
 
         dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.float16, "float32": jnp.float32}[config.cache_dtype]
-        self._cache = jnp.zeros((num_blocks, config.block_size, 2, num_layers, kv_heads, head_dim), dtype)
+        self._cache = jnp.zeros((num_layers, 2, num_blocks, kv_heads, config.block_size, head_dim), dtype)
         logger.info(f"BlockedKVCache: {num_blocks} blocks x {config.block_size} tokens "
                     f"({num_blocks * block_bytes / 1e9:.2f} GB)")
 

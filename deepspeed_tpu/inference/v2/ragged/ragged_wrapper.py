@@ -21,13 +21,29 @@ from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import DSSequenceDesc
 
 
 def to_padded(original_size: int) -> int:
-    """Pad a token count to a compile-friendly granularity (64 below 512, 128 above)."""
-    granularity = 64 if original_size <= 512 else 128
-    return (original_size + granularity - 1) // granularity * granularity
+    """Pad a token count to a compile-friendly bucket: powers of two up to 64
+    (8 minimum — decode batches stay small and must not burn a 64-token MLP),
+    then 128-granularity for prefill chunks."""
+    if original_size <= 64:
+        n = 8
+        while n < original_size:
+            n *= 2
+        return n
+    return (original_size + 127) // 128 * 128
 
 
 def _pad_to(n: int, mult: int) -> int:
     return max(mult, (n + mult - 1) // mult * mult)
+
+
+def _pow2_pad(n: int, minimum: int = 4) -> int:
+    """Power-of-two bucket: the block-table width grows every block with plain
+    granularity padding, which would recompile the decode program every few
+    generated tokens; pow2 bucketing bounds recompiles to log2(max_blocks)."""
+    p = minimum
+    while p < n:
+        p *= 2
+    return p
 
 
 class RaggedBatchWrapper:
@@ -78,7 +94,7 @@ class RaggedBatchWrapper:
         T = to_padded(max(1, self.current_tokens))
         S = _pad_to(max(1, self.current_sequences), 8)
         mb = max((len(b) for b in self._seq_blocks), default=1)
-        MB = _pad_to(mb, 4)
+        MB = _pow2_pad(mb, 4)
         n_tok = self.current_tokens
         n_seq = self.current_sequences
 
@@ -107,18 +123,21 @@ class RaggedBatchWrapper:
             blocks = self._seq_blocks[i]
             block_table[i, :len(blocks)] = blocks
 
+        # Pack into TWO device arrays (plus host-only counts): under a tunneled
+        # or multi-host dispatch every h2d transfer pays latency, and decode
+        # issues one batch per generated token — 2 transfers/step, not 10.
+        # transformer_base._unpack_batch restores the named views inside jit.
+        tok_meta = np.stack([input_ids, token_seq, token_pos,
+                             token_valid.astype(np.int32)])  # [4, T]
+        seq_meta = np.concatenate([
+            np.stack([seq_seen, seq_ntok, last_tok, seq_valid.astype(np.int32)], axis=1),
+            block_table
+        ], axis=1)  # [S, 4 + MB]
         self._device_batch = dict(
-            input_ids=input_ids,
-            token_seq=token_seq,
-            token_pos=token_pos,
-            token_valid=token_valid,
-            seq_seen=seq_seen,
-            seq_ntok=seq_ntok,
-            last_tok=last_tok,
-            seq_valid=seq_valid,
-            block_table=block_table,
-            n_tokens=np.int32(n_tok),
-            n_seqs=np.int32(n_seq),
+            tok_meta=tok_meta,
+            seq_meta=seq_meta,
+            n_tokens=n_tok,
+            n_seqs=n_seq,
         )
         return self._device_batch
 
@@ -128,4 +147,4 @@ class RaggedBatchWrapper:
         return self._device_batch
 
     def masked_input_ids(self) -> np.ndarray:
-        return self.device_batch["input_ids"][:self.current_tokens]
+        return self.device_batch["tok_meta"][0, :self.current_tokens]

@@ -173,21 +173,73 @@ def flash_attention(q, k, v, scale=1.0, causal=True):
     return _flash_fwd_pallas(q, k, v, scale, causal)
 
 
+def _flash_bwd_manual(q, k, v, out, g, scale, causal, block_k=256):
+    """Hand-written flash-attention-2 backward (no autodiff): recompute the
+    softmax statistics blockwise, then a second blockwise pass produces
+    dq/dk/dv. Differentiating the scan instead (the previous implementation)
+    made XLA stack per-block residuals — O(S^2/block) memory, OOM at 4k+.
+    All inputs [B, S, H, D] (GQA pre-expanded)."""
+    B, S, H, D = q.shape
+    bk = _fit_block(S, block_k)
+    nkb = S // bk
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    q_pos = jnp.arange(S)
+
+    def logits_block(j):
+        k_blk = jax.lax.dynamic_slice_in_dim(kf, j * bk, bk, axis=1)
+        s = jnp.einsum("bqhd,bkhd->bqhk", qf, k_blk) * scale
+        if causal:
+            k_pos = j * bk + jnp.arange(bk)
+            s = jnp.where(q_pos[None, :, None, None] >= k_pos[None, None, None, :], s, NEG_INF)
+        return s, k_blk
+
+    # pass 1: log-sum-exp per query row (running max/sum; no stacked residuals)
+    def lse_body(carry, j):
+        m, l = carry
+        s, _ = logits_block(j)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        l = l * jnp.exp(m - m_new) + jnp.sum(jnp.exp(s - m_new[..., None]), axis=-1)
+        return (m_new, l), None
+
+    m0 = jnp.full((B, S, H), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, S, H), jnp.float32)
+    (m, l), _ = jax.lax.scan(lse_body, (m0, l0), jnp.arange(nkb))
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)  # [B, S, H]
+
+    # pass 2: per-block p recomputed and discarded
+    def bwd_body(dq, j):
+        s, k_blk = logits_block(j)
+        v_blk = jax.lax.dynamic_slice_in_dim(vf, j * bk, bk, axis=1)
+        p = jnp.exp(s - lse[..., None])  # masked entries: exp(NEG_INF - lse) = 0
+        dv_j = jnp.einsum("bqhk,bqhd->bkhd", p, gf)
+        dp = jnp.einsum("bqhd,bkhd->bqhk", gf, v_blk)
+        ds = p * (dp - delta[..., None])
+        dq = dq + jnp.einsum("bqhk,bkhd->bqhd", ds, k_blk) * scale
+        dk_j = jnp.einsum("bqhk,bqhd->bkhd", ds, qf) * scale
+        return dq, (dk_j, dv_j)
+
+    dq, (dk_s, dv_s) = jax.lax.scan(bwd_body, jnp.zeros_like(qf), jnp.arange(nkb))
+    dk = jnp.moveaxis(dk_s, 0, 1).reshape(B, S, H, D)
+    dv = jnp.moveaxis(dv_s, 0, 1).reshape(B, S, H, D)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 def _fa_fwd(q, k, v, scale, causal):
     out = flash_attention(q, k, v, scale, causal)
-    return out, (q, k, v)
+    # `out` is a live activation either way — saving it adds no memory (XLA
+    # aliases), and it gives the backward delta = rowsum(dO * O) for free
+    return out, (q, k, v, out)
 
 
 def _fa_bwd(scale, causal, res, g):
-    q, k, v = res
+    q, k, v, out = res
     kvh = k.shape[2]
     ke, ve = _expand_gqa(q, k, v)
-
-    def f(q, ke, ve):
-        return _blockwise_attention_ref(q, ke, ve, scale, causal)
-
-    _, vjp = jax.vjp(f, q, ke, ve)
-    dq, dke, dve = vjp(g)
+    dq, dke, dve = _flash_bwd_manual(q, ke, ve, out, g, scale, causal)
     if kvh != q.shape[2]:  # fold expanded GQA grads back onto kv heads
         rep = q.shape[2] // kvh
         B, S, _, D = dke.shape

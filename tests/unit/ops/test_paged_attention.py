@@ -1,0 +1,150 @@
+"""Pallas paged-attention kernel vs dense reference (reference:
+tests for blocked_flash / ragged_ops kernels, run as Pallas-vs-jnp
+comparisons per SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.pallas.paged_attention import paged_attention_update
+
+
+def _dense_reference(q, cache, li, table, token_seq, token_pos, token_valid):
+    """Per-token dense attention over the block-table history (cache already
+    contains every token's K/V, including the queries' own)."""
+    T, H, D = q.shape
+    L, _, NB, KVH, bs, _ = cache.shape
+    S, MB = table.shape
+    rep = H // KVH
+    out = np.zeros((T, H, D), np.float32)
+    for t in range(T):
+        if not token_valid[t]:
+            continue
+        s, pos = int(token_seq[t]), int(token_pos[t])
+        n = pos + 1
+        k = np.zeros((n, KVH, D), np.float32)
+        v = np.zeros((n, KVH, D), np.float32)
+        for p in range(n):
+            bid = int(table[s, p // bs])
+            k[p] = np.asarray(cache[li, 0, bid, :, p % bs], np.float32)
+            v[p] = np.asarray(cache[li, 1, bid, :, p % bs], np.float32)
+        for h in range(H):
+            kv = h // rep
+            logits = (np.asarray(q[t, h], np.float32) @ k[:, kv].T) / np.sqrt(D)
+            w = np.exp(logits - logits.max())
+            w /= w.sum()
+            out[t, h] = w @ v[:, kv]
+    return out
+
+
+@pytest.mark.parametrize("kvh", [4, 2])  # MHA and GQA
+def test_paged_attention_matches_dense(kvh):
+    rng = np.random.default_rng(0)
+    L, NB, bs, D, H = 2, 12, 16, 128, 4
+    S, MB = 3, 4
+    cache0 = rng.normal(size=(L, 2, NB, kvh, bs, D)).astype(np.float32)
+    # per-seq block tables with distinct blocks
+    perm = rng.permutation(NB)[:S * MB].reshape(S, MB)
+    table = jnp.asarray(perm, jnp.int32)
+
+    # token mix: decode token for seq0 (pos 20), mid-prefill token for seq1,
+    # fresh token for seq2, one padding row
+    token_seq = jnp.asarray([0, 1, 2, 3], jnp.int32)
+    token_pos = jnp.asarray([20, 7, 0, 0], jnp.int32)
+    token_valid = jnp.asarray([1, 1, 1, 0], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(4, H, D)), jnp.float32)
+    k_new = jnp.asarray(rng.normal(size=(4, kvh, D)), jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(4, kvh, D)), jnp.float32)
+
+    # expected cache: each valid token's K/V written at its (block, offset)
+    exp_cache = cache0.copy()
+    for li in range(L):
+        for t in range(4):
+            if not int(token_valid[t]):
+                continue
+            s, pos = int(token_seq[t]), int(token_pos[t])
+            bid = int(perm[s, pos // bs])
+            exp_cache[li, 0, bid, :, pos % bs] = np.asarray(k_new[t])
+            exp_cache[li, 1, bid, :, pos % bs] = np.asarray(v_new[t])
+
+    cache = jnp.asarray(cache0)
+    for li in range(L):
+        got, cache = paged_attention_update(q, k_new, v_new, cache, li, table,
+                                            token_seq, token_pos, token_valid)
+        want = _dense_reference(q, jnp.asarray(exp_cache), li, np.asarray(table),
+                                np.asarray(token_seq), np.asarray(token_pos),
+                                np.asarray(token_valid))
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(cache), exp_cache, rtol=0, atol=0)
+
+    # all-invalid batch: no output, no cache mutation
+    out2, cache2 = paged_attention_update(q, k_new, v_new, jnp.asarray(exp_cache), 0,
+                                          table, token_seq, token_pos,
+                                          jnp.zeros(4, jnp.int32))
+    assert not np.any(np.asarray(out2))
+    np.testing.assert_allclose(np.asarray(cache2), exp_cache, rtol=0, atol=0)
+
+
+def test_padding_tokens_never_corrupt_last_block():
+    """Regression (code-review r3): -1 scatter indices WRAP in jax; padding
+    tokens must route to a positive OOB sentinel or they overwrite block NB-1
+    on the XLA gather path."""
+    from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_factory import build_engine
+    from deepspeed_tpu.inference.v2.ragged.manager_configs import (AllocationMode,
+                                                                   DSStateManagerConfig,
+                                                                   MemoryConfig)
+    from deepspeed_tpu.models.llama import LlamaConfig, init_params
+    from deepspeed_tpu.utils import groups
+
+    groups.initialize_mesh(force=True)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    _, params = init_params(cfg)
+    mgr = DSStateManagerConfig(memory_config=MemoryConfig(mode=AllocationMode.ALLOCATE, size=8),
+                               max_context=128)
+    eng = build_engine(params, cfg, RaggedInferenceEngineConfig(
+        state_manager=mgr, kv_block_size=16, use_paged_kernel=False))
+    # decode bucket pads 1 token -> 8: 7 padding tokens per forward
+    eng.put([0], [np.asarray([1, 2, 3], np.int64)])
+    last_block_before = np.asarray(eng._state_manager.kv_cache.cache[:, :, -1])
+    eng.put([0], [np.asarray([4], np.int64)])
+    last_block_after = np.asarray(eng._state_manager.kv_cache.cache[:, :, -1])
+    np.testing.assert_array_equal(last_block_after, last_block_before)
+
+
+def test_engine_kernel_vs_dense_path():
+    """Full engine equivalence: forcing the Pallas kernel must reproduce the
+    XLA gather path's logits through prefill + decode."""
+    from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_factory import build_engine
+    from deepspeed_tpu.inference.v2.ragged.manager_configs import (AllocationMode,
+                                                                   DSStateManagerConfig,
+                                                                   MemoryConfig)
+    from deepspeed_tpu.models.llama import LlamaConfig, init_params
+    from deepspeed_tpu.utils import groups
+
+    groups.initialize_mesh(force=True)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    _, params = init_params(cfg)
+
+    def ecfg(kernel):
+        mgr = DSStateManagerConfig(memory_config=MemoryConfig(mode=AllocationMode.ALLOCATE,
+                                                              size=64), max_context=512)
+        return RaggedInferenceEngineConfig(state_manager=mgr, kv_block_size=16,
+                                           use_paged_kernel=kernel)
+
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, cfg.vocab_size, 21)
+
+    outs = {}
+    for kernel in (False, True):
+        eng = build_engine(params, cfg, ecfg(kernel))
+        logits = [np.asarray(eng.put([0], [prompt]))]
+        for _ in range(3):
+            nxt = int(np.argmax(logits[-1][0]))
+            logits.append(np.asarray(eng.put([0], [np.asarray([nxt])])))
+        outs[kernel] = logits
+    for a, b in zip(outs[False], outs[True]):
+        np.testing.assert_allclose(a, b, rtol=3e-5, atol=3e-5)
