@@ -1,0 +1,118 @@
+"""Autotuner: measured search over engine configurations.
+
+Reference: ``deepspeed/autotuning/autotuner.py:42`` (Autotuner — profiles the
+model, generates experiment configs from templates over ZeRO stage /
+micro-batch / other knobs, schedules them through the launcher, picks the
+fastest) with grid/random/model-based tuners under ``autotuning/tuner/``.
+
+TPU formulation: experiments run in-process — each candidate config builds an
+engine, times a few ``train_batch`` steps on the real backend, and is torn
+down; XLA's compile cache keeps repeat shapes cheap. The search space follows
+the reference's config schema (``autotuning`` block: ``tuner_type``
+grid|random, ``max_experiments``, user-overridable space); results are
+written to ``results.json`` like the reference's autotuning_metric_path.
+"""
+
+import itertools
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_SPACE = {
+    "zero_optimization.stage": [0, 1, 2, 3],
+    "train_micro_batch_size_per_gpu": [1, 2, 4, 8],
+}
+
+
+def _set_nested(cfg: dict, dotted: str, value):
+    node = cfg
+    keys = dotted.split(".")
+    for k in keys[:-1]:
+        node = node.setdefault(k, {})
+    node[keys[-1]] = value
+
+
+class Autotuner:
+
+    def __init__(self, model, base_config: dict, batch_fn, model_parameters=None,
+                 space: Optional[Dict[str, List[Any]]] = None, steps: int = 3,
+                 warmup: int = 1, results_dir: Optional[str] = None):
+        """``batch_fn(micro_batch_size) -> batch`` supplies a global batch for
+        a candidate micro size (the reference reads it off the dataloader)."""
+        self.model = model
+        self.model_parameters = model_parameters
+        self.base_config = base_config
+        self.batch_fn = batch_fn
+        at = base_config.get("autotuning", {})
+        self.space = space or at.get("space", DEFAULT_SPACE)
+        self.tuner_type = at.get("tuner_type", "gridsearch")
+        self.max_experiments = at.get("max_experiments", 32)
+        self.steps = steps
+        self.warmup = warmup
+        self.results_dir = results_dir or at.get("results_dir", "autotuning_results")
+        self.results: List[dict] = []
+
+    def _candidates(self):
+        keys = list(self.space.keys())
+        combos = list(itertools.product(*(self.space[k] for k in keys)))
+        if self.tuner_type == "random":
+            rng = np.random.default_rng(0)
+            rng.shuffle(combos)
+        return [dict(zip(keys, c)) for c in combos[:self.max_experiments]]
+
+    def _run_experiment(self, overrides: dict) -> Optional[float]:
+        import copy
+        import jax
+        import deepspeed_tpu
+        from deepspeed_tpu.utils import groups
+
+        cfg = copy.deepcopy(self.base_config)
+        cfg.pop("autotuning", None)
+        for k, v in overrides.items():
+            _set_nested(cfg, k, v)
+        micro = cfg.get("train_micro_batch_size_per_gpu", 1)
+        try:
+            groups.initialize_mesh(force=True)
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=self.model, model_parameters=self.model_parameters, config=cfg)
+            batch = self.batch_fn(micro)
+            for _ in range(self.warmup):
+                float(engine.train_batch(batch=batch))
+            t0 = time.perf_counter()
+            loss = None
+            for _ in range(self.steps):
+                loss = engine.train_batch(batch=batch)
+            float(loss)
+            dt = (time.perf_counter() - t0) / self.steps
+            tput = engine.train_batch_size() / dt
+            del engine
+            return tput
+        except Exception as e:
+            logger.warning(f"autotuning experiment {overrides} failed: {str(e)[:120]}")
+            return None
+
+    def tune(self) -> dict:
+        """Reference Autotuner.tune():404 — run the space, keep the fastest."""
+        best = None
+        for overrides in self._candidates():
+            tput = self._run_experiment(overrides)
+            rec = {"config": overrides, "throughput_samples_per_sec":
+                   None if tput is None else round(tput, 2)}
+            self.results.append(rec)
+            logger.info(f"autotuning: {rec}")
+            if tput is not None and (best is None or tput > best[1]):
+                best = (overrides, tput)
+        os.makedirs(self.results_dir, exist_ok=True)
+        summary = {"experiments": self.results,
+                   "best": None if best is None else
+                   {"config": best[0], "throughput_samples_per_sec": round(best[1], 2)}}
+        with open(os.path.join(self.results_dir, "results.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+        if best is None:
+            raise RuntimeError("autotuning: every experiment failed")
+        return summary["best"]

@@ -1,0 +1,3 @@
+from deepspeed_tpu.compression.compress import (get_compression_config, init_compression,
+                                                redundancy_clean)
+from deepspeed_tpu.compression.basic_layer import fake_quantize, head_prune_mask, row_prune_mask

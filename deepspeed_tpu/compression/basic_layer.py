@@ -1,0 +1,63 @@
+"""Compression primitives.
+
+Reference: ``deepspeed/compression/basic_layer.py`` (LinearLayer_Compress with
+weight quantization, row/head/sparse pruning; QuantAct) — torch module
+subclasses holding masks. TPU formulation: pure functions over weight arrays;
+compression is a parameter-tree transform, not module surgery.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def fake_quantize(w, bits: int = 8, symmetric: bool = True, per_channel: bool = True,
+                  channel_axis: int = -1):
+    """Quantize-dequantize (the reference's training-time fake quant,
+    ``deepspeed/compression/utils.py`` Quantizer): keeps dtype, snaps values to
+    the 2^bits grid so downstream training sees quantization error."""
+    w = jnp.asarray(w)
+    qmax = 2.0**(bits - 1) - 1 if symmetric else 2.0**bits - 1
+    axes = tuple(i for i in range(w.ndim) if i != (channel_axis % w.ndim)) \
+        if per_channel and w.ndim > 1 else None
+    if symmetric:
+        scale = jnp.max(jnp.abs(w), axis=axes, keepdims=True) / qmax
+        scale = jnp.maximum(scale, 1e-12)
+        return jnp.round(w / scale).clip(-qmax - 1, qmax) * scale
+    lo = jnp.min(w, axis=axes, keepdims=True)
+    hi = jnp.max(w, axis=axes, keepdims=True)
+    scale = jnp.maximum((hi - lo) / qmax, 1e-12)
+    return jnp.round((w - lo) / scale).clip(0, qmax) * scale + lo
+
+
+def row_prune_mask(w, ratio: float, axis: int = 0):
+    """L1-structured row pruning mask (reference LinearLayer_Compress
+    row-pruning): zero the ``ratio`` fraction of rows with smallest L1 norm."""
+    w = jnp.asarray(w)
+    other = tuple(i for i in range(w.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(w), axis=other)
+    k = int(np.floor(ratio * norms.shape[0]))
+    if k == 0:
+        return jnp.ones_like(norms, bool)
+    thresh = jnp.sort(norms)[k - 1]
+    return norms > thresh
+
+
+def head_prune_mask(w, ratio: float, num_heads: int):
+    """Attention-head pruning mask over an [in, H*D] projection (reference
+    head-pruning): returns [H] bool keep-mask by per-head L1 norm."""
+    w = jnp.asarray(w)
+    hd = w.shape[-1] // num_heads
+    per_head = jnp.sum(jnp.abs(w.reshape(-1, num_heads, hd)), axis=(0, 2))
+    k = int(np.floor(ratio * num_heads))
+    if k == 0:
+        return jnp.ones((num_heads, ), bool)
+    thresh = jnp.sort(per_head)[k - 1]
+    return per_head > thresh
+
+
+def apply_head_mask(w, keep_mask, num_heads: int):
+    hd = w.shape[-1] // num_heads
+    m = jnp.repeat(jnp.asarray(keep_mask), hd)
+    return w * m[None, :].astype(w.dtype)

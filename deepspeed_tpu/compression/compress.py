@@ -1,0 +1,128 @@
+"""Config-driven model compression.
+
+Reference: ``deepspeed/compression/compress.py`` (``init_compression:100``
+walks the model swapping layers for compressed variants per config patterns;
+``redundancy_clean:148`` materializes structured pruning). TPU formulation:
+the "model" is a parameter pytree — compression is a tree transform keyed by
+the same config schema (``weight_quantization`` / ``sparse_pruning`` /
+``row_pruning`` / ``head_pruning`` blocks with ``modules`` glob patterns).
+"""
+
+import fnmatch
+from typing import Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression.basic_layer import (apply_head_mask, fake_quantize,
+                                                  head_prune_mask, row_prune_mask)
+from deepspeed_tpu.utils.logging import logger
+
+
+def get_compression_config(param_dict: dict) -> dict:
+    return param_dict.get("compression_training", {})
+
+
+def _path_str(path):
+    return ".".join(str(getattr(p, "key", getattr(p, "name", p))) for p in path)
+
+
+def _matches(name: str, patterns) -> bool:
+    return any(fnmatch.fnmatch(name, f"*{p}*") if "*" not in p else fnmatch.fnmatch(name, p)
+               for p in patterns)
+
+
+def _block(cfg: dict, key: str):
+    """shared_parameters + the first enabled group's modules/params."""
+    blk = cfg.get(key, {})
+    shared = blk.get("shared_parameters", {})
+    if not shared.get("enabled", False):
+        return None
+    groups = blk.get("different_groups", {})
+    out = []
+    for g in groups.values():
+        params = g.get("params", {})
+        out.append((g.get("modules", ["*"]), params))
+    return {"shared": shared, "groups": out}
+
+
+def init_compression(params, deepspeed_config: dict, mpu=None):
+    """Apply the configured compression transforms to a parameter pytree
+    (reference init_compression:100 — layer swap becomes a leaf transform).
+    Returns the new pytree; fake-quant keeps shapes/dtypes."""
+    cfg = get_compression_config(deepspeed_config if isinstance(deepspeed_config, dict)
+                                 else {})
+    wq = _block(cfg, "weight_quantization")
+    rp = _block(cfg, "row_pruning")
+    hp = _block(cfg, "head_pruning")
+    sp = _block(cfg, "sparse_pruning")
+
+    def transform(path, leaf):
+        if getattr(leaf, "ndim", 0) < 2:
+            return leaf
+        name = _path_str(path)
+        out = leaf
+        if wq is not None:
+            for patterns, p in wq["groups"]:
+                if _matches(name, patterns):
+                    bits = p.get("start_bits", p.get("target_bits", 8))
+                    out = fake_quantize(out, bits=int(bits),
+                                        symmetric=p.get("quantization_type", "symmetric")
+                                        == "symmetric")
+                    break
+        if sp is not None:
+            for patterns, p in sp["groups"]:
+                if _matches(name, patterns):
+                    ratio = float(p.get("dense_ratio", 0.5))
+                    k = int(np.ceil((1 - ratio) * out.size))
+                    if k > 0:
+                        flat = jnp.abs(out).reshape(-1)
+                        thresh = jnp.sort(flat)[k - 1]
+                        out = out * (jnp.abs(out) > thresh).astype(out.dtype)
+                    break
+        if rp is not None:
+            for patterns, p in rp["groups"]:
+                if _matches(name, patterns):
+                    mask = row_prune_mask(out, float(p.get("row_sparsity", 0.5)), axis=0)
+                    out = out * mask[:, None].astype(out.dtype)
+                    break
+        if hp is not None:
+            for patterns, p in hp["groups"]:
+                if _matches(name, patterns):
+                    heads = int(p.get("num_heads", 1))
+                    mask = head_prune_mask(out, float(p.get("head_sparsity", 0.5)), heads)
+                    out = apply_head_mask(out, mask, heads)
+                    break
+        return out
+
+    new = jax.tree_util.tree_map_with_path(transform, params)
+    logger.info("init_compression: applied "
+                + ", ".join(k for k, v in (("weight_quantization", wq), ("row_pruning", rp),
+                                           ("head_pruning", hp), ("sparse_pruning", sp))
+                            if v is not None))
+    return new
+
+
+def redundancy_clean(params, deepspeed_config: dict, mpu=None):
+    """Materialize structured pruning: physically drop zeroed rows (reference
+    redundancy_clean:148 shrinks the swapped layers). Only row pruning changes
+    shapes; masked-but-kept transforms are already materialized in the tree."""
+    cfg = get_compression_config(deepspeed_config if isinstance(deepspeed_config, dict)
+                                 else {})
+    rp = _block(cfg, "row_pruning")
+    if rp is None:
+        return params
+
+    def transform(path, leaf):
+        if getattr(leaf, "ndim", 0) != 2:
+            return leaf
+        name = _path_str(path)
+        for patterns, p in rp["groups"]:
+            if _matches(name, patterns):
+                keep = np.asarray(jnp.any(jnp.asarray(leaf) != 0, axis=1))
+                return jnp.asarray(leaf)[keep]
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(transform, params)

@@ -1,0 +1,2 @@
+from deepspeed_tpu.elasticity.elasticity import (ElasticityConfig, ElasticityError,
+                                                 compute_elastic_config, elasticity_enabled)

@@ -56,7 +56,14 @@ def _model_config_from_hf(cfg: dict):
         return MixtralConfig(num_local_experts=cfg.get("num_local_experts", 8),
                              num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
                              **common)
-    if model_type in ("llama", "mistral") or "llama" in arch or "mistral" in arch:
+    if model_type == "mistral" or "mistral" in arch:
+        from deepspeed_tpu.models.llama import LlamaConfig
+        return LlamaConfig(model_type="mistral",
+                           sliding_window=cfg.get("sliding_window") or 0, **common)
+    if model_type == "qwen2" or "qwen2" in arch:
+        from deepspeed_tpu.models.llama import LlamaConfig
+        return LlamaConfig(model_type="qwen2", attention_bias=True, **common)
+    if model_type == "llama" or "llama" in arch:
         from deepspeed_tpu.models.llama import LlamaConfig
         return LlamaConfig(**common)
     raise ValueError(f"unsupported HF model_type: {model_type!r}")
@@ -89,6 +96,8 @@ def _map_hf_name(name: str, n_experts: int):
     if rest[0] in ("input_layernorm", "post_attention_layernorm"):
         return layer + (rest[0], "weight"), False
     if rest[0] == "self_attn":
+        if rest[2] == "bias":  # qwen2 q/k/v biases
+            return layer + ("self_attn", rest[1], "bias"), False
         return layer + ("self_attn", rest[1], "kernel"), True
     if rest[0] == "mlp":
         return layer + ("mlp", rest[1], "kernel"), True

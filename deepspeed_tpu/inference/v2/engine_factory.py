@@ -17,21 +17,14 @@ from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
 
 
 def build_engine(params, model_config, engine_config: Optional[RaggedInferenceEngineConfig] = None):
-    """Build an InferenceEngineV2 for a training param tree + model config."""
-    from deepspeed_tpu.models.llama import LlamaConfig
-    from deepspeed_tpu.models.mixtral import MixtralConfig
+    """Build an InferenceEngineV2 for a training param tree + model config;
+    the model class resolves through the policy registry (reference
+    engine_factory.py:66-120 model_type dispatch)."""
+    from deepspeed_tpu.inference.v2.model_implementations.registry import model_cls_for
 
     if engine_config is None:
         engine_config = RaggedInferenceEngineConfig()
-
-    if isinstance(model_config, MixtralConfig):
-        from deepspeed_tpu.inference.v2.model_implementations.mixtral_v2 import MixtralV2Model
-        model = MixtralV2Model(params, model_config, engine_config)
-    elif isinstance(model_config, LlamaConfig):
-        from deepspeed_tpu.inference.v2.model_implementations.llama_v2 import LlamaV2Model
-        model = LlamaV2Model(params, model_config, engine_config)
-    else:
-        raise ValueError(f"no inference-v2 model implementation for {type(model_config).__name__}")
+    model = model_cls_for(model_config)(params, model_config, engine_config)
     return InferenceEngineV2(model, engine_config)
 
 
@@ -69,28 +62,53 @@ def generate(engine: InferenceEngineV2,
         p /= p.sum()
         return int(rng.choice(row.shape[0], p=p))
 
+    from deepspeed_tpu.inference.v2.scheduling_utils import SchedulingResult
+
+    def admits(uids_l, lens_l):
+        """Full admission check — sequence count and KV blocks, not just the
+        token budget (ADVICE r2: token-only budgeting made put() raise instead
+        of deferring)."""
+        return engine.can_schedule(uids_l, lens_l) == SchedulingResult.Success
+
     while len(done) < len(uids):
         batch_uids, batch_tokens = [], []
-        budget = engine._config.state_manager.max_ragged_batch_size
-        # admit pending prefills first (SplitFuse-style: chunk to fit the budget)
-        for u in list(pending):
-            if budget <= 1:
-                break
-            chunk, rest = pending[u][:budget], pending[u][budget:]
+
+        def try_admit(u, toks):
+            cand_u = batch_uids + [u]
+            cand_t = [t.size for t in batch_tokens] + [len(toks)]
+            if not admits(cand_u, cand_t):
+                return False
             batch_uids.append(u)
-            batch_tokens.append(chunk)
-            budget -= chunk.size
+            batch_tokens.append(np.asarray(toks, np.int32))
+            return True
+
+        # admit pending prefills first (SplitFuse-style: chunk to fit the budget)
+        budget = engine._config.state_manager.max_ragged_batch_size
+        for u in list(pending):
+            used = sum(t.size for t in batch_tokens)
+            room = budget - used
+            if room < 1:
+                break
+            chunk, rest = pending[u][:room], pending[u][room:]
+            while chunk.size and not try_admit(u, chunk):
+                chunk = chunk[:chunk.size // 2]  # back off under KV pressure
+                rest = pending[u][chunk.size:]
+            if not chunk.size:
+                continue  # deferred to a later iteration
             if rest.size:
                 pending[u] = rest
             else:
                 del pending[u]
                 live[u] = None  # logits from this put() seed decode
         for u, tok in live.items():
-            if tok is not None and budget > 0 and u not in batch_uids:
-                batch_uids.append(u)
-                batch_tokens.append(np.asarray([tok], np.int32))
-                budget -= 1
+            if tok is not None and u not in batch_uids:
+                try_admit(u, [tok])  # deferred when unschedulable, not crashed
         if not batch_uids:
+            if pending or any(t is not None for t in live.values()):
+                raise RuntimeError(
+                    f"generate(): no sequence schedulable ({len(pending)} pending, "
+                    f"{engine.free_blocks} free KV blocks) — raise the engine's "
+                    f"KV/sequence budgets or lower concurrency")
             break
         logits = np.asarray(engine.put(batch_uids, batch_tokens))
         for i, u in enumerate(batch_uids):

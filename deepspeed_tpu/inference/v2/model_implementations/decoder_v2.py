@@ -1,0 +1,139 @@
+"""Ragged inference for the configurable decoder family (OPT / Falcon / Phi).
+
+Reference: ``deepspeed/inference/v2/model_implementations/{opt,falcon,phi}``
+(one directory per model in the reference; one parameterized implementation
+here — the axes are position encoding, residual topology, norm, activation,
+MQA — see ``models/decoder.py``). Consumes the training pytree verbatim so
+logits are testable against the training forward.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.model_implementations.llama_v2 import _root, rotary_embedding
+from deepspeed_tpu.inference.v2.model_implementations.transformer_base import \
+    DSTransformerModelBase
+from deepspeed_tpu.inference.v2.tracer import record
+from deepspeed_tpu.models.decoder import DecoderConfig
+
+
+def _ln(x, p, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean((x32 - mu) ** 2, axis=-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (out * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _linear(h, p):
+    out = h @ p["kernel"].astype(h.dtype)
+    if "bias" in p:
+        out = out + p["bias"].astype(h.dtype)
+    return out
+
+
+def _rotary_at_partial(x, pos, cos_tab, sin_tab, pct):
+    if pct <= 0.0:
+        return x
+    D = x.shape[-1]
+    rot = int(D * pct) // 2 * 2
+    cos = cos_tab[pos][:, None, :]
+    sin = sin_tab[pos][:, None, :]
+    xr = x[..., :rot]
+    x1, x2 = jnp.split(xr, 2, axis=-1)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return jnp.concatenate([rotated.astype(x.dtype), x[..., rot:]], axis=-1)
+
+
+class DecoderV2Model(DSTransformerModelBase):
+
+    def __init__(self, params, config: DecoderConfig, engine_config, state_manager=None):
+        super().__init__(params, config, engine_config, state_manager)
+        if config.pos_embed == "rotary":
+            D = config.hidden_size // config.num_attention_heads
+            rot = int(D * config.rotary_pct) // 2 * 2
+            self._cos, self._sin = rotary_embedding(engine_config.state_manager.max_context,
+                                                    rot, config.rope_theta, jnp.float32)
+
+    @property
+    def num_layers(self):
+        return self._config.num_hidden_layers
+
+    @property
+    def num_heads(self):
+        return self._config.num_attention_heads
+
+    @property
+    def num_kv_heads(self):
+        return self._config.num_key_value_heads
+
+    @property
+    def head_dim(self):
+        return self._config.hidden_size // self._config.num_attention_heads
+
+    @property
+    def vocab_size(self):
+        return self._config.vocab_size
+
+    # --------------------------------------------------------------- phases --
+    def embed(self, params, ids):
+        r = _root(params)
+        x = r["embed_tokens"]["embedding"][ids].astype(self._config.dtype)
+        return x
+
+    def _add_positions(self, params, x, batch):
+        cfg = self._config
+        if cfg.pos_embed != "learned":
+            return x
+        wpe = _root(params)["embed_positions"]["embedding"]
+        pos = batch["token_pos"] + cfg.learned_pos_offset
+        return x + wpe[pos].astype(x.dtype)
+
+    def unembed(self, params, x):
+        r = _root(params)
+        x = _ln(x, r["final_layer_norm"], self._config.layer_norm_eps)
+        return x @ r["lm_head"]["kernel"].astype(x.dtype)
+
+    def _attn(self, params, li, h, cache, attn_fn, batch):
+        cfg = self._config
+        ap = _root(params)[f"layers_{li}"]["self_attn"]
+        H, KVH, D = self.num_heads, self.num_kv_heads, self.head_dim
+        q = _linear(h, ap["q_proj"]).reshape(-1, H, D)
+        k = _linear(h, ap["k_proj"]).reshape(-1, KVH, D)
+        v = _linear(h, ap["v_proj"]).reshape(-1, KVH, D)
+        if cfg.pos_embed == "rotary":
+            pos = batch["token_pos"]
+            q = _rotary_at_partial(q, pos, self._cos, self._sin, cfg.rotary_pct)
+            k = _rotary_at_partial(k, pos, self._cos, self._sin, cfg.rotary_pct)
+        out, cache = attn_fn(q, k, v, cache, li)
+        return _linear(out.reshape(h.shape[0], H * D), ap["out_proj"]), cache
+
+    def _mlp(self, params, li, h):
+        cfg = self._config
+        mp = _root(params)[f"layers_{li}"]["mlp"]
+        act = jax.nn.relu if cfg.activation == "relu" else \
+            (lambda x: jax.nn.gelu(x, approximate=True))
+        return _linear(act(_linear(h, mp["fc1"])), mp["fc2"])
+
+    def layer_forward(self, params, li, x, cache, attn_fn, batch):
+        cfg = self._config
+        lp = _root(params)[f"layers_{li}"]
+        if li == 0:
+            x = self._add_positions(params, x, batch)
+        if cfg.parallel_residual:
+            h = _ln(x, lp["input_layernorm"], cfg.layer_norm_eps)
+            attn_out, cache = self._attn(params, li, h, cache, attn_fn, batch)
+            return x + attn_out + self._mlp(params, li, h), cache
+        h = _ln(x, lp["input_layernorm"], cfg.layer_norm_eps)
+        attn_out, cache = self._attn(params, li, h, cache, attn_fn, batch)
+        x = x + attn_out
+        h = _ln(x, lp["post_attention_layernorm"], cfg.layer_norm_eps)
+        return x + self._mlp(params, li, h), cache
+
+    def layer_forward_traced(self, params, li, x, cache, attn_fn, batch):
+        with record("layer"):
+            x, cache = self.layer_forward(params, li, x, cache, attn_fn, batch)
+            x.block_until_ready()
+        return x, cache

@@ -85,9 +85,16 @@ class LlamaV2Model(DSTransformerModelBase):
         H, KVH, D = self.num_heads, self.num_kv_heads, self.head_dim
         h = _rms(x, lp["input_layernorm"]["weight"], cfg.rms_norm_eps)
         ap = lp["self_attn"]
-        q = (h @ ap["q_proj"]["kernel"].astype(h.dtype)).reshape(-1, H, D)
-        k = (h @ ap["k_proj"]["kernel"].astype(h.dtype)).reshape(-1, KVH, D)
-        v = (h @ ap["v_proj"]["kernel"].astype(h.dtype)).reshape(-1, KVH, D)
+
+        def lin(p, width):  # qwen2-style optional q/k/v biases
+            out = h @ p["kernel"].astype(h.dtype)
+            if "bias" in p:
+                out = out + p["bias"].astype(h.dtype)
+            return out.reshape(-1, width, D)
+
+        q = lin(ap["q_proj"], H)
+        k = lin(ap["k_proj"], KVH)
+        v = lin(ap["v_proj"], KVH)
         pos = batch["token_pos"]
         q = _rotary_at(q, pos, self._cos, self._sin)
         k = _rotary_at(k, pos, self._cos, self._sin)
@@ -116,3 +123,19 @@ class LlamaV2Model(DSTransformerModelBase):
             x = self._ffn_phase(params, li, x)
             x.block_until_ready()
         return x, cache
+
+    @property
+    def attention_window(self):
+        """Sliding attention window (mistral); 0/None = full causal."""
+        return getattr(self._config, "sliding_window", 0) or 0
+
+
+class MistralV2Model(LlamaV2Model):
+    """Reference: inference/v2/model_implementations/mistral — llama
+    architecture + sliding-window attention (the window rides the shared
+    ``attention_window`` masking in the paged attention)."""
+
+
+class Qwen2V2Model(LlamaV2Model):
+    """Reference: inference/v2/model_implementations/qwen — llama architecture
+    + q/k/v projection biases (handled generically by ``_attn_phase``)."""

@@ -1,0 +1,57 @@
+"""Inference-v2 model policy registry.
+
+Reference: ``deepspeed/inference/v2/engine_factory.py:66-120`` — the
+``model_type``→policy dispatch table covering llama / mistral / mixtral / opt
+/ falcon / phi / qwen. Registered here by model-config class AND by the HF
+``model_type`` string, so both ``build_engine(params, config)`` and
+``build_hf_engine(path)`` resolve through one table.
+"""
+
+from typing import Dict, Tuple, Type
+
+_BY_CONFIG: Dict[type, type] = {}
+_BY_NAME: Dict[str, Tuple[type, type]] = {}
+
+
+def register_policy(model_type: str, config_cls, model_cls) -> None:
+    _BY_NAME[model_type] = (config_cls, model_cls)
+    # config-class dispatch falls back on model_type when one config class
+    # serves several model types (llama family)
+    _BY_CONFIG.setdefault(config_cls, model_cls)
+
+
+def model_cls_for(model_config) -> type:
+    mt = getattr(model_config, "model_type", None)
+    if mt in _BY_NAME:
+        return _BY_NAME[mt][1]
+    for cfg_cls, model_cls in _BY_CONFIG.items():
+        if isinstance(model_config, cfg_cls):
+            return model_cls
+    raise ValueError(f"no inference-v2 policy for {type(model_config).__name__} "
+                     f"(model_type={mt!r}); known: {sorted(_BY_NAME)}")
+
+
+def supported_model_types():
+    return sorted(_BY_NAME)
+
+
+def _register_builtin():
+    from deepspeed_tpu.models.decoder import DecoderConfig
+    from deepspeed_tpu.models.llama import LlamaConfig
+    from deepspeed_tpu.models.mixtral import MixtralConfig
+    from deepspeed_tpu.inference.v2.model_implementations.decoder_v2 import DecoderV2Model
+    from deepspeed_tpu.inference.v2.model_implementations.llama_v2 import (LlamaV2Model,
+                                                                           MistralV2Model,
+                                                                           Qwen2V2Model)
+    from deepspeed_tpu.inference.v2.model_implementations.mixtral_v2 import MixtralV2Model
+
+    register_policy("llama", LlamaConfig, LlamaV2Model)
+    register_policy("mistral", LlamaConfig, MistralV2Model)
+    register_policy("qwen2", LlamaConfig, Qwen2V2Model)
+    register_policy("mixtral", MixtralConfig, MixtralV2Model)
+    register_policy("opt", DecoderConfig, DecoderV2Model)
+    register_policy("falcon", DecoderConfig, DecoderV2Model)
+    register_policy("phi", DecoderConfig, DecoderV2Model)
+
+
+_register_builtin()

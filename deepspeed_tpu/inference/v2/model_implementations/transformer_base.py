@@ -209,20 +209,17 @@ class DSTransformerModelBase:
         raise NotImplementedError("tracing requires a model with phase-split layers")
 
     # -------------------------------------------------------- paged attention --
+    @property
+    def attention_window(self) -> int:
+        """Sliding attention window in tokens; 0 = full causal (mistral sets
+        it via its model config)."""
+        return 0
+
     def _use_paged_kernel(self, T: int) -> bool:
-        """Pallas blocked-attention kernel gate: explicit config flag, or auto
-        (TPU + decode-dominated bucket + the kernel's double-buffered K/V
-        scratch fits VMEM). T is the static bucket token count."""
-        flag = getattr(self._engine_config, "use_paged_kernel", None)
-        if flag is not None:
-            return bool(flag)
-        import jax
-        if jax.default_backend() != "tpu" or T > 32:
-            return False
-        from deepspeed_tpu.ops.pallas.paged_attention import CHUNK
-        bs = self._engine_config.kv_block_size
-        scratch_bytes = 2 * 2 * CHUNK * self.num_kv_heads * bs * self.head_dim * 2
-        return scratch_bytes <= 8 * 1024 * 1024  # leave headroom in ~16MB VMEM
+        """Attention-implementation choice; delegates to the heuristics layer
+        (reference modules/heuristics.py:36-165)."""
+        from deepspeed_tpu.inference.v2.modules.heuristics import attention_implementation
+        return attention_implementation(self, self._engine_config, T) == "pallas_paged"
 
     def _paged_attention(self, q, k_new, v_new, cache, li, *, batch):
         """Scatter new K/V into the paged cache, then attend each query token to
@@ -292,6 +289,8 @@ class DSTransformerModelBase:
         valid_kv = kv_pos <= q_pos                                # causal incl. self
         seq_len = (batch["seq_seen"] + batch["seq_ntok"])[:, None, None, None]
         valid_kv &= kv_pos < seq_len
+        if self.attention_window > 0:  # mistral sliding window
+            valid_kv &= kv_pos > q_pos - self.attention_window
         logits = jnp.where(valid_kv, logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
         out_dense = jnp.einsum("shqk,skhd->sqhd", probs, v_hist)

@@ -1,0 +1,47 @@
+"""Module-implementation heuristics.
+
+Reference: ``deepspeed/inference/v2/modules/heuristics.py:36-165``
+(``instantiate_attn/linear/moe/...`` — pick a concrete kernel implementation
+from the registry given the model+engine config). The TPU build has two real
+attention implementations to arbitrate between; everything else is one
+XLA-fused implementation, so the heuristic surface is exactly this choice.
+"""
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def attention_implementation(model, engine_config, bucket_tokens: int) -> str:
+    """Pick the attention implementation for a (model, bucket) pair.
+
+    Returns "pallas_paged" (ops/pallas/paged_attention.py — the reference's
+    blocked_flash role) or "xla_gather" (dense per-batch gather). Policy:
+
+    - an explicit ``use_paged_kernel`` config wins;
+    - the kernel needs a TPU backend, a decode-dominated bucket (its grid is
+      sequential per token — long prefills amortize better through one dense
+      gather), full-causal masking (the sliding-window walk is not implemented
+      in-kernel), and VMEM room for its double-buffered K/V chunks.
+    """
+    flag = getattr(engine_config, "use_paged_kernel", None)
+    if getattr(model, "attention_window", 0):
+        # sliding window is only masked on the dense path — correctness beats
+        # an explicit kernel request
+        if flag:
+            logger.warning("use_paged_kernel=True ignored: the Pallas kernel has no "
+                           "sliding-window mask; using the XLA gather path")
+        return "xla_gather"
+    if flag is not None:
+        return "pallas_paged" if flag else "xla_gather"
+    import jax
+    if jax.default_backend() != "tpu":
+        return "xla_gather"
+    if bucket_tokens > 32:
+        return "xla_gather"  # prefill-heavy bucket
+    from deepspeed_tpu.ops.pallas.paged_attention import CHUNK
+    bs = engine_config.kv_block_size
+    scratch_bytes = 2 * 2 * CHUNK * model.num_kv_heads * bs * model.head_dim * 2
+    if scratch_bytes > 8 * 1024 * 1024:  # leave headroom in ~16MB VMEM
+        logger.warning(f"paged kernel K/V scratch {scratch_bytes >> 20}MB exceeds VMEM "
+                       f"budget (kv_block_size={bs}); using the XLA gather path")
+        return "xla_gather"
+    return "pallas_paged"

@@ -1,0 +1,199 @@
+"""Configurable decoder family: OPT / Falcon / Phi.
+
+Reference: ``deepspeed/inference/v2/model_implementations/{opt,falcon,phi}``
+ship one model directory each; their architectural deltas are a handful of
+axes, so the TPU build expresses all three as one flax decoder parameterized
+by:
+
+- position encoding: learned embeddings (OPT, with its historical +2 offset)
+  or rotary (Falcon, Phi — optionally partial, ``rotary_pct``);
+- residual topology: serial post-attention MLP (OPT) or parallel
+  attention+MLP off one norm (Falcon, Phi);
+- norm: LayerNorm with bias (all three) — the llama family uses RMS;
+- activation: relu (OPT) or gelu (Falcon, Phi);
+- attention: MHA or MQA/GQA (Falcon-7B: 1 KV head), linear biases on/off.
+
+``DecoderConfig.{opt,falcon,phi}`` build the exact variants; the same layout
+is consumed by ``inference/v2/model_implementations/decoder_v2.py``.
+"""
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.models.llama import (apply_rotary, cross_entropy_loss, rotary_embedding)
+
+
+@dataclass(frozen=True)
+class DecoderConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    num_key_value_heads: int = 12
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-5
+    rope_theta: float = 1e4
+    rotary_pct: float = 1.0            # fraction of head_dim that rotates (phi)
+    pos_embed: str = "rotary"          # "rotary" | "learned"
+    learned_pos_offset: int = 0        # OPT's +2
+    parallel_residual: bool = False    # falcon/phi topology
+    activation: str = "gelu"           # "gelu" | "relu"
+    attention_bias: bool = True
+    mlp_bias: bool = True
+    model_type: str = "decoder"
+    dtype: any = jnp.float32
+
+    # -- canonical variants ---------------------------------------------------
+    @classmethod
+    def opt(cls, **kw):
+        base = dict(pos_embed="learned", learned_pos_offset=2, parallel_residual=False,
+                    activation="relu", attention_bias=True, mlp_bias=True,
+                    model_type="opt")
+        base.update(kw)
+        return cls(**base)
+
+    @classmethod
+    def falcon(cls, **kw):
+        base = dict(pos_embed="rotary", parallel_residual=True, activation="gelu",
+                    attention_bias=False, mlp_bias=False, num_key_value_heads=1,
+                    model_type="falcon")
+        base.update(kw)
+        return cls(**base)
+
+    @classmethod
+    def phi(cls, **kw):
+        base = dict(pos_embed="rotary", rotary_pct=0.5, parallel_residual=True,
+                    activation="gelu", attention_bias=True, mlp_bias=True,
+                    model_type="phi")
+        base.update(kw)
+        return cls(**base)
+
+    @classmethod
+    def tiny(cls, variant="opt", **kw):
+        base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+                    max_position_embeddings=128)
+        if variant == "falcon":
+            base["num_key_value_heads"] = 1
+        base.update(kw)
+        return getattr(cls, variant)(**base)
+
+
+def _act(cfg):
+    return {"relu": nn.relu, "gelu": partial(nn.gelu, approximate=True)}[cfg.activation]
+
+
+def partial_rotary(x, cos, sin, pct):
+    """Rotate only the first ``pct`` of head_dim (phi); pass-through the rest."""
+    if pct >= 1.0:
+        return apply_rotary(x, cos, sin)
+    D = x.shape[-1]
+    rot = int(D * pct) // 2 * 2
+    return jnp.concatenate([apply_rotary(x[..., :rot], cos, sin), x[..., rot:]], axis=-1)
+
+
+class DecoderAttention(nn.Module):
+    cfg: DecoderConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, pos_ids):
+        cfg = self.cfg
+        H, KVH = cfg.num_attention_heads, cfg.num_key_value_heads
+        D = cfg.hidden_size // H
+        dense = partial(nn.Dense, use_bias=cfg.attention_bias, dtype=cfg.dtype)
+        q = dense(H * D, name="q_proj")(x).reshape(*x.shape[:-1], H, D)
+        k = dense(KVH * D, name="k_proj")(x).reshape(*x.shape[:-1], KVH, D)
+        v = dense(KVH * D, name="v_proj")(x).reshape(*x.shape[:-1], KVH, D)
+        if cfg.pos_embed == "rotary":
+            q = partial_rotary(q, cos, sin, cfg.rotary_pct)
+            k = partial_rotary(k, cos, sin, cfg.rotary_pct)
+        if KVH != H:
+            k = jnp.repeat(k, H // KVH, axis=2)
+            v = jnp.repeat(v, H // KVH, axis=2)
+        S = x.shape[1]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(D)
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(*x.shape[:-1], H * D)
+        return dense(cfg.hidden_size, name="out_proj")(out)
+
+
+class DecoderMLP(nn.Module):
+    cfg: DecoderConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = partial(nn.Dense, use_bias=cfg.mlp_bias, dtype=cfg.dtype)
+        h = dense(cfg.intermediate_size, name="fc1")(x)
+        return dense(cfg.hidden_size, name="fc2")(_act(cfg)(h))
+
+
+class DecoderBlock(nn.Module):
+    cfg: DecoderConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, pos_ids):
+        cfg = self.cfg
+        ln = partial(nn.LayerNorm, epsilon=cfg.layer_norm_eps, dtype=cfg.dtype)
+        if cfg.parallel_residual:
+            h = ln(name="input_layernorm")(x)
+            return x + DecoderAttention(cfg, name="self_attn")(h, cos, sin, pos_ids) \
+                + DecoderMLP(cfg, name="mlp")(h)
+        h = ln(name="input_layernorm")(x)
+        x = x + DecoderAttention(cfg, name="self_attn")(h, cos, sin, pos_ids)
+        h = ln(name="post_attention_layernorm")(x)
+        return x + DecoderMLP(cfg, name="mlp")(h)
+
+
+class DecoderModel(nn.Module):
+    cfg: DecoderConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     name="embed_tokens")(input_ids)
+        S = input_ids.shape[1]
+        pos_ids = jnp.arange(S)
+        cos = sin = None
+        if cfg.pos_embed == "learned":
+            wpe = nn.Embed(cfg.max_position_embeddings + cfg.learned_pos_offset,
+                           cfg.hidden_size, dtype=cfg.dtype, name="embed_positions")
+            x = x + wpe(pos_ids + cfg.learned_pos_offset)
+        else:
+            D = cfg.hidden_size // cfg.num_attention_heads
+            rot = int(D * cfg.rotary_pct) // 2 * 2
+            cos, sin = rotary_embedding(S, rot, cfg.rope_theta, jnp.float32)
+        for i in range(cfg.num_hidden_layers):
+            x = DecoderBlock(cfg, name=f"layers_{i}")(x, cos, sin, pos_ids)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="final_layer_norm")(x)
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head")(x)
+
+
+class DecoderForCausalLM(nn.Module):
+    cfg: DecoderConfig
+
+    @nn.compact
+    def __call__(self, batch):
+        input_ids, labels = batch
+        logits = DecoderModel(self.cfg, name="model")(input_ids)
+        return cross_entropy_loss(logits, labels)
+
+
+def init_params(cfg: DecoderConfig, batch_size: int = 2, seq_len: Optional[int] = None,
+                rng=None):
+    model = DecoderForCausalLM(cfg)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    S = seq_len or min(cfg.max_position_embeddings, 16)
+    ids = jnp.zeros((batch_size, S), jnp.int32)
+    return model, model.init(rng, (ids, ids))["params"]

@@ -39,6 +39,11 @@ class LlamaConfig:
     remat_policy: str = "nothing"
     sequence_parallel: bool = False
     use_flash_attention: bool = False
+    # llama-family deltas: qwen2 adds q/k/v biases; mistral masks beyond a
+    # sliding attention window
+    attention_bias: bool = False
+    sliding_window: int = 0  # 0 = disabled
+    model_type: str = "llama"
 
     @staticmethod
     def tiny(**kw):
@@ -79,8 +84,9 @@ def apply_rotary(x, cos, sin):
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
-def causal_attention(q, k, v, scale):
-    """Plain XLA attention [B,S,H,D]; fused/flash variant in ops/pallas."""
+def causal_attention(q, k, v, scale, window: int = 0):
+    """Plain XLA attention [B,S,H,D]; fused/flash variant in ops/pallas.
+    ``window`` > 0 masks keys older than the sliding window (mistral)."""
     B, S, H, D = q.shape
     _, _, KVH, _ = k.shape
     if KVH != H:  # GQA: repeat kv heads
@@ -88,7 +94,10 @@ def causal_attention(q, k, v, scale):
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    mask = jnp.tril(jnp.ones((S, S), bool))
+    pos = jnp.arange(S)
+    mask = pos[None, :] <= pos[:, None]
+    if window > 0:
+        mask &= pos[None, :] > pos[:, None] - window
     logits = jnp.where(mask[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
@@ -109,14 +118,18 @@ class LlamaAttention(nn.Module):
         D = cfg.hidden_size // H
         dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype)
 
-        q = dense(H * D, name="q_proj")(x).reshape(*x.shape[:-1], H, D)
-        k = dense(KVH * D, name="k_proj")(x).reshape(*x.shape[:-1], KVH, D)
-        v = dense(KVH * D, name="v_proj")(x).reshape(*x.shape[:-1], KVH, D)
+        qkv_dense = partial(nn.Dense, use_bias=cfg.attention_bias, dtype=cfg.dtype)
+        q = qkv_dense(H * D, name="q_proj")(x).reshape(*x.shape[:-1], H, D)
+        k = qkv_dense(KVH * D, name="k_proj")(x).reshape(*x.shape[:-1], KVH, D)
+        v = qkv_dense(KVH * D, name="v_proj")(x).reshape(*x.shape[:-1], KVH, D)
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
 
-        inner = flash_causal_attention if cfg.use_flash_attention else causal_attention
-        attn = partial(inner, scale=1.0 / (D**0.5))
+        if cfg.use_flash_attention:
+            assert cfg.sliding_window == 0, "flash path has no sliding-window mask yet"
+            attn = partial(flash_causal_attention, scale=1.0 / (D**0.5))
+        else:
+            attn = partial(causal_attention, scale=1.0 / (D**0.5), window=cfg.sliding_window)
         if cfg.sequence_parallel:
             # Ulysses: all-to-all seq→heads around full-sequence local attention
             attn = DistributedAttention(attn)

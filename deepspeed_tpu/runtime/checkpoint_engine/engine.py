@@ -56,8 +56,12 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         return self._ckptr.restore(path)
 
     def wait(self):
+        # orbax finalizes array commits on background threads even for the
+        # "synchronous" checkpointer; a caller (or interpreter exit) racing
+        # them sees a missing/partial state dir. close() joins them.
         if hasattr(self._ckptr, "wait_until_finished"):
             self._ckptr.wait_until_finished()
+        self._ckptr.close()
 
 
 def _ckpt_path(save_dir, tag):
@@ -76,6 +80,7 @@ def save_engine_state(engine, save_dir, tag, client_state, save_latest):
         "scale_state": engine.scale_state._asdict(),
     }
     ck.save(arrays, os.path.join(path, "state"))
+    ck.wait()  # checkpoint must be durable before save_checkpoint returns
 
     host_state = {
         "global_steps": engine.global_steps,
@@ -128,9 +133,13 @@ def load_engine_state(engine, load_dir, tag, load_optimizer_states=True, load_lr
         # restore straight into the at-rest placement (pinned host when offloaded)
         engine.opt_state = jax.device_put(type(engine.opt_state)(**restored["opt_state"]),
                                           engine._offload.rest_shardings)
+        from jax.sharding import NamedSharding, PartitionSpec as P
         from deepspeed_tpu.runtime.fp16.loss_scaler import LossScaleState
-        engine.scale_state = LossScaleState(**{k: restored["scale_state"][k] for k in ("cur_scale", "good_steps",
-                                                                                       "hysteresis")})
+        # scalars must live on the CURRENT mesh (restored under a different
+        # topology they'd sit on one device and poison the jitted step)
+        rep = NamedSharding(engine.mesh, P())
+        engine.scale_state = LossScaleState(**{k: jax.device_put(restored["scale_state"][k], rep)
+                                               for k in ("cur_scale", "good_steps", "hysteresis")})
 
     with open(os.path.join(path, "host_state.pkl"), "rb") as f:
         host_state = pickle.load(f)

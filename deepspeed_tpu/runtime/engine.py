@@ -64,6 +64,13 @@ def _make_optimizer(name, params_cfg):
         return FusedAdam(adam_w_mode=awm, **cfg)
     if name == "cpuadam":
         return DeepSpeedCPUAdam(**cfg)
+    if name == "onebitadam":
+        from deepspeed_tpu.ops.adam.onebit_adam import OnebitAdam
+        return OnebitAdam(**cfg)
+    if name in ("onebitlamb", "zerooneadam"):
+        raise NotImplementedError(f"{name}: only onebitadam is implemented; the Lamb "
+                                  f"trust-ratio / 0-1 variable-freeze variants are not "
+                                  f"(silently substituting OnebitAdam would change numerics)")
     if name in ("lamb", "fusedlamb"):
         return FusedLamb(**cfg)
     if name in ("lion", "fusedlion"):
@@ -261,6 +268,13 @@ class DeepSpeedEngine:
             steps_per_output=self._config.steps_per_print)
         self.monitor = self._configure_monitor()
         dist.configure(self._config)
+
+        # curriculum learning (reference data_pipeline/curriculum_scheduler.py;
+        # legacy "curriculum_learning" config block)
+        self.curriculum_scheduler = None
+        if self._config.curriculum_enabled_legacy:
+            from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+            self.curriculum_scheduler = CurriculumScheduler(self._config.curriculum_params_legacy)
 
         self._compiled = {}
         self._flops_profiled = False
@@ -561,6 +575,8 @@ class DeepSpeedEngine:
         pass — no grads, no dropout/gating rngs — matching the reference's eval
         forward."""
         self.timers(FORWARD_MICRO_TIMER).start()
+        if self.training:
+            batch = self._apply_curriculum(batch)
         batch = self.shard_batch(batch)
         if not self.training:
             self._cached_grads = None  # eval invalidates any pending backward()
@@ -640,6 +656,23 @@ class DeepSpeedEngine:
             self.lr_scheduler.step(**lr_kwargs)
             self._current_lr = self.lr_scheduler.get_last_lr()[0]
 
+    def _apply_curriculum(self, batch):
+        """Truncate the sequence dim to the current curriculum difficulty
+        (reference engine.py curriculum seqlen truncation; each difficulty
+        bucket is one compiled program)."""
+        if self.curriculum_scheduler is None:
+            return batch
+        if self._config.curriculum_params_legacy.get("curriculum_type", "seqlen") != "seqlen":
+            return batch
+        import jax
+        diff = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
+
+        def trunc(x):
+            x = np.asarray(x)
+            return x[:, :diff] if x.ndim >= 2 and x.shape[1] > diff else x
+
+        return jax.tree.map(trunc, batch)
+
     def _maybe_profile_flops(self, batch, micro_stacked=False):
         """Print the flops profile at ``profile_step`` (reference engine.py:1793
         triggers the profiler inside forward)."""
@@ -675,9 +708,10 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps()
         if batch is None:
             assert data_iter is not None, "train_batch needs data_iter or batch"
-            micro = [next(data_iter) for _ in range(gas)]
+            micro = [self._apply_curriculum(next(data_iter)) for _ in range(gas)]
             batch = jax.tree.map(lambda *xs: np.stack(xs), *micro)
         else:
+            batch = self._apply_curriculum(batch)
             batch = jax.tree.map(lambda x: np.asarray(x).reshape((gas, -1) + np.asarray(x).shape[1:]), batch)
         batch = jax.tree.map(
             lambda l: jax.device_put(l, self._micro_stack_sharding(l)), batch)
@@ -757,11 +791,28 @@ class DeepSpeedEngine:
                                  load_module_only=load_module_only)
 
     def _checkpoint_tag_validation(self, tag):
+        """All ranks must be saving the SAME tag (reference engine.py:3035
+        _checkpoint_tag_validation: bcast rank-0's tag, compare): hash the tag
+        and all-reduce min/max over the mesh — any disagreement across hosts
+        makes them differ."""
         if not self._config.checkpoint_tag_validation_enabled:
             return
-        # All hosts must agree on the tag (reference _checkpoint_tag_validation:3035).
-        # Single-controller SPMD: every host computes the same tag by construction;
-        # multi-host agreement is checked through the coordination service.
+        import zlib
+        import numpy as np
+        h = np.int32(zlib.crc32(str(tag).encode()) & 0x7FFFFFFF)
+        agreed = int(self._broadcast_rank0_value(h))
+        if agreed != int(h):
+            msg = f"checkpoint tag {tag!r} is not consistent across all ranks"
+            if self._config.checkpoint_tag_validation_fail:
+                raise RuntimeError(msg)
+            logger.warning(msg)
+
+    @staticmethod
+    def _broadcast_rank0_value(value):
+        """Process-0's value on every process — covers EVERY process regardless
+        of mesh-axis layout, unlike a group-scoped collective."""
+        from jax.experimental import multihost_utils
+        return multihost_utils.broadcast_one_to_all(value)
 
     def save_16bit_model(self, save_dir, save_filename="pytorch_model.bin", exclude_frozen_parameters=False):
         """Reference engine.py:3479 _zero3_consolidated_16bit_state_dict.

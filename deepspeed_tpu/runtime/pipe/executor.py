@@ -1,0 +1,153 @@
+"""Host-level executor for pipeline instruction streams.
+
+Reference: ``deepspeed/runtime/pipe/engine.py:1357`` (``_exec_schedule`` — the
+dispatch loop walking a PipeSchedule's instructions through
+``_INSTRUCTION_MAP``) with the P2P sends/recvs of ``pipe/p2p.py``.
+
+TPU role: the HOT path executes pipelines as one jitted scan with ppermute
+(``pipe/engine.py``); this executor is the general fallback the schedules
+drive directly — it handles what the fused scan cannot: heterogeneous stages
+(different layer types/shapes per stage) and ``TiedLayerSpec`` parameter
+sharing. It simulates the P stage workers in lock step: per clock tick, all
+sends deposit into per-link mailboxes, then recvs collect them (asserting the
+same-tick pairing invariant the streams encode), then compute runs. Backward
+uses per-buffer ``jax.vjp`` residuals exactly where the reference stashes
+activation grads."""
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.pipe import schedule as sched
+from deepspeed_tpu.utils.logging import logger
+
+
+class ScheduleExecutor:
+
+    def __init__(self, stage_fns: Sequence[Callable], stage_params: Sequence,
+                 loss_fn: Callable, micro_batches: int,
+                 tied_groups: Optional[List[List[int]]] = None):
+        """``stage_fns[s](params_s, x) -> y``; the last stage's output feeds
+        ``loss_fn(y, label)``. ``tied_groups``: stage-index groups whose param
+        trees are shared (TiedLayerSpec) — their grads are summed and mirrored
+        (the reference's tied-weight allreduce, module.py:423)."""
+        self.stage_fns = list(stage_fns)
+        self.stage_params = list(stage_params)
+        self.loss_fn = loss_fn
+        self.P = len(stage_fns)
+        self.M = micro_batches
+        self.tied_groups = tied_groups or []
+
+    # ------------------------------------------------------------------ exec --
+    def train_batch(self, inputs: Sequence, labels: Sequence):
+        """Run one TrainSchedule pass; returns (mean loss, per-stage grads)."""
+        P, M = self.P, self.M
+        assert len(inputs) == M and len(labels) == M
+        schedules = [sched.TrainSchedule(M, P, s) for s in range(P)]
+        streams = [list(s.steps()) for s in schedules]
+        nbuf = [schedules[s].num_pipe_buffers() for s in range(P)]
+
+        # per-stage state
+        act_in = [[None] * nbuf[s] for s in range(P)]     # recv'd/loaded inputs
+        vjps = self._vjps = [[None] * nbuf[s] for s in range(P)]  # backward closures
+        act_out = [[None] * nbuf[s] for s in range(P)]    # forward outputs
+        grad_in = [[None] * nbuf[s] for s in range(P)]    # recv'd output-grads
+        grads = [jax.tree.map(jnp.zeros_like, p) for p in self.stage_params]
+        labels_buf = [[None] * nbuf[P - 1]]
+        losses = []
+
+        # same-tick mailboxes, one per directed link
+        act_mail = {}   # (src, dst) -> activation
+        grad_mail = {}
+
+        ticks = len(streams[0])
+        for t in range(ticks):
+            cmds_per_stage = [streams[s][t] for s in range(P)]
+
+            # phase 1: sends + loads deposit
+            for s, cmds in enumerate(cmds_per_stage):
+                for cmd in cmds:
+                    if isinstance(cmd, sched.SendActivation):
+                        assert (s, s + 1) not in act_mail, f"act link {s}->{s+1} busy @t{t}"
+                        act_mail[(s, s + 1)] = act_out[s][cmd.buffer_id]
+                    elif isinstance(cmd, sched.SendGrad):
+                        assert (s, s - 1) not in grad_mail, f"grad link {s}->{s-1} busy @t{t}"
+                        grad_mail[(s, s - 1)] = self._input_grad(s, cmd.buffer_id)
+                    elif isinstance(cmd, sched.LoadMicroBatch):
+                        _, mb = schedules[s].work_at(t)
+                        if s == 0:
+                            act_in[0][cmd.buffer_id] = inputs[mb]
+                        if s == P - 1:
+                            labels_buf[0][cmd.buffer_id] = labels[mb]
+
+            # phase 2: recvs collect (send must have happened THIS tick)
+            for s, cmds in enumerate(cmds_per_stage):
+                for cmd in cmds:
+                    if isinstance(cmd, sched.RecvActivation):
+                        key = (s - 1, s)
+                        assert key in act_mail, f"unpaired RecvActivation on {key} @t{t}"
+                        act_in[s][cmd.buffer_id] = act_mail.pop(key)
+                    elif isinstance(cmd, sched.RecvGrad):
+                        key = (s + 1, s)
+                        assert key in grad_mail, f"unpaired RecvGrad on {key} @t{t}"
+                        grad_in[s][cmd.buffer_id] = grad_mail.pop(key)
+
+            # phase 3: compute
+            for s, cmds in enumerate(cmds_per_stage):
+                for cmd in cmds:
+                    if isinstance(cmd, sched.ForwardPass):
+                        b = cmd.buffer_id
+                        x = act_in[s][b]
+                        assert x is not None, \
+                            f"ForwardPass on stage {s} buffer {b} @t{t} with no activation " \
+                            f"(missing LoadMicroBatch/RecvActivation)"
+                        if s == P - 1:
+                            def full(p, x, y):
+                                return self.loss_fn(self.stage_fns[s](p, x), y)
+                            loss, vjp = jax.vjp(full, self.stage_params[s], x,
+                                                labels_buf[0][b])
+                            losses.append(loss)
+                            vjps[s][b] = vjp
+                        else:
+                            y, vjp = jax.vjp(self.stage_fns[s], self.stage_params[s], x)
+                            act_out[s][b] = y
+                            vjps[s][b] = vjp
+                    elif isinstance(cmd, sched.BackwardPass):
+                        b = cmd.buffer_id
+                        if s == P - 1:
+                            dp, dx, _ = vjps[s][b](jnp.ones(()))
+                        else:
+                            dp, dx = vjps[s][b](grad_in[s][b])
+                        grads[s] = jax.tree.map(jnp.add, grads[s], dp)
+                        vjps[s][b] = ("done", dx)  # stash input-grad for SendGrad
+                    elif isinstance(cmd, sched.ReduceTiedGrads):
+                        if s == 0:
+                            self._reduce_tied(grads)
+                    elif isinstance(cmd, (sched.ReduceGrads, sched.OptimizerStep)):
+                        pass  # DP reduction/step belong to the caller's engine
+
+        assert not act_mail and not grad_mail, "unconsumed mailbox entries"
+        assert len(losses) == M
+        return jnp.mean(jnp.stack(losses)), grads
+
+    def _input_grad(self, s, buffer_id):
+        slot = self.vjp_slot(s, buffer_id)
+        assert isinstance(slot, tuple) and slot[0] == "done", \
+            f"SendGrad before BackwardPass on stage {s} buffer {buffer_id}"
+        return slot[1]
+
+    def vjp_slot(self, s, buffer_id):
+        return self._vjps[s][buffer_id]
+
+    def _reduce_tied(self, grads):
+        """Sum tied groups' grads and mirror the total (reference
+        _exec_reduce_tied_grads / module.py:423)."""
+        for group in self.tied_groups:
+            total = None
+            for s in group:
+                total = grads[s] if total is None else jax.tree.map(jnp.add, total, grads[s])
+            for s in group:
+                grads[s] = total

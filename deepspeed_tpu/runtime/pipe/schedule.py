@@ -34,7 +34,8 @@ tick t, a send on stage s must pair with the neighbor's recv at the *same* t.
 
 On TPU the hot-path *execution* of a schedule is a jitted scan with ppermute
 (XLA overlaps compute and stage transfers; see pipe/engine.py); these streams
-document/test the ordering and drive the host-level fallback executor.
+drive the host-level fallback executor (pipe/executor.py ScheduleExecutor —
+heterogeneous stages, TiedLayerSpec weight sharing) and pin the ordering.
 """
 
 from abc import ABC, abstractmethod

@@ -1,0 +1,76 @@
+"""Offline checkpoint → consolidated fp32 state-dict converter (CLI).
+
+Reference: ``deepspeed/utils/zero_to_fp32.py`` (592 LoC — stitches per-rank
+``zero_pp_rank_*`` flat partitions back into full fp32 tensors) and
+``deepspeed/checkpoint/ds_to_universal.py:286``. The TPU checkpoint is ONE
+logical sharded array store (orbax/tensorstore), so consolidation is a plain
+offline restore — no engine, no mesh, no shard stitching — followed by a
+flat-named export:
+
+    python -m deepspeed_tpu.utils.zero_to_fp32 <checkpoint_dir> <output_file> [--tag TAG]
+
+Output: an ``.npz`` with one entry per parameter, keys joined with ``.``
+(``model.layers_0.self_attn.q_proj.kernel``), everything cast to fp32 —
+loadable with ``numpy.load`` anywhere, no JAX required at load time.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None):
+    """Reference zero_to_fp32.py API: returns {flat_name: np.float32 array}."""
+    from deepspeed_tpu.runtime.checkpoint_engine.engine import LATEST_FILE, OrbaxCheckpointEngine
+
+    if tag is None:
+        latest = os.path.join(checkpoint_dir, LATEST_FILE)
+        if not os.path.isfile(latest):
+            raise FileNotFoundError(f"no tag given and no {latest}")
+        with open(latest) as f:
+            tag = f.read().strip()
+    state_path = os.path.join(os.path.abspath(checkpoint_dir), str(tag), "state")
+    if not os.path.isdir(state_path):
+        raise FileNotFoundError(f"checkpoint state not found at {state_path}")
+
+    restored = OrbaxCheckpointEngine().load(state_path)
+    params = restored["params"]
+
+    out = {}
+
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, prefix + (str(k), ))
+        else:
+            out[".".join(prefix)] = np.asarray(node, dtype=np.float32)
+
+    walk(params, ())
+    return out
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir, output_file, tag=None):
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    os.makedirs(os.path.dirname(os.path.abspath(output_file)), exist_ok=True)
+    np.savez(output_file, **sd)
+    total = sum(v.size for v in sd.values())
+    print(f"wrote {len(sd)} tensors ({total:,} fp32 params) to {output_file}")
+    return output_file
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="consolidate a deepspeed_tpu checkpoint into a flat fp32 .npz "
+                    "(reference: deepspeed/utils/zero_to_fp32.py)")
+    parser.add_argument("checkpoint_dir")
+    parser.add_argument("output_file")
+    parser.add_argument("--tag", default=None, help="checkpoint tag (default: 'latest' file)")
+    args = parser.parse_args(argv)
+    convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir, args.output_file, args.tag)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""Universal-checkpoint depth (VERDICT r3 #7).
+
+Reference: ``deepspeed/checkpoint/ds_to_universal.py:286`` (extract → merge →
+reshape into any topology), MoE expert-sharded save (``engine.py:3153``),
+``deepspeed/utils/zero_to_fp32.py`` offline consolidation, and tag validation
+(``engine.py:3035``). The TPU checkpoint is one sharded array store, so the
+universal reshape is "restore under the new mesh" — these tests prove it for
+the hard case: an EP-sharded MoE saved under one topology and restored under
+a completely different one."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM, init_params, \
+    mixtral_param_specs
+from deepspeed_tpu.utils import groups
+
+
+def _cfg(stage=2):
+    return {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+    }
+
+
+def _batch(cfg, rng, bs=8, seq=16):
+    ids = rng.integers(0, cfg.vocab_size, size=(bs, seq)).astype(np.int32)
+    return (ids, ids.copy())
+
+
+def _make_engine(mcfg, params):
+    model = MixtralForCausalLM(mcfg)
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                            config=_cfg(),
+                                            param_specs=mixtral_param_specs(params))
+    return eng
+
+
+def test_moe_cross_mesh_reshard(tmp_path):
+    """Save a ZeRO-2 Mixtral on a (data=4, expert=2) mesh; restore on
+    (data=2, seq=2, model=2). Expert banks move from EP shards to TP-sharded
+    replicas; every leaf must survive bit-for-bit and training must continue."""
+    mcfg = MixtralConfig.tiny(dtype=jnp.float32)
+    _, params0 = init_params(mcfg)
+    rng = np.random.default_rng(0)
+
+    groups.initialize_mesh(expert_parallel_size=2, force=True)  # data=4, expert=2
+    eng = _make_engine(mcfg, params0)
+    for _ in range(3):
+        eng.train_batch(batch=_batch(mcfg, rng))
+    eng.save_checkpoint(str(tmp_path), tag="cross")
+    want_params = jax.device_get(eng.params)
+    want_opt = jax.device_get(eng.opt_state)
+    steps = eng.global_steps
+
+    groups.initialize_mesh(sequence_parallel_size=2, model_parallel_size=2, force=True)
+    eng2 = _make_engine(mcfg, params0)
+    eng2.load_checkpoint(str(tmp_path), tag="cross")
+    assert eng2.global_steps == steps
+    for a, b in zip(jax.tree.leaves(jax.device_get(eng2.params)), jax.tree.leaves(want_params)):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(jax.tree.leaves(jax.device_get(eng2.opt_state)), jax.tree.leaves(want_opt)):
+        np.testing.assert_array_equal(a, b)
+
+    # the restored engine trains under the NEW topology
+    l0 = float(eng2.train_batch(batch=_batch(mcfg, rng)))
+    assert np.isfinite(l0)
+
+
+def test_zero_to_fp32_cli(tmp_path):
+    """Offline consolidation CLI: checkpoint dir → flat fp32 npz, no engine."""
+    from ..simple_model import make_simple_model, random_batches
+
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=16, batch_size=16)
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                            config=_cfg(stage=3))
+    for b in random_batches(2, 16, 16):
+        eng.train_batch(batch=b)
+    eng.save_checkpoint(str(tmp_path))  # default tag + latest file
+
+    import os
+    out = tmp_path / "consolidated.npz"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "/root/repo"}
+    rc = subprocess.call([sys.executable, "-m", "deepspeed_tpu.utils.zero_to_fp32",
+                          str(tmp_path), str(out)], env=env)
+    assert rc == 0
+    sd = np.load(str(out))
+    want = jax.device_get(eng.params)
+    import jax.tree_util as jtu
+    flat = {".".join(str(getattr(k, "key", k)) for k in path): v
+            for path, v in jtu.tree_flatten_with_path(want)[0]}
+    assert set(sd.files) == set(flat)
+    for name in sd.files:
+        assert sd[name].dtype == np.float32
+        np.testing.assert_array_equal(sd[name], np.asarray(flat[name], np.float32))
+
+
+def test_tag_validation():
+    """Consistent tags pass; the check runs a real min/max all-reduce."""
+    from ..simple_model import make_simple_model
+
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=16, batch_size=16)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params0,
+        config={**_cfg(), "checkpoint": {"tag_validation": "Fail"}})
+    eng._checkpoint_tag_validation("tag1")  # must not raise
+
+    # simulate cross-host disagreement: rank 0 "broadcasts" a different hash
+    # (instance-level patch so the class staticmethod is untouched)
+    eng._broadcast_rank0_value = lambda v: int(v) + 1
+    try:
+        with pytest.raises(RuntimeError, match="not consistent"):
+            eng._checkpoint_tag_validation("tag2")
+    finally:
+        del eng._broadcast_rank0_value
