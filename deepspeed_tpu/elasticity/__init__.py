@@ -1,2 +1,3 @@
+from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent, ElasticAgentError
 from deepspeed_tpu.elasticity.elasticity import (ElasticityConfig, ElasticityError,
                                                  compute_elastic_config, elasticity_enabled)

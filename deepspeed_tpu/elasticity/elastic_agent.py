@@ -1,0 +1,116 @@
+"""Elastic agent: supervise a launched job, shrink and restart on failure.
+
+Reference: ``deepspeed/elasticity/elastic_agent.py`` (DSElasticAgent:28 — a
+torch-elastic LocalElasticAgent subclass that restarts worker groups on
+membership change, re-rendezvousing through the store).
+
+TPU formulation: JAX's coordination service fixes world membership at
+``jax.distributed.initialize``, so recovery is restart-shaped by construction —
+exactly what this agent does. It spawns the per-process group, watches exits,
+and on failure kills the stragglers, recomputes a *valid* world size from the
+elasticity config (v0.1 batch math — the set of chip counts that keep the
+global batch constant), and relaunches with ``DSTPU_NUM_PROCESSES`` shrunk to
+the nearest valid size ≤ the surviving capacity.
+"""
+
+import os
+import signal
+import subprocess
+import time
+from typing import Callable, Dict, List, Optional
+
+from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+from deepspeed_tpu.utils.logging import logger
+
+
+class ElasticAgentError(RuntimeError):
+    pass
+
+
+class DSElasticAgent:
+
+    def __init__(self, cmd: List[str], num_processes: int, ds_config: Optional[dict] = None,
+                 env: Optional[Dict[str, str]] = None, max_restarts: int = 3,
+                 monitor_interval: float = 0.5,
+                 capacity_fn: Optional[Callable[[], int]] = None):
+        """``cmd`` is launched once per process with DSTPU_NUM_PROCESSES /
+        DSTPU_PROCESS_ID exported (the contract ``comm.init_distributed``
+        reads). ``capacity_fn`` reports how many processes can be spawned for
+        the next attempt (defaults to the last world size — a failed process is
+        assumed recoverable; pass a probe for real node-loss handling)."""
+        self.cmd = list(cmd)
+        self.num_processes = int(num_processes)
+        self.ds_config = ds_config or {}
+        self.env = dict(env if env is not None else os.environ)
+        self.max_restarts = int(max_restarts)
+        self.monitor_interval = monitor_interval
+        self.capacity_fn = capacity_fn
+        self.restart_count = 0
+
+    # -- world-size policy -------------------------------------------------------
+    def next_world_size(self, capacity: int) -> int:
+        """Largest elasticity-valid world size ≤ capacity (or capacity itself
+        when elasticity is off)."""
+        if not self.ds_config.get("elasticity", {}).get("enabled", False):
+            if capacity < 1:
+                raise ElasticAgentError("no capacity left to restart into")
+            return capacity
+        _, valid = compute_elastic_config(self.ds_config)
+        fitting = [n for n in valid if n <= capacity]
+        if not fitting:
+            raise ElasticAgentError(
+                f"no elasticity-valid world size fits the surviving capacity {capacity} "
+                f"(valid: {sorted(valid)[:10]}...)")
+        return max(fitting)
+
+    # -- process control ---------------------------------------------------------
+    def _spawn(self, world_size: int) -> List[subprocess.Popen]:
+        procs = []
+        for rank in range(world_size):
+            env = dict(self.env)
+            env["DSTPU_NUM_PROCESSES"] = str(world_size)
+            env["DSTPU_PROCESS_ID"] = str(rank)
+            env["DSTPU_ELASTIC_RESTART"] = str(self.restart_count)
+            procs.append(subprocess.Popen(self.cmd, env=env))
+        return procs
+
+    @staticmethod
+    def _kill(procs: List[subprocess.Popen]):
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 5
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def _monitor(self, procs: List[subprocess.Popen]) -> bool:
+        """True = clean exit of every process; False = a failure occurred."""
+        while True:
+            codes = [p.poll() for p in procs]
+            if any(c not in (None, 0) for c in codes):
+                self._kill(procs)
+                return False
+            if all(c == 0 for c in codes):
+                return True
+            time.sleep(self.monitor_interval)
+
+    # -- main loop ---------------------------------------------------------------
+    def run(self) -> int:
+        world = self.num_processes
+        while True:
+            logger.info(f"elastic agent: launching world_size={world} "
+                        f"(attempt {self.restart_count + 1})")
+            procs = self._spawn(world)
+            if self._monitor(procs):
+                logger.info("elastic agent: job finished cleanly")
+                return 0
+            self.restart_count += 1
+            if self.restart_count > self.max_restarts:
+                raise ElasticAgentError(f"job failed after {self.max_restarts} restarts")
+            capacity = self.capacity_fn() if self.capacity_fn is not None else world
+            world = self.next_world_size(capacity)
+            logger.warning(f"elastic agent: worker failed; restarting with "
+                           f"world_size={world} (capacity {capacity})")

@@ -160,8 +160,9 @@ def _compatible_gpus_v02(micro_batches, max_batch, current_num_gpus, min_gpus, m
         return batch, valid_dp, pick_micro(batch)
 
     # current world incompatible with the elastic set: fix batch to the current
-    # dp size (reference _get_compatible_gpus_v02 fallback)
-    current_dp = (current_num_gpus // num_gpus_per_node) * dp_per_node
+    # dp size (reference _get_compatible_gpus_v02 fallback — float node ratio,
+    # so a sub-node world degrades gracefully instead of dividing by zero)
+    current_dp = max(1, round((current_num_gpus / num_gpus_per_node) * dp_per_node))
     cands = [m * current_dp * (max_batch // (m * current_dp)) for m in micro_batches
              if m * current_dp <= max_batch]
     if not cands:

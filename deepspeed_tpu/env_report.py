@@ -44,6 +44,14 @@ def main(argv=None):
     except Exception as e:
         print(f"backend ................ ERROR: {e}")
     print("-" * 60)
+    # native-op compat matrix (reference env_report.py op_report / ds_report)
+    from deepspeed_tpu.ops.op_builder import ALL_OPS
+    for name, cls in ALL_OPS.items():
+        b = cls()
+        ok = b.is_compatible()
+        print(f"native op {name:<12} ... {GREEN_OK if ok else RED_NO}"
+              f"{'' if ok else '  (' + str(b.error_log) + ')'}")
+    print("-" * 60)
     from deepspeed_tpu.utils import groups
     print(f"mesh axes .............. {groups.MESH_AXES}")
     if groups.mesh_is_initialized():

@@ -63,13 +63,97 @@ class InferenceEngine:
 
     __call__ = forward
 
-    def generate(self, *inputs, **kwargs):
-        """Reference engine.py:613; full sampling loop arrives with the v2 ragged
-        engine — here we delegate to a module-provided generate."""
+    def generate(self, input_ids=None, max_new_tokens: int = 32, do_sample: bool = False,
+                 temperature: float = 1.0, eos_token_id: Optional[int] = None,
+                 rng=None, **kwargs):
+        """Reference engine.py:613 (``_generate`` → module.generate or the
+        sampling loop). A module-provided ``generate`` wins; otherwise this is
+        the v1 autoregressive loop for causal-LM modules whose forward returns
+        logits [B, S, V]:
+
+        One jitted ``lax.fori_loop`` over a padded [B, S0+max_new_tokens]
+        buffer — static shapes, a single compile per (S0, max_new_tokens)
+        bucket. No KV cache: each step re-runs the prefix (the v2 ragged
+        engine with the paged Pallas kernel is the production decode path;
+        this matches reference v1's no-cache fallback semantics).
+        """
         if hasattr(self.module, "generate"):
-            return self.module.generate(*inputs, **kwargs)
-        raise NotImplementedError("generate() requires a module with a generate method "
-                                  "or the v2 ragged inference engine")
+            # delegate EVERYTHING the caller passed; filter our named params by
+            # the module's signature so modules with narrower generate APIs
+            # keep working (and none of the knobs get silently dropped)
+            import inspect
+            mg = self.module.generate
+            named = dict(max_new_tokens=max_new_tokens, do_sample=do_sample,
+                         temperature=temperature, eos_token_id=eos_token_id, rng=rng)
+            try:
+                sig = inspect.signature(mg)
+                if not any(p.kind == p.VAR_KEYWORD for p in sig.parameters.values()):
+                    named = {k: v for k, v in named.items() if k in sig.parameters}
+            except (TypeError, ValueError):
+                pass
+            return mg(input_ids, **named, **kwargs)
+        if input_ids is None:
+            raise ValueError("generate() needs input_ids")
+
+        import jax
+        import jax.numpy as jnp
+
+        input_ids = jnp.asarray(input_ids)
+        if input_ids.ndim == 1:
+            input_ids = input_ids[None]
+        B, S0 = input_ids.shape
+        total = S0 + int(max_new_tokens)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+
+        # the loop needs a causal-LM-shaped module: ids [B, S] -> logits [B, S, V]
+        try:
+            probe = jax.eval_shape(
+                (lambda p, i: self._apply(p, i)) if self.params is not None else
+                (lambda p, i: self._apply(i)), self.params, input_ids)
+            probe = probe[0] if isinstance(probe, tuple) else probe
+            if len(probe.shape) != 3 or probe.shape[:2] != (B, S0):
+                raise TypeError(f"forward returns {probe.shape}, not [B, S, vocab]")
+        except Exception as e:
+            raise NotImplementedError(
+                f"generate() needs a causal-LM module (ids [B,S] -> logits [B,S,V]); "
+                f"this module does not qualify ({e}); provide module.generate or use "
+                f"the v2 ragged engine") from e
+
+        key = ("gen", B, S0, total, bool(do_sample), float(temperature), eos)
+        if not hasattr(self, "_gen_cache"):
+            self._gen_cache = {}
+        if key not in self._gen_cache:
+            apply, params_given = self._apply, self.params is not None
+            temp = float(temperature)
+
+            def logits_at(params, ids, pos):
+                out = apply(params, ids) if params_given else apply(ids)
+                logits = out[0] if isinstance(out, tuple) else out
+                return jax.lax.dynamic_slice_in_dim(logits, pos, 1, axis=1)[:, 0]
+
+            def run(params, ids0, r):
+                def step(i, carry):
+                    ids, done, r = carry
+                    logits = logits_at(params, ids, i - 1)
+                    r, sub = jax.random.split(r)
+                    if do_sample:
+                        nxt = jax.random.categorical(sub, logits / max(temp, 1e-6), axis=-1)
+                    else:
+                        nxt = jnp.argmax(logits, axis=-1)
+                    nxt = jnp.where(done, 0, nxt).astype(ids.dtype)
+                    ids = jax.lax.dynamic_update_slice_in_dim(ids, nxt[:, None], i, axis=1)
+                    done = done | (nxt == eos)
+                    return ids, done, r
+
+                pad = jnp.zeros((B, total - S0), ids0.dtype)
+                ids = jnp.concatenate([ids0, pad], axis=1)
+                done = jnp.zeros((B, ), bool)
+                ids, done, _ = jax.lax.fori_loop(S0, total, step, (ids, done, r))
+                return ids
+
+            self._gen_cache[key] = jax.jit(run)
+        return self._gen_cache[key](self.params, input_ids, rng)
 
     def profile_model_time(self, use_cuda_events=True):
         logger.warning("model profiling on TPU: use jax.profiler traces")

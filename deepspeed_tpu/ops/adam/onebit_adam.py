@@ -70,12 +70,11 @@ class OnebitAdam(TpuOptimizer):
             compressed = scale * jnp.sign(compensated).astype(p.dtype)
             m_used = jnp.where(frozen, compressed, m_new)
             err_new = jnp.where(frozen, compensated - compressed, err)
-            m_kept = jnp.where(frozen, compressed, m_new)
 
             update = (m_used / bc1) / (jnp.sqrt(v_new / bc2) + self.eps)
             if wd != 0.0:
                 update = update + wd * p
-            return p - lr * update, m_kept, v_new, err_new
+            return p - lr * update, m_used, v_new, err_new
 
         p_flat, treedef = jax.tree.flatten(params)
         g_flat = treedef.flatten_up_to(grads)

@@ -1,0 +1,152 @@
+"""0/1 Adam.
+
+Reference: ``deepspeed/runtime/fp16/onebit/zoadam.py`` (ZeroOneAdam,
+arXiv:2202.06009). Semantics reproduced:
+
+- **Variance-update policy** (zoadam.py:265-280): until ``var_freeze_step`` the
+  variance refreshes only at steps divisible by ``var_interval``; each
+  ``var_update_scaler`` refreshes, the interval doubles. At refresh steps the
+  momentum consumes the exact gradient; between refreshes it consumes the
+  sign-compressed gradient with error feedback (zoadam.py:205-218).
+- **Local-step policy** (zoadam.py:241-261): after the variance freezes,
+  parameters advance every step while the accumulated update
+  (``momentum_accumulator``) syncs only every ``local_step_interval`` steps —
+  scaled by the denominator, sign-compressed with error feedback, and the
+  momentum is re-seeded from the synced buffer divided by the accumulated lr.
+  The interval doubles every ``local_step_scaler`` counts, clipped at
+  ``local_step_clipper``.
+
+TPU note: under single-program SPMD the gradient arriving here is already the
+group mean (XLA's psum), so the compression models the wire fidelity while the
+interval policies reproduce the optimizer's trajectory exactly.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optimizer import TpuOptimizer, _tree_zeros_like
+
+
+class ZeroOneAdamState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: any
+    exp_avg_sq: any
+    worker_error: any         # gradient-compression error (warmup stage)
+    sync_error: any           # buffer-compression error (local-step stage —
+                              # the reference reinitializes its error buffers at
+                              # the freeze transition, zoadam.py:306-311)
+    comm_buffer: any          # momentum_accumulator (local-step stage)
+    lrs: jnp.ndarray          # accumulated lr between syncs
+    var_interval: jnp.ndarray
+    var_counter: jnp.ndarray
+    local_interval: jnp.ndarray
+    local_counter: jnp.ndarray
+
+
+class ZeroOneAdam(TpuOptimizer):
+
+    name = "zerooneadam"
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                 var_freeze_step=100, var_update_scaler=16, local_step_scaler=100,
+                 local_step_clipper=16, cuda_aware=False, comm_backend_name="xla"):
+        super().__init__(lr=lr, weight_decay=weight_decay)
+        self.betas = betas
+        self.eps = eps
+        self.var_freeze_step = int(var_freeze_step)
+        self.var_update_scaler = int(var_update_scaler)
+        self.local_step_scaler = int(local_step_scaler)
+        self.local_step_clipper = int(local_step_clipper)
+
+    def init(self, params):
+        return ZeroOneAdamState(step=jnp.zeros([], jnp.int32),
+                                exp_avg=_tree_zeros_like(params),
+                                exp_avg_sq=_tree_zeros_like(params),
+                                worker_error=_tree_zeros_like(params),
+                                sync_error=_tree_zeros_like(params),
+                                comm_buffer=_tree_zeros_like(params),
+                                lrs=jnp.zeros([], jnp.float32),
+                                var_interval=jnp.ones([], jnp.int32),
+                                var_counter=jnp.zeros([], jnp.int32),
+                                local_interval=jnp.ones([], jnp.int32),
+                                local_counter=jnp.zeros([], jnp.int32))
+
+    def update(self, grads, state, params, lr):
+        b1, b2 = self.betas
+        eps = self.eps
+        wd = self.weight_decay
+        step = state.step + 1
+        frozen = step > self.var_freeze_step
+        var_refresh = (~frozen) & (step % state.var_interval == 0)
+        sync_now = frozen & (step % state.local_interval == 0)
+        lrs_new = jnp.where(sync_now, 0.0, jnp.where(frozen, state.lrs + lr, state.lrs))
+        lrs_at_sync = state.lrs + lr
+
+        def upd(p, g, m, v, err, serr, buf):
+            g = g.astype(p.dtype)
+            # between variance refreshes the momentum sees the compressed grad
+            compensated = g + err
+            scale = jnp.mean(jnp.abs(compensated))
+            g_comp = scale * jnp.sign(compensated).astype(p.dtype)
+            use_exact = var_refresh | frozen
+            g_used = jnp.where(use_exact, g, g_comp)
+            err_new = jnp.where(use_exact, err, compensated - g_comp)
+
+            m_new = b1 * m + (1.0 - b1) * g_used
+            v_new = jnp.where(var_refresh, b2 * v + (1.0 - b2) * (g * g), v)
+
+            denom = jnp.sqrt(v_new) + eps
+            update = m_new / denom
+            if wd > 0.0:
+                update = update + wd * p
+            p_new = p - lr * update
+            buf_acc = jnp.where(frozen, buf - lr * update, buf)
+
+            # ---- local-step sync (zoadam.py:243-261) ----
+            # revert local drift, sync the denominator-scaled buffer
+            # (compressed, error-fed), re-seed momentum, re-apply
+            p_revert = p_new - buf_acc
+            buf_scaled = buf_acc * denom
+            comp2 = buf_scaled + serr
+            scale2 = jnp.mean(jnp.abs(comp2))
+            buf_sync = scale2 * jnp.sign(comp2).astype(p.dtype)
+            serr_sync = comp2 - buf_sync
+            m_sync = -buf_sync / jnp.maximum(lrs_at_sync, 1e-12)
+            p_sync = p_revert + buf_sync / denom
+
+            p_out = jnp.where(sync_now, p_sync, p_new)
+            m_out = jnp.where(sync_now, m_sync, m_new)
+            buf_out = jnp.where(sync_now, jnp.zeros_like(buf), buf_acc)
+            serr_out = jnp.where(sync_now, serr_sync, serr)
+            return p_out, m_out, v_new, err_new, serr_out, buf_out
+
+        p_flat, treedef = jax.tree.flatten(params)
+        flats = [treedef.flatten_up_to(t) for t in
+                 (grads, state.exp_avg, state.exp_avg_sq, state.worker_error,
+                  state.sync_error, state.comm_buffer)]
+        out = [upd(p, *args) for p, *args in zip(p_flat, *flats)]
+        unf = lambda i: jax.tree.unflatten(treedef, [o[i] for o in out])
+
+        # interval policies (zoadam.py:265-286)
+        vc = jnp.where(var_refresh, state.var_counter + 1, state.var_counter)
+        double_var = vc == self.var_update_scaler
+        var_counter = jnp.where(~frozen, jnp.where(double_var, 0, vc), state.var_counter)
+        var_interval = jnp.where((~frozen) & double_var, state.var_interval * 2,
+                                 state.var_interval)
+        lc = jnp.where(frozen, state.local_counter + 1, state.local_counter)
+        double_local = lc == self.local_step_scaler
+        local_counter = jnp.where(frozen, jnp.where(double_local, 0, lc), state.local_counter)
+        local_interval = jnp.where(frozen & double_local,
+                                   jnp.minimum(self.local_step_clipper,
+                                               state.local_interval * 2),
+                                   state.local_interval)
+
+        return unf(0), ZeroOneAdamState(step=step, exp_avg=unf(1), exp_avg_sq=unf(2),
+                                        worker_error=unf(3), sync_error=unf(4),
+                                        comm_buffer=unf(5),
+                                        lrs=lrs_new, var_interval=var_interval,
+                                        var_counter=var_counter,
+                                        local_interval=local_interval,
+                                        local_counter=local_counter)

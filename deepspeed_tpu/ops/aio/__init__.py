@@ -1,0 +1,3 @@
+from deepspeed_tpu.ops.aio.aio_op import AsyncIOHandle, aio_available
+
+__all__ = ["AsyncIOHandle", "aio_available"]

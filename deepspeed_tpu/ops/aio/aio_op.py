@@ -1,0 +1,155 @@
+"""Python surface of the native async-IO engine.
+
+Role parity: ``/root/reference/csrc/aio/py_lib/py_ds_aio.cpp`` (``aio_handle``
+with async_pread/async_pwrite/wait) and ``deepspeed_py_aio_handle.cpp``. The
+consumers are numpy buffers (the pinned-host staging side of the NVMe swap
+tier); requests are submitted to the C++ thread pool and completed with
+``wait``/``wait_all``.
+
+Falls back to a pure-Python ThreadPoolExecutor engine when no C++ toolchain is
+available, so the swap tier degrades instead of disappearing.
+"""
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+_LIB = None
+_LIB_TRIED = False
+
+
+def _native_lib():
+    global _LIB, _LIB_TRIED
+    if not _LIB_TRIED:
+        _LIB_TRIED = True
+        try:
+            from deepspeed_tpu.ops.op_builder import AsyncIOBuilder
+            _LIB = AsyncIOBuilder().load()
+        except Exception as e:  # no compiler / build failure
+            logger.warning(f"native async_io unavailable ({e}); using Python thread pool")
+            _LIB = None
+    return _LIB
+
+
+def aio_available() -> bool:
+    return _native_lib() is not None
+
+
+def _check_buffer(buf: np.ndarray):
+    if not isinstance(buf, np.ndarray):
+        raise TypeError(f"aio buffers must be numpy arrays, got {type(buf)}")
+    if not buf.flags["C_CONTIGUOUS"]:
+        raise ValueError("aio buffers must be C-contiguous")
+
+
+class AsyncIOHandle:
+    """Handle over the native thread pool (reference ``aio_handle``).
+
+    ``async_pread/async_pwrite`` return request ids; ``wait(id)`` returns bytes
+    transferred (raises on I/O error); ``wait_all`` drains every outstanding
+    request.
+    """
+
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 32,
+                 thread_count: int = 4, single_submit: bool = False,
+                 overlap_events: bool = True):
+        self.block_size = block_size
+        self.queue_depth = queue_depth
+        self.thread_count = thread_count
+        self._lib = _native_lib()
+        self._handle = None
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._futures = {}
+        self._next_id = 1
+        if self._lib is not None:
+            self._handle = self._lib.dstpu_aio_new(thread_count, queue_depth)
+        else:
+            self._pool = ThreadPoolExecutor(max_workers=max(1, thread_count))
+
+    # -- fallback engine ---------------------------------------------------------
+    def _py_submit(self, is_write: bool, path: str, buf: np.ndarray, offset: int) -> int:
+        def run():
+            mode = "r+b" if is_write and os.path.exists(path) else ("wb" if is_write else "rb")
+            with open(path, mode) as f:
+                f.seek(offset)
+                if is_write:
+                    f.write(memoryview(buf).cast("B"))
+                    f.flush()
+                    os.fsync(f.fileno())
+                    return buf.nbytes
+                data = f.read(buf.nbytes)
+                flat = memoryview(buf).cast("B")
+                flat[:len(data)] = data
+                return len(data)
+
+        rid = self._next_id
+        self._next_id += 1
+        self._futures[rid] = self._pool.submit(run)
+        return rid
+
+    # -- API ---------------------------------------------------------------------
+    def async_pread(self, buffer: np.ndarray, path: str, offset: int = 0) -> int:
+        _check_buffer(buffer)
+        if self._handle is not None:
+            rid = self._lib.dstpu_aio_submit_read(
+                self._handle, os.fsencode(path), buffer.ctypes.data, buffer.nbytes, offset)
+            if rid < 0:
+                raise OSError(-rid, f"aio submit_read {path}")
+            return rid
+        return self._py_submit(False, path, buffer, offset)
+
+    def async_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0) -> int:
+        _check_buffer(buffer)
+        if self._handle is not None:
+            rid = self._lib.dstpu_aio_submit_write(
+                self._handle, os.fsencode(path), buffer.ctypes.data, buffer.nbytes, offset)
+            if rid < 0:
+                raise OSError(-rid, f"aio submit_write {path}")
+            return rid
+        return self._py_submit(True, path, buffer, offset)
+
+    def wait(self, request_id: int) -> int:
+        if self._handle is not None:
+            rc = self._lib.dstpu_aio_wait(self._handle, request_id)
+            if rc < 0:
+                raise OSError(-rc, f"aio request {request_id} failed")
+            return rc
+        fut = self._futures.pop(request_id)
+        return fut.result()
+
+    def wait_all(self):
+        if self._handle is not None:
+            rc = self._lib.dstpu_aio_wait_all(self._handle)
+            if rc < 0:
+                raise OSError(-rc, "aio wait_all: a request failed")
+            return
+        futs, self._futures = self._futures, {}
+        for f in futs.values():
+            f.result()
+
+    # synchronous one-shots (reference deepspeed_py_aio.cpp)
+    def sync_pread(self, buffer: np.ndarray, path: str, offset: int = 0) -> int:
+        rid = self.async_pread(buffer, path, offset)
+        return self.wait(rid)
+
+    def sync_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0) -> int:
+        rid = self.async_pwrite(buffer, path, offset)
+        return self.wait(rid)
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.dstpu_aio_free(self._handle)
+            self._handle = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

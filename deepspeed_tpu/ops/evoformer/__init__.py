@@ -1,0 +1,3 @@
+from deepspeed_tpu.ops.evoformer.attention import DS4Sci_EvoformerAttention, evoformer_attention
+
+__all__ = ["DS4Sci_EvoformerAttention", "evoformer_attention"]

@@ -1,0 +1,50 @@
+"""Evoformer attention (DS4Sci).
+
+Reference: ``deepspeed/ops/deepspeed4science/evoformer_attn.py``
+(DS4Sci_EvoformerAttention:88 over the CUTLASS kernels in
+``csrc/deepspeed4science/evoformer_attn/``): attention over AlphaFold2
+evoformer shapes ``[*, seq, heads, dim]`` with up to two additive biases —
+bias1 broadcast over rows (MSA mask, ``[B, N, 1, 1, S]``) and bias2 the pair
+representation (``[B, 1, H, S, S]``) — computed in bf16/fp16.
+
+TPU formulation: one einsum-softmax-einsum chain; XLA fuses the bias adds and
+the softmax into the MXU matmuls, which is exactly what the hand-written CUDA
+kernel exists to do. The scale is 1/√d applied to Q (reference _attention).
+"""
+
+from typing import List, Optional
+
+import numpy as np
+
+
+def evoformer_attention(q, k, v, bias1=None, bias2=None):
+    """q/k/v: [..., S, H, D] (AlphaFold layout, heads after sequence);
+    biases broadcast against [..., H, S_q, S_k]. Returns [..., S, H, D]."""
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    scale = 1.0 / float(np.sqrt(d))
+    # [..., S, H, D] -> [..., H, S, D]
+    qh = jnp.swapaxes(q, -2, -3) * scale
+    kh = jnp.swapaxes(k, -2, -3)
+    vh = jnp.swapaxes(v, -2, -3)
+    scores = jnp.einsum("...qd,...kd->...qk", qh, kh)
+    if bias1 is not None:
+        scores = scores + bias1
+    if bias2 is not None:
+        scores = scores + bias2
+    probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum("...qk,...kd->...qd", probs, vh)
+    return jnp.swapaxes(out, -2, -3)
+
+
+def DS4Sci_EvoformerAttention(Q, K, V, biases: Optional[List] = None):
+    """Reference-named entry (evoformer_attn.py:88): validates the two-bias
+    contract and dispatches to :func:`evoformer_attention`."""
+    biases = [b for b in (biases or []) if b is not None]
+    if len(biases) > 2:
+        raise ValueError("DS4Sci_EvoformerAttention supports at most two biases")
+    bias1 = biases[0] if len(biases) >= 1 else None
+    bias2 = biases[1] if len(biases) == 2 else None
+    return evoformer_attention(Q, K, V, bias1=bias1, bias2=bias2)

@@ -1,0 +1,140 @@
+"""1-bit LAMB.
+
+Reference: ``deepspeed/runtime/fp16/onebit/lamb.py`` (OnebitLamb, NeurIPS'21
+"1-bit LAMB", arXiv:2104.06069). Semantics reproduced:
+
+- **Warmup** (step ≤ freeze_step): exact LAMB — per-tensor trust ratio
+  ``lamb_coeff = clip(||w|| / ||update||, min_coeff, max_coeff)`` with a
+  running EMA ``lamb_coeff_freeze`` (coeff_beta) that the compressed stage
+  inherits.
+- **Compressed stage**: variance frozen; the momentum travels sign-compressed
+  with error feedback; a *fresh* variance is maintained from the gradient
+  reconstructed out of the compressed momentum
+  (``grad_rec = (m_t - β1·m_{t-1}) / (1-β1)``, reference lamb.py:333), and the
+  trust ratio becomes ``lamb_coeff_freeze × factor`` where
+  ``factor = max(denom_frozen / denom_fresh)`` clipped to
+  [factor_min, factor_max] and rate-limited per step by factor_threshold
+  (reference lamb.py:343-360).
+
+Divergence (documented): the reference unifies momentum scales across layers
+with a one-time ``scaling_coeff`` so a single flattened sign-compression works
+(lamb.py:171-182); our compression is per-tensor with a per-tensor L1 scale,
+which makes the united scale unnecessary.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optimizer import TpuOptimizer, _tree_zeros_like
+
+
+class OnebitLambState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: any
+    exp_avg_sq: any        # frozen after freeze_step
+    exp_avg_sq_fresh: any  # reconstructed-gradient variance (compressed stage)
+    worker_error: any      # error feedback
+    lamb_coeff_freeze: any # per-tensor EMA of the warmup trust ratio
+    last_factor: any       # per-tensor factor rate-limiter state
+
+
+class OnebitLamb(TpuOptimizer):
+
+    name = "onebitlamb"
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                 freeze_step=100, max_coeff=10.0, min_coeff=0.01, coeff_beta=0.9,
+                 factor_max=4.0, factor_min=0.5, factor_threshold=0.1,
+                 cuda_aware=False, comm_backend_name="xla"):
+        super().__init__(lr=lr, weight_decay=weight_decay)
+        self.betas = betas
+        self.eps = eps
+        self.freeze_step = int(freeze_step)
+        self.max_coeff = max_coeff
+        self.min_coeff = min_coeff
+        self.coeff_beta = coeff_beta
+        self.factor_max = factor_max
+        self.factor_min = factor_min
+        self.factor_threshold = factor_threshold
+
+    def init(self, params):
+        scalar = jax.tree.map(lambda p: jnp.zeros([], jnp.float32), params)
+        return OnebitLambState(step=jnp.zeros([], jnp.int32),
+                               exp_avg=_tree_zeros_like(params),
+                               exp_avg_sq=_tree_zeros_like(params),
+                               exp_avg_sq_fresh=_tree_zeros_like(params),
+                               worker_error=_tree_zeros_like(params),
+                               lamb_coeff_freeze=scalar,
+                               last_factor=jax.tree.map(lambda p: jnp.ones([], jnp.float32),
+                                                        params))
+
+    def update(self, grads, state, params, lr):
+        b1, b2 = self.betas
+        step = state.step + 1
+        frozen = step > self.freeze_step
+        at_freeze_boundary = step == (self.freeze_step + 1)
+        wd = self.weight_decay
+        eps = self.eps
+
+        def upd(p, g, m, v, vf, err, cf, lf):
+            g = g.astype(p.dtype)
+            m_prev = m
+            m_new = b1 * m + (1.0 - b1) * g
+            v_warm = b2 * v + (1.0 - b2) * (g * g)
+            v_new = jnp.where(frozen, v, v_warm)  # frozen after warmup
+
+            # ---- compressed-stage momentum: sign + L1 scale + error feedback
+            compensated = m_new + err
+            scale = jnp.mean(jnp.abs(compensated))
+            compressed = scale * jnp.sign(compensated).astype(p.dtype)
+            m_used = jnp.where(frozen, compressed, m_new)
+            err_new = jnp.where(frozen, compensated - compressed, err)
+
+            # fresh variance from the reconstructed gradient (reference :333);
+            # seeded from the frozen variance at the boundary
+            g_rec = (m_used - b1 * m_prev) / (1.0 - b1)
+            vf_base = jnp.where(at_freeze_boundary, v_new, vf)
+            vf_new = jnp.where(frozen, b2 * vf_base + (1.0 - b2) * (g_rec * g_rec), vf)
+
+            denom = jnp.sqrt(v_new) + eps
+            update_prelim = m_used / denom
+            update = update_prelim + wd * p if wd > 0.0 else update_prelim
+
+            w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+            u_norm = jnp.linalg.norm(update.astype(jnp.float32))
+            raw_coeff = jnp.where((w_norm > 0) & (u_norm > 0),
+                                  jnp.clip(w_norm / jnp.maximum(u_norm, 1e-12),
+                                           self.min_coeff, self.max_coeff),
+                                  1.0)
+            cf_new = jnp.where(frozen, cf,
+                               jnp.where(raw_coeff != 1.0,
+                                         self.coeff_beta * cf + (1 - self.coeff_beta) * raw_coeff,
+                                         cf))
+
+            # ---- compressed-stage factor (reference :343-360)
+            denom_real = jnp.sqrt(jnp.where(frozen, vf_new, v_new)) + eps
+            factor = jnp.max(denom / denom_real)
+            if wd > 0.0:
+                ratio = jnp.minimum(
+                    1.0, jnp.linalg.norm(update_prelim.astype(jnp.float32)) /
+                    jnp.maximum(u_norm, 1e-12))
+                factor = factor * ratio + (1.0 - ratio)
+            factor = jnp.clip(factor, self.factor_min, self.factor_max)
+            factor = jnp.clip(factor, lf * (1.0 - self.factor_threshold),
+                              lf * (1.0 + self.factor_threshold))
+            lf_new = jnp.where(frozen, factor, lf)
+
+            coeff = jnp.where(frozen, cf_new * factor, raw_coeff)
+            return (p - lr * coeff * update, m_used, v_new, vf_new, err_new, cf_new, lf_new)
+
+        p_flat, treedef = jax.tree.flatten(params)
+        flats = [treedef.flatten_up_to(t) for t in
+                 (grads, state.exp_avg, state.exp_avg_sq, state.exp_avg_sq_fresh,
+                  state.worker_error, state.lamb_coeff_freeze, state.last_factor)]
+        out = [upd(p, *args) for p, *args in zip(p_flat, *flats)]
+        unf = lambda i: jax.tree.unflatten(treedef, [o[i] for o in out])
+        return unf(0), OnebitLambState(step=step, exp_avg=unf(1), exp_avg_sq=unf(2),
+                                       exp_avg_sq_fresh=unf(3), worker_error=unf(4),
+                                       lamb_coeff_freeze=unf(5), last_factor=unf(6))

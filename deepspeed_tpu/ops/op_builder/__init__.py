@@ -1,0 +1,17 @@
+"""Native-op builder registry (reference ``op_builder/__init__.py`` +
+``all_ops.py``: the dict ``ds_report`` walks to print build compatibility).
+
+Pallas/XLA compute ops need no build step; only runtime-tier native code
+registers here.
+"""
+
+from deepspeed_tpu.ops.op_builder.async_io import AsyncIOBuilder
+from deepspeed_tpu.ops.op_builder.builder import OpBuilder
+
+ALL_OPS = {
+    AsyncIOBuilder.NAME: AsyncIOBuilder,
+}
+
+
+def get_op_builder(name: str) -> OpBuilder:
+    return ALL_OPS[name]()

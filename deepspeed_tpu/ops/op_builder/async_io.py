@@ -1,0 +1,36 @@
+"""AsyncIO builder (reference ``op_builder/async_io.py`` AsyncIOBuilder:12).
+
+The reference links libaio and probes for it in ``is_compatible``; our engine
+is a std::thread pool over positional pread/pwrite (csrc/aio/dstpu_aio.cpp), so
+the only requirement is a C++17 toolchain.
+"""
+
+import ctypes
+
+from deepspeed_tpu.ops.op_builder.builder import OpBuilder
+
+
+class AsyncIOBuilder(OpBuilder):
+    BUILD_VAR = "DSTPU_BUILD_AIO"
+    NAME = "async_io"
+
+    def sources(self):
+        return ["csrc/aio/dstpu_aio.cpp"]
+
+    def load(self) -> ctypes.CDLL:
+        lib = super().load()
+        lib.dstpu_aio_new.restype = ctypes.c_void_p
+        lib.dstpu_aio_new.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.dstpu_aio_free.argtypes = [ctypes.c_void_p]
+        for fn in (lib.dstpu_aio_submit_read, lib.dstpu_aio_submit_write):
+            fn.restype = ctypes.c_long
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                           ctypes.c_long, ctypes.c_long]
+        lib.dstpu_aio_wait.restype = ctypes.c_long
+        lib.dstpu_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.dstpu_aio_wait_all.restype = ctypes.c_long
+        lib.dstpu_aio_wait_all.argtypes = [ctypes.c_void_p]
+        for fn in (lib.dstpu_aio_pread, lib.dstpu_aio_pwrite):
+            fn.restype = ctypes.c_long
+            fn.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_long, ctypes.c_long]
+        return lib

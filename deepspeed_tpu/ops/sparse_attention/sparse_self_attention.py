@@ -1,0 +1,74 @@
+"""Block-sparse self-attention over a sparsity layout.
+
+Reference: ``deepspeed/ops/sparse_attention/sparse_self_attention.py``
+(SparseSelfAttention:15 — Triton block-sparse sdd/dsd matmuls + masked
+softmax). TPU formulation: the layout expands to a block-structured boolean
+mask consumed by a masked attention; XLA fuses mask-add into the softmax and
+the block structure keeps the mask cheap to materialize. For long sequences the
+flash path (``ops/pallas/flash_attention.py``) with a window is the
+sliding-window special case; this module is the general-layout surface.
+"""
+
+from typing import Optional
+
+import numpy as np
+
+
+def layout_to_dense_mask(layout, block: int):
+    """[H, nb, nb] block layout → [H, S, S] boolean token mask."""
+    import jax.numpy as jnp
+    lay = jnp.asarray(layout, bool)
+    return jnp.repeat(jnp.repeat(lay, block, axis=1), block, axis=2)
+
+
+def sparse_self_attention(q, k, v, layout, block: int, scale: Optional[float] = None,
+                          key_padding_mask=None, attn_mask=None):
+    """q/k/v: [B, H, S, D]; layout: [H, nb, nb]; returns [B, H, S, D].
+
+    ``key_padding_mask`` [B, S] and ``attn_mask`` [S, S] follow the reference's
+    additive/boolean semantics: True (or 0) = keep, False (or -inf) = drop.
+    """
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / float(np.sqrt(d))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+
+    neg = jnp.asarray(jnp.finfo(scores.dtype).min, scores.dtype)
+    mask = layout_to_dense_mask(layout, block)[None]  # [1, H, S, S]
+    scores = jnp.where(mask, scores, neg)
+    if key_padding_mask is not None:
+        kpm = jnp.asarray(key_padding_mask, bool)[:, None, None, :]
+        scores = jnp.where(kpm, scores, neg)
+    if attn_mask is not None:
+        am = jnp.asarray(attn_mask, bool)[None, None]
+        scores = jnp.where(am, scores, neg)
+
+    probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    # rows with no attended block (possible under padding) become zeros, not NaN
+    denom = jnp.sum(probs, axis=-1, keepdims=True)
+    probs = probs / jnp.maximum(denom, 1e-20)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+class SparseSelfAttention:
+    """Layout-holding wrapper (reference SparseSelfAttention module surface)."""
+
+    def __init__(self, sparsity_config, key_padding_mask_mode="add", attn_mask_mode="mul",
+                 max_seq_length: int = 2048):
+        self.sparsity_config = sparsity_config
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self._layouts = {}
+
+    def get_layout(self, seq_len):
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = self.sparsity_config.make_layout(seq_len)
+        return self._layouts[seq_len]
+
+    def __call__(self, query, key, value, rpe=None, key_padding_mask=None, attn_mask=None):
+        layout = self.get_layout(query.shape[-2])
+        return sparse_self_attention(query, key, value, layout,
+                                     self.sparsity_config.block,
+                                     key_padding_mask=key_padding_mask,
+                                     attn_mask=attn_mask)

@@ -1,0 +1,105 @@
+"""Standalone activation-checkpointing API.
+
+Reference: ``deepspeed/runtime/activation_checkpointing/checkpointing.py``
+(``configure:871``, ``checkpoint:748`` — CheckpointFunction with partitioned
+activations across TP ranks, optional CPU checkpointing, contiguous buffers,
+RNG state tracking).
+
+TPU mapping (each knob → its XLA-era mechanism):
+
+- ``checkpoint(fn, *args)`` → ``jax.checkpoint`` (remat): recompute in the
+  backward instead of storing; RNG correctness is automatic (same key re-used
+  on recompute — the role of the reference's CudaRNGStatesTracker).
+- ``partition_activations`` → save the dot outputs instead of nothing; under
+  TP/ZeRO shardings those saved residuals are already partitioned arrays, so
+  each rank stores only its shard (the reference's partition-then-allgather).
+- ``cpu_checkpointing`` → offload saved dot products to pinned host memory
+  when the backend supports it (``offload_dot_products_to_host``), the
+  reference's CPU checkpoint buffer.
+- ``contiguous_memory_optimization``/``number_checkpoints``/``profile`` are
+  accepted for config parity; XLA's allocator already packs remat buffers.
+"""
+
+from typing import Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+_CONFIG = None
+
+
+def _policy():
+    import jax
+    if _CONFIG is None:
+        return jax.checkpoint_policies.nothing_saveable
+    if _CONFIG.cpu_checkpointing:
+        cp = getattr(jax.checkpoint_policies, "offload_dot_products_to_host", None)
+        if cp is not None:
+            return cp("device", "pinned_host")
+        logger.warning("cpu_checkpointing: this jax has no host-offload remat policy; "
+                       "saving dot products on device instead")
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if _CONFIG.partition_activations:
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    return jax.checkpoint_policies.nothing_saveable
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    """Reference checkpointing.py:871 — flags override the config block."""
+    global _CONFIG
+    from deepspeed_tpu.runtime.config import ActivationCheckpointingConfig, DeepSpeedConfig
+
+    if deepspeed_config is not None:
+        if isinstance(deepspeed_config, DeepSpeedConfig):
+            _CONFIG = deepspeed_config.activation_checkpointing_config
+        else:
+            _CONFIG = DeepSpeedConfig(deepspeed_config).activation_checkpointing_config
+    elif _CONFIG is None:
+        _CONFIG = ActivationCheckpointingConfig()
+    if partition_activations is not None:
+        _CONFIG.partition_activations = partition_activations
+    if checkpoint_in_cpu is not None:
+        _CONFIG.cpu_checkpointing = checkpoint_in_cpu
+    if num_checkpoints is not None:
+        _CONFIG.number_checkpoints = num_checkpoints
+    if contiguous_checkpointing is not None:
+        _CONFIG.contiguous_memory_optimization = contiguous_checkpointing
+    if profile is not None:
+        _CONFIG.profile = profile
+
+
+def is_configured() -> bool:
+    return _CONFIG is not None
+
+
+def reset():
+    """Reference checkpointing.py:999 (buffer reset) + test isolation."""
+    global _CONFIG
+    _CONFIG = None
+
+
+def checkpoint(function, *args):
+    """Rematerialized call of ``function(*args)`` (reference checkpoint:748).
+
+    Differentiable; the saved-residual policy follows :func:`configure`.
+    """
+    import jax
+    return jax.checkpoint(function, policy=_policy())(*args)
+
+
+def checkpoint_wrapped(function):
+    """The transform itself (for wrapping layers once, not per call)."""
+    import jax
+    return jax.checkpoint(function, policy=_policy())
+
+
+# RNG-tracker parity surface: jax.checkpoint replays the same PRNG keys on
+# recompute, so these are well-defined no-ops kept for API compatibility.
+def model_parallel_cuda_manual_seed(seed: int):
+    logger.info("model_parallel_cuda_manual_seed: PRNG keys are explicit under JAX; "
+                "remat replays them automatically")
+
+
+def get_cuda_rng_tracker():
+    return None

@@ -76,7 +76,7 @@ def save_engine_state(engine, save_dir, tag, client_state, save_latest):
     ck = OrbaxCheckpointEngine()
     arrays = {
         "params": engine.params,
-        "opt_state": _named_opt_state(engine.opt_state),
+        "opt_state": _named_opt_state(engine._offload.checkpoint_view(engine.opt_state)),
         "scale_state": engine.scale_state._asdict(),
     }
     ck.save(arrays, os.path.join(path, "state"))
@@ -124,15 +124,16 @@ def load_engine_state(engine, load_dir, tag, load_optimizer_states=True, load_lr
     # (the universal-checkpoint reshape of deepspeed/checkpoint/ds_to_universal.py).
     target = {
         "params": _shaped(engine.params, engine._param_shardings),
-        "opt_state": _named_opt_state(_shaped(engine.opt_state, None)),
+        "opt_state": _named_opt_state(engine._offload.restore_template(engine.opt_state)),
         "scale_state": {k: v for k, v in engine.scale_state._asdict().items()},
     }
     restored = ck.load(os.path.join(path, "state"), target=target)
     engine.params = jax.device_put(restored["params"], engine._param_shardings)
     if load_optimizer_states and not load_module_only:
-        # restore straight into the at-rest placement (pinned host when offloaded)
-        engine.opt_state = jax.device_put(type(engine.opt_state)(**restored["opt_state"]),
-                                          engine._offload.rest_shardings)
+        # restore straight into the at-rest placement (pinned host when
+        # offloaded, NVMe files under ZeRO-Infinity)
+        engine.opt_state = engine._offload.accept_restored(
+            type(engine.opt_state)(**restored["opt_state"]))
         from jax.sharding import NamedSharding, PartitionSpec as P
         from deepspeed_tpu.runtime.fp16.loss_scaler import LossScaleState
         # scalars must live on the CURRENT mesh (restored under a different

@@ -83,7 +83,9 @@ def compressed_allreduce(tensor, worker_error, server_error, axis_name=None, mes
         lambda x, we, se: compressed_allreduce_local(x[0], we[0], se[0], axis_name, n),
         mesh=mesh,
         in_specs=(P(axis_name), P(axis_name), P(axis_name)),
-        out_specs=(P(), P(), P(axis_name)),
+        # worker/server error feedback is PER-RANK state: keep it sharded over
+        # the axis (reference: each rank persists its own worker_error buffer)
+        out_specs=(P(), P(axis_name), P(axis_name)),
         check_vma=False)
     # feed each rank its own (replicated) copy: stack over the axis
     import jax.numpy as jnp
@@ -93,7 +95,8 @@ def compressed_allreduce(tensor, worker_error, server_error, axis_name=None, mes
             worker_error, (n, ) + worker_error.shape)
     ses = server_error.reshape((n, -1))
     out, we, se = fn(xs, wes, ses)
-    return out, we, se.reshape(-1)
+    # flat stacked layouts ([n*N] / [N]) so the next call's reshape round-trips
+    return out, we.reshape(-1), se.reshape(-1)
 
 
 def quantized_reduce_scatter_local(x, axis_name: str, n_ranks: int, block: int = 512):
@@ -107,10 +110,15 @@ def quantized_reduce_scatter_local(x, axis_name: str, n_ranks: int, block: int =
 
     N = x.shape[0]
     chunk = N // n_ranks
-    nb = max(1, chunk // block)
-    blk = chunk // nb
+    # pad each rank's chunk up to whole blocks so any N divisible by n_ranks
+    # works (the padding quantizes to exact zeros and is sliced off)
+    nb = -(-chunk // block)
+    pad = nb * block - chunk
 
-    v = x.reshape(n_ranks, nb, blk)
+    v = x.reshape(n_ranks, chunk)
+    if pad:
+        v = jnp.pad(v, ((0, 0), (0, pad)))
+    v = v.reshape(n_ranks, nb, block)
     scale = jnp.max(jnp.abs(v), axis=-1, keepdims=True) / 127.0
     scale = jnp.maximum(scale, 1e-12)
     q = jnp.clip(jnp.round(v / scale), -127, 127).astype(jnp.int8)
@@ -118,8 +126,7 @@ def quantized_reduce_scatter_local(x, axis_name: str, n_ranks: int, block: int =
     q_recv = jax.lax.all_to_all(q, axis_name, 0, 0, tiled=True)          # int8 wire
     s_recv = jax.lax.all_to_all(scale, axis_name, 0, 0, tiled=True)      # f32 scales
     deq = q_recv.astype(jnp.float32) * s_recv
-    return jnp.sum(deq, axis=0).reshape(chunk * 1) if nb == 1 else \
-        jnp.sum(deq, axis=0).reshape(chunk)
+    return jnp.sum(deq, axis=0).reshape(nb * block)[:chunk]
 
 
 def quantized_reduce_scatter(tensor, axis_name=None, mesh=None, block: int = 512):
@@ -134,6 +141,9 @@ def quantized_reduce_scatter(tensor, axis_name=None, mesh=None, block: int = 512
     n = int(mesh.shape.get(axis_name, 1))
     if n <= 1:
         return tensor
+    if tensor.shape[-1] % n != 0:
+        raise ValueError(f"reduce-scatter length {tensor.shape[-1]} must be divisible "
+                         f"by the axis size {n} (pad the flat gradient first)")
 
     fn = jax.shard_map(
         lambda x: quantized_reduce_scatter_local(x[0], axis_name, n, block),

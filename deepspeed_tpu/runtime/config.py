@@ -176,6 +176,13 @@ class DeepSpeedConfig:
         self.curriculum_enabled_legacy = bool(pd.get("curriculum_learning", {}).get("enabled", False))
         self.curriculum_params_legacy = pd.get("curriculum_learning", {})
 
+        # safe-mode sanity checks (SURVEY.md §5.2): debug_nans re-runs failing
+        # ops un-jitted; check_finite_grads validates every backward (host sync
+        # per microstep — a debug mode, like the reference's anomaly detection)
+        sanity = pd.get("sanity_checks", {})
+        self.debug_nans = bool(sanity.get("debug_nans", False))
+        self.check_finite_grads = bool(sanity.get("check_finite_grads", False))
+
         self.eigenvalue_enabled = bool(pd.get("eigenvalue", {}).get("enabled", False))
         self.progressive_layer_drop = pd.get("progressive_layer_drop", {})
         self.pld_enabled = bool(self.progressive_layer_drop.get("enabled", False))
@@ -185,7 +192,7 @@ class DeepSpeedConfig:
         from deepspeed_tpu.utils import groups
         if self.mesh is not None:
             dp = 1
-            for ax in ("data", "expert"):
+            for ax in ("data", "hpz", "expert"):
                 dp *= self.mesh.shape.get(ax, 1)
             return dp
         if groups.mesh_is_initialized():

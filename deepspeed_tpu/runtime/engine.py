@@ -67,10 +67,12 @@ def _make_optimizer(name, params_cfg):
     if name == "onebitadam":
         from deepspeed_tpu.ops.adam.onebit_adam import OnebitAdam
         return OnebitAdam(**cfg)
-    if name in ("onebitlamb", "zerooneadam"):
-        raise NotImplementedError(f"{name}: only onebitadam is implemented; the Lamb "
-                                  f"trust-ratio / 0-1 variable-freeze variants are not "
-                                  f"(silently substituting OnebitAdam would change numerics)")
+    if name == "onebitlamb":
+        from deepspeed_tpu.ops.lamb.onebit_lamb import OnebitLamb
+        return OnebitLamb(**cfg)
+    if name == "zerooneadam":
+        from deepspeed_tpu.ops.adam.zero_one_adam import ZeroOneAdam
+        return ZeroOneAdam(**cfg)
     if name in ("lamb", "fusedlamb"):
         return FusedLamb(**cfg)
     if name in ("lion", "fusedlion"):
@@ -134,14 +136,30 @@ class DeepSpeedEngine:
             self._config = DeepSpeedConfig(config, mpu=mpu, mesh=mesh)
 
         # 3. mesh/topology (reference groups.initialize, engine.py:1106-1145)
+        # hpZ / MiCS need the data dimension split into (data, hpz): the inner
+        # ``hpz`` axis is the intra-node secondary shard group.
+        zc0 = self._config.zero_config
+        secondary = 1
+        if zc0.zero_hpz_partition_size > 1:
+            secondary = zc0.zero_hpz_partition_size
+        elif zc0.mics_shard_size > 0:
+            secondary = zc0.mics_shard_size
         if mesh is not None:
             groups.set_mesh(mesh)
-        elif not groups.mesh_is_initialized():
+        elif not groups.mesh_is_initialized() or \
+                (secondary > 1 and groups.get_mesh().shape.get(groups.HPZ_AXIS, 1) != secondary):
             groups.initialize_mesh(model_parallel_size=self._config.tensor_parallel_size,
                                    pipe_parallel_size=self._config.pipeline_parallel_size,
                                    expert_parallel_size=self._config.expert_parallel_size,
-                                   sequence_parallel_size=self._config.sequence_parallel_size)
+                                   sequence_parallel_size=self._config.sequence_parallel_size,
+                                   secondary_partition_size=secondary,
+                                   force=True)
         self.mesh = groups.get_mesh()
+        if secondary > 1 and self.mesh.shape.get(groups.HPZ_AXIS, 1) != secondary:
+            raise groups.TopologyError(
+                f"hpZ/MiCS partition size {secondary} requires a mesh with an "
+                f"'hpz' axis of that size (got {dict(self.mesh.shape)}); build it via "
+                f"groups.initialize_mesh(secondary_partition_size={secondary})")
 
         # 4. precision policy (reference _configure_distributed_model dtype cast)
         if self._config.bfloat16_config.enabled:
@@ -155,11 +173,31 @@ class DeepSpeedEngine:
         self._dynamic_scale = self._fp16 and self._config.fp16_config.loss_scale == 0.0
 
         # 5. ZeRO placement policy (reference _configure_zero_optimizer, engine.py:1475)
+        # hpZ: params sharded over the secondary (intra-node) group only;
+        # MiCS: params+grads+opt all sharded within the group, replicated across.
+        policy_kwargs = {}
+        if zc0.mics_shard_size > 0:
+            policy_kwargs["zero_axes"] = groups.SECONDARY_PARTITION_AXES
+        elif zc0.zero_hpz_partition_size > 1:
+            policy_kwargs["param_axes"] = groups.SECONDARY_PARTITION_AXES
         self.zero_policy = ZeroShardingPolicy(
             stage=self._config.zero_config.stage,
             mesh=self.mesh,
             persistence_threshold=(self._config.zero_config.param_persistence_threshold
-                                   if self._config.zero_config.stage >= 3 else 0))
+                                   if self._config.zero_config.stage >= 3 else 0),
+            **policy_kwargs)
+
+        # 5b. qgZ: int8 gradient reduce-scatter (reference ZeRO++ qgZ,
+        # coalesced_collectives.py:73 — see runtime/comm/quantized_grads.py)
+        self._qgz = False
+        if zc0.zero_quantized_gradients:
+            from deepspeed_tpu.runtime.comm.quantized_grads import qgz_supported
+            if qgz_supported(self.mesh, zc0.stage):
+                self._qgz = True
+                logger.info("qgZ enabled: data-parallel gradients reduce as int8 blocks")
+            else:
+                logger.warning("zero_quantized_gradients requested but unsupported on this "
+                               "mesh/stage (needs ZeRO<=2 and a pure-DP mesh); using exact psum")
 
         # 6. loss function
         self.loss_fn = self._resolve_loss_fn(model, loss_fn)
@@ -211,15 +249,18 @@ class DeepSpeedEngine:
 
         # ZeRO-Offload: optimizer states in pinned host memory (reference
         # stage3.py:1816 + partitioned_optimizer_swapper.py:29; cpuadam implies it)
-        from deepspeed_tpu.runtime.zero.offload import OptimizerOffloadPlan
+        from deepspeed_tpu.runtime.zero.offload import NvmeOffloadPlan, OptimizerOffloadPlan
         offload_cfg = self._config.zero_config.offload_optimizer
         offload_enabled = getattr(self.optimizer, "offload", False)
         if offload_cfg is not None and str(offload_cfg.device) != "none":
-            if str(offload_cfg.device) == "nvme":
-                raise NotImplementedError("offload_optimizer.device=nvme is not implemented; "
-                                          "use device=cpu (pinned host memory)")
             offload_enabled = True
-        self._offload = OptimizerOffloadPlan(self._opt_shardings, offload_enabled, mesh=self.mesh)
+        if offload_cfg is not None and str(offload_cfg.device) == "nvme":
+            # ZeRO-Infinity disk tier (reference swap_tensor/, csrc/aio/)
+            self._offload = NvmeOffloadPlan(self._opt_shardings, offload_cfg.nvme_path,
+                                            aio_config=self._config.aio_config,
+                                            buffer_count=offload_cfg.buffer_count)
+        else:
+            self._offload = OptimizerOffloadPlan(self._opt_shardings, offload_enabled, mesh=self.mesh)
         self._opt_shardings = self._offload.compute_shardings
         self.opt_state = jax.jit(self.optimizer.init, out_shardings=self._opt_shardings)(self.params)
         self.opt_state = self._offload.stage_out(self.opt_state)
@@ -258,6 +299,27 @@ class DeepSpeedEngine:
         # 11. dataloader (reference deepspeed_io, engine.py:1686)
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data)
+
+        # progressive layer drop (reference engine.py _configure_progressive_layer_drop)
+        self.progressive_layer_drop = None
+        if self._config.pld_enabled:
+            from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+            pld_cfg = self._config.progressive_layer_drop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=pld_cfg.get("theta", 0.5), gamma=pld_cfg.get("gamma", 0.001))
+
+        # safe mode (SURVEY.md §5.2)
+        if self._config.debug_nans:
+            from deepspeed_tpu.utils.debug import enable_debug_nans
+            enable_debug_nans(True)
+
+        # eigenvalue (reference engine.py eigenvalue_enabled → runtime/eigenvalue.py)
+        self.eigenvalue = None
+        if self._config.eigenvalue_enabled:
+            from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+            ev = dict(self._config._param_dict.get("eigenvalue", {}))
+            ev.pop("enabled", None)
+            self.eigenvalue = Eigenvalue(**ev)
 
         # timers / monitor (reference EngineTimers:144, _write_monitor:2261)
         self.wall_clock_breakdown = self._config.wall_clock_breakdown
@@ -396,7 +458,7 @@ class DeepSpeedEngine:
         if ndim == 0:
             return NamedSharding(self.mesh, P())
         spec = [None] * ndim
-        dp_axes = tuple(ax for ax in (groups.DATA_AXIS, groups.EXPERT_AXIS) if self.mesh.shape.get(ax, 1) > 1)
+        dp_axes = tuple(ax for ax in groups.DATA_PARALLEL_AXES if self.mesh.shape.get(ax, 1) > 1)
         if dp_axes and leaf.shape[0] % int(np.prod([self.mesh.shape[a] for a in dp_axes])) == 0:
             spec[0] = dp_axes if len(dp_axes) > 1 else dp_axes[0]
         if ndim > 1 and self.mesh.shape.get(groups.SEQ_AXIS, 1) > 1 \
@@ -436,6 +498,10 @@ class DeepSpeedEngine:
             (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params, batch, rng, scale)
             grads = jax.tree.map(lambda g: g.astype(accum_dtype), grads)
             return loss, grads
+
+        if self._qgz:
+            from deepspeed_tpu.runtime.comm.quantized_grads import make_qgz_micro_grads
+            fn = make_qgz_micro_grads(loss_fn, takes_rng, compute_dtype, accum_dtype, self.mesh)
 
         self._compiled["grad"] = jax.jit(fn, out_shardings=(None, self._grad_shardings))
         return self._compiled["grad"]
@@ -506,6 +572,11 @@ class DeepSpeedEngine:
 
             (_, loss), grads = jax.value_and_grad(scaled, has_aux=True)(params)
             return loss, jax.tree.map(lambda g: g.astype(accum_dtype), grads)
+
+        if self._qgz:
+            from deepspeed_tpu.runtime.comm.quantized_grads import make_qgz_micro_grads
+            micro_grads = make_qgz_micro_grads(loss_fn, takes_rng, compute_dtype, accum_dtype,
+                                               self.mesh)
 
         def fn(params, opt_state, scale_state, batches, rng, lr):
             # batches: pytree with leading [gas, micro, ...]
@@ -614,6 +685,9 @@ class DeepSpeedEngine:
         1/GAS happens at the boundary here — same numerics, one less pass)."""
         assert self._cached_grads is not None, "backward() must follow forward()"
         self.timers(BACKWARD_MICRO_TIMER).start()
+        if self._config.check_finite_grads:
+            from deepspeed_tpu.utils.debug import assert_all_finite
+            assert_all_finite(self._cached_grads, "grads")
         if self.acc_grads is None:
             self.acc_grads = self._cached_grads
         else:
@@ -639,6 +713,8 @@ class DeepSpeedEngine:
             self.global_steps += 1
             self.global_samples += self.train_batch_size()
             self._step_lr_scheduler(overflow, **(lr_kwargs or {}))
+            if self.progressive_layer_drop is not None:
+                self.progressive_layer_drop.update_state(self.global_steps)
             if self.monitor is not None and self.monitor.enabled and self.global_steps % max(
                     1, self._config.steps_per_print) == 0:
                 self._write_monitor()

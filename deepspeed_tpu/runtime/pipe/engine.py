@@ -163,7 +163,8 @@ class PipelineEngine(DeepSpeedEngine):
             mesh = groups.get_mesh()
             from jax.sharding import PartitionSpec as PS
 
-            dp_axes = ("data", "expert")
+            dp_axes = tuple(ax for ax in groups.DATA_PARALLEL_AXES
+                            if mesh.shape.get(ax, 1) > 1) or ("data", )
             param_specs = {
                 "pre": jax.tree.map(lambda l: PS(), params["pre"]),
                 "stack": jax.tree.map(lambda l: PS(PIPE_AXIS, *([None] * (l.ndim - 1))), params["stack"]),

@@ -1,0 +1,52 @@
+"""Progressive Layer Drop (PLD).
+
+Reference: ``deepspeed/runtime/progressive_layer_drop.py``
+(ProgressiveLayerDrop:9 — θ(t) = (1-θ̄)·exp(-γ·t) + θ̄ updated each global
+step) and the PLD paper's per-layer keep probability: layer i of L keeps with
+``p_i = 1 - (i / L) · (1 - θ)`` so early layers are almost never dropped.
+
+The engine instantiates this when ``progressive_layer_drop.enabled`` and
+advances it at every gradient-accumulation boundary; models opt in with the
+functional :func:`layer_drop` transform (a stochastic-depth residual skip,
+traced — θ enters as a scalar array so no recompilation per step).
+"""
+
+import numpy as np
+
+
+class ProgressiveLayerDrop:
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int):
+        def _prob(x, g, t):
+            return (1.0 - t) * np.exp(-g * x) + t
+
+        self.current_theta = float(_prob(global_step, self.gamma, self.theta))
+
+
+def keep_prob(layer_index: int, num_layers: int, theta):
+    """Per-layer keep probability: 1 - (i/L)(1-θ)."""
+    return 1.0 - (float(layer_index) / float(num_layers)) * (1.0 - theta)
+
+
+def layer_drop(fn, x, rng, p_keep, *args, **kwargs):
+    """Stochastic-depth residual skip (traced): with prob ``p_keep`` return
+    ``fn(x, ...)``, else ``x``. At eval (rng=None) the block always runs —
+    the reference's inference path likewise disables PLD."""
+    import jax
+    import jax.numpy as jnp
+
+    if rng is None:
+        return fn(x, *args, **kwargs)
+    keep = jax.random.bernoulli(rng, jnp.asarray(p_keep, jnp.float32))
+    return jax.lax.cond(keep, lambda t: fn(t, *args, **kwargs), lambda t: t, x)

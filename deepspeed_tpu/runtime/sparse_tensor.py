@@ -1,0 +1,62 @@
+"""Row-sparse tensor for sparse gradients.
+
+Reference: ``deepspeed/runtime/sparse_tensor.py`` (SparseTensor:11 — wraps the
+COO tensors sparse embedding layers emit so the engine can allreduce
+index/value pairs instead of dense gradients).
+
+TPU formulation: a pytree of (indices [N], values [N, ...row shape]) with a
+static dense shape — jit-friendly (fixed N per program), convertible both ways,
+and additive (the reference's sparse allreduce concatenates index/value pairs;
+summation happens at densification via scatter-add).
+"""
+
+from typing import Tuple
+
+import numpy as np
+
+
+class SparseTensor:
+    """Compact row-sparse representation of a 2-D tensor."""
+
+    def __init__(self, indices, values, dense_size: Tuple[int, ...]):
+        import jax.numpy as jnp
+        self.indices = jnp.asarray(indices, jnp.int32)
+        self.values = jnp.asarray(values)
+        self.dense_size = tuple(int(s) for s in dense_size)
+
+    @classmethod
+    def from_dense(cls, x, max_rows: int = 0):
+        """Rows with any nonzero become (index, row) pairs. ``max_rows`` fixes
+        the representation size for jit (0 = host-side exact count)."""
+        xn = np.asarray(x)
+        nz = np.flatnonzero(np.abs(xn).sum(axis=tuple(range(1, xn.ndim))) != 0)
+        if max_rows:
+            n = min(nz.size, max_rows)
+            idx = np.zeros(max_rows, np.int64)
+            idx[:n] = nz[:n]
+            vals = np.zeros((max_rows, ) + xn.shape[1:], xn.dtype)
+            vals[:n] = xn[nz[:n]]  # padding rows carry zeros: scatter-add no-ops
+            return cls(idx, vals, xn.shape)
+        return cls(nz, xn[nz], xn.shape)
+
+    def to_dense(self):
+        import jax.numpy as jnp
+        out = jnp.zeros(self.dense_size, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def sparse_size(self):
+        """(elements stored, dense elements) — the reference's wire-volume stat."""
+        return int(np.prod(self.values.shape)), int(np.prod(self.dense_size))
+
+    def add(self, other: "SparseTensor") -> "SparseTensor":
+        """Concatenate index/value pairs (duplicates resolved by scatter-add at
+        densification) — reference sparse_allreduce concatenation semantics."""
+        import jax.numpy as jnp
+        assert self.dense_size == other.dense_size
+        return SparseTensor(jnp.concatenate([self.indices, other.indices]),
+                            jnp.concatenate([self.values, other.values]),
+                            self.dense_size)
+
+    def __str__(self):
+        return f"SparseTensor(indices={self.indices.shape}, values={self.values.shape}, " \
+               f"dense_size={self.dense_size})"

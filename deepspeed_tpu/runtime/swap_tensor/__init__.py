@@ -1,0 +1,4 @@
+from deepspeed_tpu.runtime.swap_tensor.partitioned_optimizer_swapper import (NvmeSwappedLeaf,
+                                                                             PartitionedOptimizerSwapper)
+
+__all__ = ["PartitionedOptimizerSwapper", "NvmeSwappedLeaf"]

@@ -35,18 +35,29 @@ from deepspeed_tpu.utils.logging import logger
 class ZeroShardingPolicy:
 
     def __init__(self, stage: int, mesh=None, zero_axes=None, tp_axis=groups.MODEL_AXIS,
-                 persistence_threshold: int = 0):
+                 persistence_threshold: int = 0, param_axes=None):
+        """``param_axes`` restricts stage-3 *parameter* placement to a subset of
+        the ZeRO axes — ZeRO++ hpZ's secondary partition (reference
+        zero/config.py zero_hpz_partition_size): the forward/backward
+        all-gathers then ride only the small intra-node axis while optimizer
+        state and gradients stay sharded over the full group. Passing a
+        restricted ``zero_axes`` instead shards *everything* over the subgroup
+        and replicates across the rest — MiCS (reference runtime/zero/mics.py):
+        gradient sync across replica groups becomes the plain psum XLA inserts
+        for the replicated axes."""
         self.stage = stage
         self.mesh = mesh if mesh is not None else groups.get_mesh()
         self.zero_axes = tuple(zero_axes) if zero_axes is not None else groups.get_zero_partition_axes()
         # drop axes of size 1 so specs stay minimal
         self.zero_axes = tuple(ax for ax in self.zero_axes if self.mesh.shape.get(ax, 1) > 1)
         self.zero_size = int(np.prod([self.mesh.shape[ax] for ax in self.zero_axes])) if self.zero_axes else 1
+        self.param_axes = tuple(ax for ax in param_axes if self.mesh.shape.get(ax, 1) > 1) \
+            if param_axes is not None else None
         self.tp_axis = tp_axis
         self.persistence_threshold = persistence_threshold
 
     # ---- spec construction -----------------------------------------------------
-    def _add_zero_axes(self, shape, base_spec):
+    def _add_zero_axes(self, shape, base_spec, axes_set=None):
         """Extend ``base_spec`` (TP/EP placement) with the ZeRO axes on the first
         free dimension divisible by the ZeRO degree. Axes already used by the base
         spec are excluded — an expert-sharded parameter is ZeRO-partitioned only
@@ -61,7 +72,8 @@ class ZeroShardingPolicy:
                 continue
             for ax in (entry if isinstance(entry, tuple) else (entry, )):
                 used.add(ax)
-        axes = tuple(ax for ax in self.zero_axes if ax not in used)
+        axes_set = axes_set if axes_set is not None else self.zero_axes
+        axes = tuple(ax for ax in axes_set if ax not in used)
         size_prod = int(np.prod([self.mesh.shape[ax] for ax in axes])) if axes else 1
         if not axes or size_prod == 1:
             return P(*base)
@@ -80,7 +92,7 @@ class ZeroShardingPolicy:
         from jax.sharding import PartitionSpec as P
         base_spec = base_spec if base_spec is not None else P()
         if self.stage >= 3:
-            return self._add_zero_axes(shape, base_spec)
+            return self._add_zero_axes(shape, base_spec, self.param_axes)
         return base_spec
 
     def grad_spec(self, shape, base_spec=None):

@@ -1,0 +1,60 @@
+"""TiledLinear: a Dense layer stored and computed as a tile grid.
+
+Reference: ``deepspeed/runtime/zero/tiling.py`` (TiledLinear:29 — splits one
+large Linear into ``in_splits × out_splits`` sub-Linears so ZeRO-3 gathers one
+tile at a time instead of the whole weight; ``copy_params_from`` converts a
+dense layer's weights).
+
+TPU formulation: the kernel is one parameter of shape
+``[in_splits, out_splits, in/t, out/t]`` — the ZeRO policy shards the leading
+tile axes, so an all-gather materializes a tile, never the full matrix; the
+contraction ``bxi,xyio->byo`` is a batched MXU matmul XLA schedules
+tile-by-tile. Numerics are exactly Dense (a reshape of the same weight).
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+class TiledLinear(nn.Module):
+    features: int
+    in_splits: int = 1
+    out_splits: int = 1
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        if in_features % self.in_splits or self.features % self.out_splits:
+            raise ValueError(f"tile grid {self.in_splits}x{self.out_splits} must divide "
+                             f"({in_features}, {self.features})")
+        tin = in_features // self.in_splits
+        tout = self.features // self.out_splits
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (self.in_splits, self.out_splits, tin, tout))
+        kernel = kernel.astype(self.dtype or x.dtype)
+        lead = x.shape[:-1]
+        xr = x.reshape(lead + (self.in_splits, tin))
+        y = jnp.einsum("...xi,xyio->...yo", xr, kernel)
+        y = y.reshape(lead + (self.features, ))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.out_splits, tout))
+            y = y + bias.reshape(self.features).astype(y.dtype)
+        return y
+
+
+def dense_kernel_to_tiles(kernel, in_splits: int, out_splits: int):
+    """[in, out] → [in_splits, out_splits, in/t, out/t] (reference
+    copy_params_from, tiling.py:236)."""
+    i, o = kernel.shape
+    tin, tout = i // in_splits, o // out_splits
+    return kernel.reshape(in_splits, tin, out_splits, tout).transpose(0, 2, 1, 3)
+
+
+def tiles_to_dense_kernel(tiles):
+    """Inverse of :func:`dense_kernel_to_tiles`."""
+    ins, outs, tin, tout = tiles.shape
+    return tiles.transpose(0, 2, 1, 3).reshape(ins * tin, outs * tout)

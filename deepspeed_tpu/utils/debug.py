@@ -1,0 +1,57 @@
+"""Safe-mode / numerical-sanity helpers.
+
+Reference role: SURVEY.md §5.2 — the reference's overflow detection
+(``check_grad_overflow``), torch anomaly detection, and the multi-rank
+consistency checks scattered through its engine (tag validation, NCCL sanity).
+
+TPU surface:
+- :func:`enable_debug_nans` flips ``jax_debug_nans`` (XLA re-runs the failing
+  op un-jitted and points at it — the torch detect-anomaly analog).
+- :func:`find_nonfinite` walks a pytree and names the offending leaves.
+- :func:`assert_cross_rank_consistent` proves every process holds the same
+  host value (config hashes, tags, schedules) — the class of bug the
+  reference's tag validation catches.
+"""
+
+from typing import Any, List
+
+import numpy as np
+
+
+def enable_debug_nans(enable: bool = True):
+    import jax
+    jax.config.update("jax_debug_nans", enable)
+
+
+def find_nonfinite(tree, name: str = "tree") -> List[str]:
+    """Paths of leaves containing NaN/Inf (host sync — debug tool, not a hot
+    path)."""
+    import jax
+    bad = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(jax.device_get(leaf))
+        if not np.all(np.isfinite(arr)):
+            n_bad = int((~np.isfinite(arr)).sum())
+            bad.append(f"{name}{jax.tree_util.keystr(path)}: {n_bad}/{arr.size} non-finite")
+    return bad
+
+
+def assert_all_finite(tree, name: str = "tree"):
+    bad = find_nonfinite(tree, name)
+    if bad:
+        raise FloatingPointError("non-finite values detected:\n  " + "\n  ".join(bad))
+
+
+def assert_cross_rank_consistent(value: Any, what: str = "value"):
+    """Raise if any process disagrees on ``value`` (hashed, broadcast from
+    process 0 — covers every process regardless of mesh layout)."""
+    import zlib
+    import jax
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    h = np.int64(zlib.crc32(repr(value).encode()))
+    agreed = int(multihost_utils.broadcast_one_to_all(h))
+    if agreed != int(h):
+        raise RuntimeError(f"{what} differs across processes (local hash {int(h)}, "
+                           f"process-0 hash {agreed})")

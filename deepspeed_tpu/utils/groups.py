@@ -33,17 +33,23 @@ import numpy as np
 from deepspeed_tpu.utils.logging import logger
 
 # Canonical mesh axis names, outermost (DCN-friendly) to innermost (ICI-critical).
+# ``hpz`` is the ZeRO++ hpZ / MiCS *secondary partition* axis: a split of the
+# data-parallel dimension whose inner part stays on one node's ICI (reference
+# zero/config.py zero_hpz_partition_size, mics.py MiCS_Optimizer shard groups).
+# Size 1 (the default) makes it vanish from every PartitionSpec.
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
+HPZ_AXIS = "hpz"
 EXPERT_AXIS = "expert"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
-MESH_AXES = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+MESH_AXES = (PIPE_AXIS, DATA_AXIS, HPZ_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
 
 # Axis groups used as "process groups".
-DATA_PARALLEL_AXES = (DATA_AXIS, EXPERT_AXIS)  # dense-param DP group
-EXPERT_DATA_PARALLEL_AXES = (DATA_AXIS, )  # expert-param DP group
-SEQ_DATA_PARALLEL_AXES = (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS)  # ZeRO partition group
+DATA_PARALLEL_AXES = (DATA_AXIS, HPZ_AXIS, EXPERT_AXIS)  # dense-param DP group
+EXPERT_DATA_PARALLEL_AXES = (DATA_AXIS, HPZ_AXIS)  # expert-param DP group
+SEQ_DATA_PARALLEL_AXES = (DATA_AXIS, HPZ_AXIS, EXPERT_AXIS, SEQ_AXIS)  # ZeRO partition group
+SECONDARY_PARTITION_AXES = (HPZ_AXIS, EXPERT_AXIS, SEQ_AXIS)  # hpZ/MiCS shard group
 
 _MESH = None  # the process-global Mesh (analog of the reference's module globals)
 
@@ -58,13 +64,14 @@ class MeshTopology:
 
     pipe: int = 1
     data: int = 1
+    hpz: int = 1
     expert: int = 1
     seq: int = 1
     model: int = 1
 
     @property
     def shape(self) -> Tuple[int, ...]:
-        return (self.pipe, self.data, self.expert, self.seq, self.model)
+        return (self.pipe, self.data, self.hpz, self.expert, self.seq, self.model)
 
     def world_size(self) -> int:
         return int(np.prod(self.shape))
@@ -77,11 +84,17 @@ def initialize_mesh(
     pipe_parallel_size: int = 1,
     expert_parallel_size: int = 1,
     sequence_parallel_size: int = 1,
+    secondary_partition_size: int = 1,
     devices=None,
     force: bool = False,
 ):
     """Build (or rebuild) the global mesh. ``data_parallel_size=None`` infers it from
-    the device count, mirroring the reference where dp = world // (mp*pp)."""
+    the device count, mirroring the reference where dp = world // (mp*pp).
+
+    ``secondary_partition_size`` splits the data dimension into
+    (data // k, hpz=k) for ZeRO++ hpZ / MiCS: the inner ``hpz`` axis is the
+    intra-node shard group (devices adjacent in the mesh order → ICI
+    neighbors), the outer ``data`` axis crosses nodes."""
     global _MESH
     import jax
     from jax.sharding import Mesh
@@ -96,8 +109,13 @@ def initialize_mesh(
         raise TopologyError(f"device count {n} not divisible by mp*pp*ep*sp = {fixed}")
     if data_parallel_size is None:
         data_parallel_size = n // fixed
+    k = max(1, secondary_partition_size)
+    if data_parallel_size % k != 0:
+        raise TopologyError(f"data degree {data_parallel_size} not divisible by "
+                            f"secondary partition size {k}")
     topo = MeshTopology(pipe=pipe_parallel_size,
-                        data=data_parallel_size,
+                        data=data_parallel_size // k,
+                        hpz=k,
                         expert=expert_parallel_size,
                         seq=sequence_parallel_size,
                         model=model_parallel_size)
@@ -106,8 +124,8 @@ def initialize_mesh(
 
     dev_array = np.asarray(devices).reshape(topo.shape)
     _MESH = Mesh(dev_array, MESH_AXES)
-    logger.info(f"initialized mesh pipe={topo.pipe} data={topo.data} expert={topo.expert} "
-                f"seq={topo.seq} model={topo.model} over {n} devices")
+    logger.info(f"initialized mesh pipe={topo.pipe} data={topo.data} hpz={topo.hpz} "
+                f"expert={topo.expert} seq={topo.seq} model={topo.model} over {n} devices")
     return _MESH
 
 
@@ -207,3 +225,8 @@ def get_pipe_parallel_axis() -> str:
 
 def get_zero_partition_axes() -> Tuple[str, ...]:
     return SEQ_DATA_PARALLEL_AXES
+
+
+def get_secondary_partition_axes() -> Tuple[str, ...]:
+    """hpZ/MiCS shard-group axes (the intra-node slice of the ZeRO group)."""
+    return SECONDARY_PARTITION_AXES

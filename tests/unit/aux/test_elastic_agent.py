@@ -1,0 +1,93 @@
+"""Elastic agent: restart-on-failure with elasticity-valid world shrink
+(reference deepspeed/elasticity/elastic_agent.py DSElasticAgent)."""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.elasticity import DSElasticAgent, ElasticAgentError
+
+ELASTIC_CFG = {"elasticity": {"enabled": True, "max_train_batch_size": 2000,
+                              "micro_batch_sizes": [2, 4, 6], "min_gpus": 1,
+                              "max_gpus": 64, "version": 0.1}}
+
+
+def _worker_script(tmp_path, fail_first: bool):
+    """Rank 0 fails on the first attempt (before any flag exists), then
+    succeeds — the restart path."""
+    path = tmp_path / "worker.py"
+    path.write_text(textwrap.dedent(f"""
+        import os, sys, pathlib
+        flag = pathlib.Path({str(repr(str(tmp_path / 'attempted')))})
+        rank = os.environ["DSTPU_PROCESS_ID"]
+        world = os.environ["DSTPU_NUM_PROCESSES"]
+        log = pathlib.Path({str(repr(str(tmp_path)))}) / f"rank{{rank}}_restart{{os.environ['DSTPU_ELASTIC_RESTART']}}.txt"
+        log.write_text(world)
+        if {fail_first!r} and rank == "0" and not flag.exists():
+            flag.write_text("1")
+            sys.exit(3)
+        sys.exit(0)
+    """))
+    return str(path)
+
+
+def test_agent_clean_run(tmp_path):
+    agent = DSElasticAgent([sys.executable, _worker_script(tmp_path, fail_first=False)],
+                           num_processes=2, max_restarts=1, monitor_interval=0.05)
+    assert agent.run() == 0
+    assert agent.restart_count == 0
+    assert (tmp_path / "rank1_restart0.txt").exists()
+
+
+def test_agent_restarts_after_failure(tmp_path):
+    agent = DSElasticAgent([sys.executable, _worker_script(tmp_path, fail_first=True)],
+                           num_processes=2, max_restarts=2, monitor_interval=0.05)
+    assert agent.run() == 0
+    assert agent.restart_count == 1
+    assert (tmp_path / "rank0_restart1.txt").exists(), "second attempt must have run"
+
+
+def test_agent_gives_up_after_max_restarts(tmp_path):
+    path = tmp_path / "always_fail.py"
+    path.write_text("import sys; sys.exit(1)")
+    agent = DSElasticAgent([sys.executable, str(path)], num_processes=1,
+                           max_restarts=1, monitor_interval=0.05)
+    with pytest.raises(ElasticAgentError, match="after 1 restarts"):
+        agent.run()
+
+
+def test_agent_shrinks_to_valid_world(tmp_path):
+    """After a node loss the new world size must come from the elastic set."""
+    agent = DSElasticAgent(["true"], num_processes=8, ds_config=ELASTIC_CFG,
+                           max_restarts=1)
+    from deepspeed_tpu.elasticity import compute_elastic_config
+    _, valid = compute_elastic_config(ELASTIC_CFG)
+    w = agent.next_world_size(capacity=7)
+    assert w in valid and w <= 7
+    # larger capacity → at least as large a world
+    assert agent.next_world_size(capacity=64) >= w
+
+
+def test_agent_no_valid_world_raises():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 2000,
+                          "micro_batch_sizes": [2], "min_gpus": 40, "max_gpus": 64,
+                          "version": 0.1}}
+    agent = DSElasticAgent(["true"], num_processes=64, ds_config=cfg, max_restarts=1)
+    with pytest.raises(ElasticAgentError, match="fits the surviving capacity"):
+        agent.next_world_size(capacity=2)
+
+
+def test_agent_restart_shrinks_world_end_to_end(tmp_path):
+    """Failure + reduced capacity → relaunch with a *smaller, valid* world;
+    workers observe the shrunken DSTPU_NUM_PROCESSES."""
+    caps = iter([3])  # after the failure, only 3 slots survive
+    agent = DSElasticAgent([sys.executable, _worker_script(tmp_path, fail_first=True)],
+                           num_processes=4, ds_config=ELASTIC_CFG, max_restarts=2,
+                           monitor_interval=0.05, capacity_fn=lambda: next(caps))
+    assert agent.run() == 0
+    from deepspeed_tpu.elasticity import compute_elastic_config
+    _, valid = compute_elastic_config(ELASTIC_CFG)
+    observed = int((tmp_path / "rank0_restart1.txt").read_text())
+    assert observed <= 3 and observed in valid

@@ -1,0 +1,168 @@
+"""Block-sparse attention layouts + evoformer attention
+(reference ops/sparse_attention/, ops/deepspeed4science/evoformer_attn.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.evoformer import DS4Sci_EvoformerAttention, evoformer_attention
+from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig, BSLongformerSparsityConfig,
+                                                DenseSparsityConfig, FixedSparsityConfig,
+                                                LocalSlidingWindowSparsityConfig,
+                                                SparseSelfAttention, VariableSparsityConfig,
+                                                layout_to_dense_mask, sparse_self_attention)
+
+
+# ---------------------------------------------------------------------- layouts --
+def test_fixed_layout_unidirectional():
+    cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=4,
+                              num_global_blocks=1, attention="unidirectional")
+    lay = cfg.make_layout(16 * 8)
+    assert lay.shape == (2, 8, 8)
+    assert np.array_equal(lay[0], lay[1])  # propagated single layout
+    assert np.all(np.triu(lay[0], 1) == 0), "unidirectional must stay lower-triangular"
+    # local window: block row 2 sees rows 0-2 of its window
+    assert lay[0, 2, 0] and lay[0, 2, 2]
+    # global: window representative (block 3) attended by later rows
+    assert lay[0, 7, 3] == 1
+    # outside window + not global → 0
+    assert lay[0, 2, 1] == 1 and lay[0, 1, 0] == 1
+
+
+def test_fixed_layout_bidirectional_horizontal_global():
+    cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=4,
+                              num_global_blocks=1, attention="bidirectional",
+                              horizontal_global_attention=True)
+    lay = cfg.make_layout(16 * 8)[0]
+    assert lay[0, 3] == 1, "vertical global visible from every row"
+    assert np.all(lay[3, :] == 1), "horizontal global row fully attends"
+
+
+def test_fixed_layout_different_patterns_per_head():
+    cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=4,
+                              num_global_blocks=1, attention="bidirectional",
+                              different_layout_per_head=True,
+                              num_different_global_patterns=4)
+    lay = cfg.make_layout(16 * 8)
+    # each head uses a different window representative → layouts differ
+    assert not np.array_equal(lay[0], lay[1])
+
+
+def test_bigbird_layout():
+    cfg = BigBirdSparsityConfig(num_heads=2, block=16, num_random_blocks=2,
+                                num_sliding_window_blocks=3, num_global_blocks=1)
+    lay = cfg.make_layout(16 * 8)
+    assert np.all(lay[0, 0, :] == 1) and np.all(lay[0, :, 0] == 1)  # global ITC
+    for r in range(1, 7):  # sliding window
+        assert lay[0, r, r - 1] and lay[0, r, r] and lay[0, r, r + 1]
+    # randomness beyond window+global exists with 2 random blocks over 8
+    assert lay.sum() >= 2 * (8 + 8 + 3 * 8 - 4)
+
+
+def test_bigbird_unidirectional_is_causal():
+    cfg = BigBirdSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                num_sliding_window_blocks=3, num_global_blocks=1,
+                                attention="unidirectional")
+    lay = cfg.make_layout(16 * 8)[0]
+    assert np.all(np.triu(lay, 1) == 0)
+
+
+def test_bslongformer_layout():
+    cfg = BSLongformerSparsityConfig(num_heads=1, block=16, num_sliding_window_blocks=3,
+                                     global_block_indices=[0, 5])
+    lay = cfg.make_layout(16 * 8)[0]
+    assert np.all(lay[5, :] == 1) and np.all(lay[:, 5] == 1)
+    assert lay[3, 1] == 0  # outside window, not global
+
+
+def test_variable_and_local_window_layouts():
+    lay = VariableSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                 local_window_blocks=[2, 4],
+                                 global_block_indices=[0]).make_layout(16 * 8)[0]
+    assert lay[1, 0] and lay[1, 1]  # first window of 2
+    assert np.all(lay[:, 0] == 1)   # global column
+
+    lay = LocalSlidingWindowSparsityConfig(num_heads=1, block=16,
+                                           num_sliding_window_blocks=3).make_layout(16 * 8)[0]
+    assert np.all(np.triu(lay, 1) == 0)
+    assert lay[4, 3] and lay[4, 4] and not lay[4, 1]
+
+
+# -------------------------------------------------------------- sparse attention --
+def test_dense_layout_matches_full_attention():
+    rng = np.random.default_rng(0)
+    B, H, S, D = 2, 2, 64, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32) for _ in range(3))
+    lay = DenseSparsityConfig(num_heads=H, block=16).make_layout(S)
+    out = sparse_self_attention(q, k, v, lay, block=16)
+    scale = 1.0 / np.sqrt(D)
+    ref = jax.nn.softmax(jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale) @ v
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_attention_honors_layout():
+    """Tokens in unattended blocks must not influence the output."""
+    rng = np.random.default_rng(1)
+    B, H, S, D = 1, 1, 64, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32) for _ in range(3))
+    cfg = LocalSlidingWindowSparsityConfig(num_heads=H, block=16, num_sliding_window_blocks=1)
+    lay = cfg.make_layout(S)
+    out1 = sparse_self_attention(q, k, v, lay, block=16)
+    # perturb keys/values in a block row 0 never attends (block 3)
+    k2 = k.at[:, :, 48:, :].set(99.0)
+    v2 = v.at[:, :, 48:, :].set(99.0)
+    out2 = sparse_self_attention(q, k2, v2, lay, block=16)
+    np.testing.assert_array_equal(np.asarray(out1[:, :, :16]), np.asarray(out2[:, :, :16]))
+
+
+def test_sparse_self_attention_module_and_padding():
+    rng = np.random.default_rng(2)
+    B, H, S, D = 2, 2, 64, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32) for _ in range(3))
+    attn = SparseSelfAttention(FixedSparsityConfig(num_heads=H, block=16, num_local_blocks=2))
+    out = attn(q, k, v)
+    assert out.shape == (B, H, S, D)
+    # padding mask drops keys
+    kpm = np.ones((B, S), bool)
+    kpm[:, 32:] = False
+    out_pad = attn(q, k, v, key_padding_mask=jnp.asarray(kpm))
+    assert np.all(np.isfinite(np.asarray(out_pad)))
+    assert not np.allclose(np.asarray(out), np.asarray(out_pad))
+
+
+# -------------------------------------------------------------------- evoformer --
+def test_evoformer_matches_naive():
+    rng = np.random.default_rng(3)
+    B, N, S, H, D = 2, 3, 16, 4, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(B, N, S, H, D)), jnp.float32) for _ in range(3))
+    bias1 = jnp.asarray(rng.normal(size=(B, N, 1, 1, S)), jnp.float32)
+    bias2 = jnp.asarray(rng.normal(size=(B, 1, H, S, S)), jnp.float32)
+    out = DS4Sci_EvoformerAttention(q, k, v, [bias1, bias2])
+    assert out.shape == (B, N, S, H, D)
+
+    # naive: head-first layout
+    qh = np.swapaxes(np.asarray(q), -2, -3) / np.sqrt(D)
+    kh = np.swapaxes(np.asarray(k), -2, -3)
+    vh = np.swapaxes(np.asarray(v), -2, -3)
+    scores = qh @ np.swapaxes(kh, -1, -2) + np.asarray(bias1) + np.asarray(bias2)
+    probs = np.exp(scores - scores.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    ref = np.swapaxes(probs @ vh, -2, -3)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_evoformer_gradients_flow():
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.normal(size=(1, 2, 8, 2, 4)), jnp.float32)
+    k, v = q + 0.1, q + 0.2
+    bias2 = jnp.zeros((1, 1, 2, 8, 8), jnp.float32)
+    g = jax.grad(lambda b: jnp.sum(evoformer_attention(q, k, v, bias2=b)))(bias2)
+    assert float(jnp.max(jnp.abs(g))) > 0
+
+
+def test_evoformer_rejects_three_biases():
+    q = jnp.zeros((1, 1, 4, 1, 4))
+    with pytest.raises(ValueError):
+        DS4Sci_EvoformerAttention(q, q, q, [q, q, q])

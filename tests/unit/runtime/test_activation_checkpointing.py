@@ -1,0 +1,105 @@
+"""Standalone activation-checkpointing API + safe-mode sanity checks
+(reference runtime/activation_checkpointing/checkpointing.py, SURVEY.md §5.2).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+from deepspeed_tpu.utils import groups
+
+from ..simple_model import make_simple_model, random_batches
+
+
+@pytest.fixture(autouse=True)
+def _reset_ckpt_config():
+    checkpointing.reset()
+    yield
+    checkpointing.reset()
+
+
+def _fn(w, x):
+    h = jnp.tanh(x @ w)
+    return jnp.sum(jnp.tanh(h @ w))
+
+
+def test_checkpoint_preserves_value_and_grad():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(8, 8)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
+
+    checkpointing.configure(deepspeed_config={"train_micro_batch_size_per_gpu": 1})
+    assert checkpointing.is_configured()
+
+    direct_v, direct_g = jax.value_and_grad(_fn)(w, x)
+    ck_v, ck_g = jax.value_and_grad(lambda w, x: checkpointing.checkpoint(_fn, w, x))(w, x)
+    np.testing.assert_allclose(np.asarray(ck_v), np.asarray(direct_v), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ck_g), np.asarray(direct_g), rtol=1e-6)
+
+
+def test_checkpoint_reduces_saved_residuals():
+    """nothing_saveable must leave no tanh residuals in the jaxpr — remat for
+    real, not a passthrough."""
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.normal(size=(16, 16)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)
+    checkpointing.configure(deepspeed_config={"train_micro_batch_size_per_gpu": 1})
+
+    plain = str(jax.make_jaxpr(jax.grad(_fn))(w, x))
+    remat = str(jax.make_jaxpr(jax.grad(lambda w, x: checkpointing.checkpoint(_fn, w, x)))(w, x))
+    assert "remat" not in plain
+    assert "remat" in remat, "checkpointed backward must carry the remat primitive"
+
+
+def test_checkpoint_partition_activations_policy():
+    checkpointing.configure(deepspeed_config={
+        "train_micro_batch_size_per_gpu": 1,
+        "activation_checkpointing": {"partition_activations": True}})
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.normal(size=(8, 8)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
+    v, g = jax.value_and_grad(lambda w, x: checkpointing.checkpoint(_fn, w, x))(w, x)
+    dv, dg = jax.value_and_grad(_fn)(w, x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(dg), rtol=1e-6)
+
+
+def test_configure_flag_overrides():
+    checkpointing.configure(deepspeed_config={"train_micro_batch_size_per_gpu": 1},
+                            partition_activations=True, checkpoint_in_cpu=True,
+                            num_checkpoints=2)
+    from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import _CONFIG
+    assert _CONFIG.partition_activations and _CONFIG.cpu_checkpointing
+    assert _CONFIG.number_checkpoints == 2
+
+
+# ------------------------------------------------------------------- safe mode --
+def test_find_nonfinite_names_leaves():
+    from deepspeed_tpu.utils.debug import assert_all_finite, find_nonfinite
+
+    tree = {"a": jnp.ones((3, )), "b": {"c": jnp.asarray([1.0, np.nan, np.inf])}}
+    bad = find_nonfinite(tree, "grads")
+    assert len(bad) == 1 and "'b'" in bad[0] and "2/3" in bad[0]
+    with pytest.raises(FloatingPointError):
+        assert_all_finite(tree)
+    assert_all_finite({"a": jnp.ones((3, ))})  # clean tree passes
+
+
+def test_engine_check_finite_grads_raises():
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=16, batch_size=16)
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "optimizer": {"type": "AdamW", "params": {"lr": 0.01}},
+           "sanity_checks": {"check_finite_grads": True}}
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0, config=cfg)
+    b = random_batches(1, 16, 16)[0]
+    loss = eng.forward(b)
+    eng.backward(loss)  # clean grads pass
+    eng.step()
+    bad = jax.tree.map(lambda l: np.where(np.isfinite(l), np.inf, l).astype(l.dtype), b)
+    loss = eng.forward(bad)
+    with pytest.raises(FloatingPointError):
+        eng.backward(loss)

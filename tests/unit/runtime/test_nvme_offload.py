@@ -1,0 +1,128 @@
+"""ZeRO-Infinity NVMe tier: optimizer states at rest on disk.
+
+Reference semantics: ``deepspeed/runtime/swap_tensor/partitioned_optimizer_swapper.py:29``
++ ``zero/stage3.py:1816``: between steps the accelerator (and host) holds no
+optimizer state — only files under ``nvme_path``; the step swaps in, updates,
+swaps out. Numerics are identical to the in-HBM run.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.swap_tensor import NvmeSwappedLeaf
+from deepspeed_tpu.utils import groups
+
+from ..simple_model import make_simple_model, random_batches
+
+HIDDEN = 16
+
+
+def _cfg(stage, nvme_path=None, gas=1):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "AdamW", "params": {"lr": 0.01, "weight_decay": 0.0}},
+        "zero_optimization": {"stage": stage},
+    }
+    if nvme_path is not None:
+        cfg["zero_optimization"]["offload_optimizer"] = {
+            "device": "nvme", "nvme_path": str(nvme_path), "buffer_count": 2}
+        cfg["aio"] = {"thread_count": 2, "queue_depth": 4}
+    return cfg
+
+
+def _stub_leaves(opt_state):
+    import jax
+    return [l for l in jax.tree.leaves(opt_state) if isinstance(l, NvmeSwappedLeaf)]
+
+
+def _train(engine, batches, fused=False):
+    if fused:
+        for b in batches:
+            engine.train_batch(batch=b)
+    else:
+        for b in batches:
+            loss = engine.forward(b)
+            engine.backward(loss)
+            engine.step()
+
+
+@pytest.mark.parametrize("stage", [1, 3])
+@pytest.mark.parametrize("fused", [False, True])
+def test_nvme_parity_and_residency(tmp_path, stage, fused):
+    """device=nvme trains to the exact same params as the in-HBM run, and
+    between steps every moment leaf is a file stub — no array anywhere."""
+    import jax
+
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+    batches = random_batches(4, 16, HIDDEN)
+
+    ref, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                            config=_cfg(stage))
+    _train(ref, batches, fused)
+
+    groups.initialize_mesh(force=True)
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                            config=_cfg(stage, nvme_path=tmp_path / "swap"))
+    # at rest (post-init): moments are stubs backed by real files
+    stubs = _stub_leaves(eng.opt_state)
+    assert stubs, "optimizer state should be swapped out after init"
+    eng._offload.swapper._drain_writes()  # write-back is async by design
+    for s in stubs:
+        assert os.path.exists(s.path)
+    _train(eng, batches, fused)
+    assert _stub_leaves(eng.opt_state), "state must return to NVMe after each step"
+
+    for g, w in zip(jax.tree.leaves(jax.device_get(eng.params)),
+                    jax.tree.leaves(jax.device_get(ref.params))):
+        np.testing.assert_allclose(g, w, rtol=1e-6, atol=1e-6)
+
+
+def test_nvme_checkpoint_roundtrip(tmp_path):
+    """save_checkpoint materializes states from disk; load_checkpoint swaps the
+    restored tree straight back out to NVMe, and training continues bit-exact."""
+    import jax
+
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+    batches = random_batches(6, 16, HIDDEN)
+
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                            config=_cfg(2, nvme_path=tmp_path / "swapA"))
+    _train(eng, batches[:3])
+    eng.save_checkpoint(tmp_path / "ckpt", tag="t3")
+    _train(eng, batches[3:])
+    final_direct = jax.device_get(eng.params)
+
+    groups.initialize_mesh(force=True)
+    eng2, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                             config=_cfg(2, nvme_path=tmp_path / "swapB"))
+    eng2.load_checkpoint(tmp_path / "ckpt", tag="t3")
+    assert _stub_leaves(eng2.opt_state), "restored state must live on NVMe"
+    _train(eng2, batches[3:])
+    for a, b in zip(jax.tree.leaves(jax.device_get(eng2.params)),
+                    jax.tree.leaves(final_direct)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+
+def test_swapper_unit(tmp_path):
+    """Swapper alone: tree out → stubs, tree in → identical arrays."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.runtime.swap_tensor import PartitionedOptimizerSwapper
+
+    tree = {"m": jnp.arange(64, dtype=jnp.float32),
+            "v": {"a": jnp.ones((8, 8), jnp.bfloat16), "b": jnp.zeros((3, ), jnp.int32)}}
+    sw = PartitionedOptimizerSwapper(str(tmp_path), buffer_count=1)
+    stubs = sw.swap_out(tree)
+    assert all(isinstance(l, NvmeSwappedLeaf) for l in jax.tree.leaves(stubs))
+    back = sw.swap_in(stubs, None)
+    for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(tree)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    host = sw.materialize_host(stubs)
+    assert isinstance(jax.tree.leaves(host)[0], np.ndarray)
+    sw.close()

@@ -1,0 +1,95 @@
+"""qgZ gradient-path wiring: `zero_quantized_gradients` must put int8 on the
+wire (reference ZeRO++, coalesced_collectives.py:73 all_to_all_quant_reduce).
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.utils import groups
+
+from ..simple_model import make_simple_model, random_batches
+
+HIDDEN = 16
+
+
+def _cfg(qgz, stage=2, gas=1):
+    return {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "AdamW", "params": {"lr": 0.01, "weight_decay": 0.0}},
+        "zero_optimization": {"stage": stage, "zero_quantized_gradients": bool(qgz)},
+    }
+
+
+def _train(engine, batches, fused=False):
+    if fused:
+        for b in batches:
+            engine.train_batch(batch=b)
+    else:
+        for b in batches:
+            loss = engine.forward(b)
+            engine.backward(loss)
+            engine.step()
+
+
+def test_qgz_hlo_has_int8_all_to_all():
+    """The compiled gradient program must contain an s8 all-to-all — wire
+    compression for real, not a numerics-only decoration."""
+    import jax
+
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                            config=_cfg(qgz=True))
+    assert eng._qgz
+    b = random_batches(1, 16, HIDDEN)[0]
+    batch = eng.shard_batch(b)
+    import jax.numpy as jnp
+    hlo = eng._grad_fn().lower(eng.params, batch, jax.random.PRNGKey(0),
+                               jnp.float32(1.0)).compile().as_text()
+    assert "all-to-all" in hlo
+    assert "s8[" in hlo, "quantized payload must be int8 on the wire"
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_qgz_trains_close_to_exact(fused):
+    """4x-compressed gradients track the exact run closely on a smooth
+    problem — and are NOT bit-identical (the quantizer really ran)."""
+    import jax
+
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+    batches = random_batches(4, 16, HIDDEN)
+
+    exact, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                              config=_cfg(qgz=False))
+    _train(exact, batches, fused)
+
+    groups.initialize_mesh(force=True)
+    q, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                          config=_cfg(qgz=True))
+    _train(q, batches, fused)
+
+    exact_leaves = jax.tree.leaves(jax.device_get(exact.params))
+    q_leaves = jax.tree.leaves(jax.device_get(q.params))
+    # Adam normalizes by second moments, so a tiny gradient-quantization delta
+    # can flip a near-zero-gradient element's update direction — worst case one
+    # full lr-sized step per update in each run (4 steps × lr 0.01 × 2). The
+    # mean drift must stay far below that.
+    for a, b in zip(q_leaves, exact_leaves):
+        np.testing.assert_allclose(a, b, atol=0.08)
+    flat_err = np.concatenate([np.abs(a - b).ravel() for a, b in zip(q_leaves, exact_leaves)])
+    assert flat_err.mean() < 0.01, flat_err.mean()
+    assert any(not np.array_equal(a, b) for a, b in zip(q_leaves, exact_leaves)), \
+        "bit-identical params mean the quantizer never ran"
+
+
+def test_qgz_falls_back_on_unsupported_mesh():
+    """ZeRO-3 (sharded params) keeps the exact psum path, with a warning."""
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                            config=_cfg(qgz=True, stage=3))
+    assert not eng._qgz
+    _train(eng, random_batches(1, 16, HIDDEN))

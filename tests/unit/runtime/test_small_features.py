@@ -1,0 +1,189 @@
+"""Eigenvalue, progressive layer drop, random-LTD, SparseTensor, TiledLinear
+(reference: runtime/eigenvalue.py, runtime/progressive_layer_drop.py,
+data_pipeline/data_routing/, runtime/sparse_tensor.py, runtime/zero/tiling.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.utils import groups
+
+from ..simple_model import make_simple_model, random_batches
+
+
+# ------------------------------------------------------------------ eigenvalue --
+def test_eigenvalue_quadratic():
+    """For loss = 0.5 xᵀ A x the Hessian is A: power iteration must find the
+    dominant eigenvalue per block (then scale the max to 1.0)."""
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+    a_eigs = np.array([4.0, 1.0, 0.25])
+    b_eigs = np.array([8.0, 2.0])
+    A = jnp.asarray(np.diag(a_eigs), jnp.float32)
+    B = jnp.asarray(np.diag(b_eigs), jnp.float32)
+    params = {"a": jnp.ones((3, ), jnp.float32), "b": jnp.ones((2, ), jnp.float32)}
+
+    def loss_fn(p, batch):
+        return 0.5 * p["a"] @ A @ p["a"] + 0.5 * p["b"] @ B @ p["b"]
+
+    ev = Eigenvalue(max_iter=200, tol=1e-6)
+    out = ev.compute_eigenvalue(loss_fn, params, batch=None)
+    # raw eigs 4 and 8 → normalized to max 1.0
+    np.testing.assert_allclose(out["b"], 1.0, rtol=1e-3)
+    np.testing.assert_allclose(out["a"], 0.5, rtol=1e-3)
+
+
+def test_eigenvalue_engine_wiring():
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=16, batch_size=16)
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "optimizer": {"type": "AdamW", "params": {"lr": 0.01}},
+           "eigenvalue": {"enabled": True, "max_iter": 10, "tol": 1e-2}}
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0, config=cfg)
+    assert eng.eigenvalue is not None and eng.eigenvalue.max_iter == 10
+
+
+# --------------------------------------------------------------------------- PLD --
+def test_pld_theta_schedule():
+    """θ(t) = (1-θ̄)exp(-γt) + θ̄: starts at 1, decays monotonically to θ̄."""
+    from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop, keep_prob
+
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0
+    thetas = []
+    for t in range(0, 1000, 100):
+        pld.update_state(t)
+        thetas.append(pld.get_theta())
+    assert all(a >= b for a, b in zip(thetas, thetas[1:]))
+    assert abs(thetas[-1] - 0.5) < 0.01
+    # early layers keep more often than late ones
+    assert keep_prob(0, 12, 0.5) == 1.0
+    assert keep_prob(11, 12, 0.5) < keep_prob(6, 12, 0.5) < 1.0
+
+
+def test_pld_layer_drop_transform():
+    from deepspeed_tpu.runtime.progressive_layer_drop import layer_drop
+
+    x = jnp.ones((4, 8))
+    fn = lambda t: t * 2.0
+    # eval mode: always runs
+    np.testing.assert_array_equal(layer_drop(fn, x, None, 0.0), x * 2)
+    # p_keep=1: runs; p_keep=0: identity
+    rng = jax.random.PRNGKey(0)
+    np.testing.assert_array_equal(layer_drop(fn, x, rng, 1.0), x * 2)
+    np.testing.assert_array_equal(layer_drop(fn, x, rng, 0.0), x)
+    # gradient flows through both branches
+    g = jax.grad(lambda t: jnp.sum(layer_drop(fn, t, rng, 1.0)))(x)
+    assert np.all(np.asarray(g) == 2.0)
+
+
+def test_pld_engine_updates_theta():
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=16, batch_size=16)
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "optimizer": {"type": "AdamW", "params": {"lr": 0.01}},
+           "progressive_layer_drop": {"enabled": True, "theta": 0.5, "gamma": 0.1}}
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0, config=cfg)
+    assert eng.progressive_layer_drop is not None
+    for b in random_batches(3, 16, 16):
+        loss = eng.forward(b)
+        eng.backward(loss)
+        eng.step()
+    assert eng.progressive_layer_drop.get_theta() < 1.0
+
+
+# -------------------------------------------------------------------- random-LTD --
+def test_random_ltd_schedule():
+    from deepspeed_tpu.runtime.data_pipeline.data_routing import RandomLTDScheduler
+
+    s = RandomLTDScheduler(min_value=128, max_value=1024, require_steps=100,
+                           increase_step=16, total_layer_num=12,
+                           random_ltd_layer_num=10, global_batch_size=4)
+    assert s.get_value(0) == 128
+    assert s.get_value(100) == 1024
+    assert s.get_value(200) == 1024  # clipped
+    mid = s.get_value(50)
+    assert 128 < mid < 1024 and mid % 16 == 0
+    assert s.get_total_layer_tokens(10) > 0
+
+
+def test_random_ltd_gather_scatter_roundtrip():
+    from deepspeed_tpu.runtime.data_pipeline.data_routing import (gather_tokens, random_token_indices,
+                                                                  scatter_tokens)
+
+    rng = jax.random.PRNGKey(1)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 16, 8)), jnp.float32)
+    idx = random_token_indices(rng, 16, 6)
+    assert idx.shape == (6, ) and bool(jnp.all(idx[1:] > idx[:-1]))  # sorted, unique
+    part = gather_tokens(x, idx)
+    assert part.shape == (2, 6, 8)
+    # scatter processed tokens back; untouched positions keep their values
+    out = scatter_tokens(x, part * 2.0, idx)
+    np.testing.assert_allclose(np.asarray(out[:, idx]), np.asarray(x[:, idx]) * 2, rtol=1e-6)
+    mask = np.ones(16, bool)
+    mask[np.asarray(idx)] = False
+    np.testing.assert_array_equal(np.asarray(out[:, mask]), np.asarray(x[:, mask]))
+    # gradients flow only through kept tokens for the processed branch
+    g = jax.grad(lambda h: jnp.sum(gather_tokens(h, idx)))(x)
+    assert float(jnp.sum(g[:, mask])) == 0.0
+
+
+# ------------------------------------------------------------------ SparseTensor --
+def test_sparse_tensor_roundtrip_and_add():
+    from deepspeed_tpu.runtime.sparse_tensor import SparseTensor
+
+    x = np.zeros((10, 4), np.float32)
+    x[2] = 1.0
+    x[7] = 3.0
+    st = SparseTensor.from_dense(x)
+    assert st.sparse_size() == (8, 40)
+    np.testing.assert_array_equal(np.asarray(st.to_dense()), x)
+
+    y = np.zeros((10, 4), np.float32)
+    y[7] = 1.0
+    y[9] = 2.0
+    both = st.add(SparseTensor.from_dense(y))
+    np.testing.assert_array_equal(np.asarray(both.to_dense()), x + y)  # dup row 7 sums
+
+    padded = SparseTensor.from_dense(x, max_rows=5)
+    np.testing.assert_array_equal(np.asarray(padded.to_dense()), x)
+
+
+# ------------------------------------------------------------------- TiledLinear --
+def test_tiled_linear_matches_dense():
+    from deepspeed_tpu.runtime.zero.tiling import (TiledLinear, dense_kernel_to_tiles,
+                                                   tiles_to_dense_kernel)
+    import flax.linen as nn
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)
+    dense = nn.Dense(24)
+    dp = dense.init(jax.random.PRNGKey(0), x)["params"]
+    tiled = TiledLinear(features=24, in_splits=4, out_splits=3)
+    tiles = dense_kernel_to_tiles(dp["kernel"], 4, 3)
+    tp = {"kernel": tiles, "bias": dp["bias"].reshape(3, 8)}
+    np.testing.assert_allclose(np.asarray(tiled.apply({"params": tp}, x)),
+                               np.asarray(dense.apply({"params": dp}, x)),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(tiles_to_dense_kernel(tiles)),
+                                  np.asarray(dp["kernel"]))
+
+
+def test_tiled_linear_zero3_shards_tiles():
+    """Under ZeRO-3 the tile axes shard: an allgather materializes one tile row,
+    never the whole [in, out] matrix (the reference's memory claim)."""
+    from deepspeed_tpu.runtime.zero.policy import ZeroShardingPolicy
+    from deepspeed_tpu.runtime.zero.tiling import TiledLinear
+
+    mesh = groups.initialize_mesh(force=True)  # data=8
+    x = jnp.ones((2, 32), jnp.float32)
+    m = TiledLinear(features=32, in_splits=8, out_splits=4, use_bias=False)
+    params = m.init(jax.random.PRNGKey(0), x)["params"]
+    policy = ZeroShardingPolicy(stage=3, mesh=mesh)
+    sh = policy.param_shardings(params)
+    spec = sh["kernel"].spec
+    assert spec[0] is not None, f"tile axis must carry the ZeRO sharding, got {spec}"
