@@ -63,9 +63,9 @@ class InferenceEngine:
 
     __call__ = forward
 
-    def generate(self, input_ids=None, max_new_tokens: int = 32, do_sample: bool = False,
-                 temperature: float = 1.0, eos_token_id: Optional[int] = None,
-                 rng=None, **kwargs):
+    def generate(self, *inputs, input_ids=None, max_new_tokens: Optional[int] = None,
+                 do_sample: Optional[bool] = None, temperature: Optional[float] = None,
+                 eos_token_id: Optional[int] = None, rng=None, **kwargs):
         """Reference engine.py:613 (``_generate`` → module.generate or the
         sampling loop). A module-provided ``generate`` wins; otherwise this is
         the v1 autoregressive loop for causal-LM modules whose forward returns
@@ -78,22 +78,31 @@ class InferenceEngine:
         this matches reference v1's no-cache fallback semantics).
         """
         if hasattr(self.module, "generate"):
-            # delegate EVERYTHING the caller passed; filter our named params by
-            # the module's signature so modules with narrower generate APIs
-            # keep working (and none of the knobs get silently dropped)
+            # verbatim pass-through of positionals; only knobs the caller
+            # EXPLICITLY set are forwarded (None = unset, so the module's own
+            # defaults win), filtered by the module's signature
             import inspect
             mg = self.module.generate
-            named = dict(max_new_tokens=max_new_tokens, do_sample=do_sample,
-                         temperature=temperature, eos_token_id=eos_token_id, rng=rng)
+            named = {k: v for k, v in dict(max_new_tokens=max_new_tokens,
+                                           do_sample=do_sample, temperature=temperature,
+                                           eos_token_id=eos_token_id, rng=rng).items()
+                     if v is not None}
             try:
                 sig = inspect.signature(mg)
                 if not any(p.kind == p.VAR_KEYWORD for p in sig.parameters.values()):
                     named = {k: v for k, v in named.items() if k in sig.parameters}
             except (TypeError, ValueError):
                 pass
-            return mg(input_ids, **named, **kwargs)
+            pos = inputs if input_ids is None else (input_ids, ) + inputs
+            return mg(*pos, **named, **kwargs)
         if input_ids is None:
-            raise ValueError("generate() needs input_ids")
+            if len(inputs) != 1:
+                raise ValueError("the built-in sampling loop takes exactly one "
+                                 "input_ids array")
+            input_ids = inputs[0]
+        max_new_tokens = 32 if max_new_tokens is None else int(max_new_tokens)
+        do_sample = bool(do_sample)
+        temperature = 1.0 if temperature is None else float(temperature)
 
         import jax
         import jax.numpy as jnp

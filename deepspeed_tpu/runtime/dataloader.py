@@ -4,9 +4,35 @@ Reference: ``deepspeed/runtime/dataloader.py`` (DeepSpeedDataLoader, RepeatingLo
 Under single-controller SPMD the loader yields *global* batches of host numpy arrays;
 ``engine.shard_batch`` places them over the data/seq mesh axes (the role the
 per-rank DistributedSampler plays in the reference).
+
+``PrefetchingLoader`` adds the reference's pinned-memory prefetch worker: a
+background thread runs collation + curriculum + the H2D ``device_put``
+(``engine.stage_train_batch``) ``depth`` batches ahead, so the host staging
+never sits on the device critical path.
 """
 
+import queue
+import threading
 import numpy as np
+
+
+class StagedBatch:
+    """A device-resident, micro-stacked batch ready for ``train_batch``."""
+
+    __slots__ = ("tree", )
+
+    def __init__(self, tree):
+        self.tree = tree
+
+
+class FusedHostBatch:
+    """A full global batch still on host — prefetched but intentionally
+    unstaged (curriculum truncation must happen at consume time)."""
+
+    __slots__ = ("tree", )
+
+    def __init__(self, tree):
+        self.tree = tree
 
 
 class DeepSpeedDataLoader:
@@ -57,6 +83,114 @@ class RepeatingLoader:
             self.data_iter = iter(self.loader)
             batch = next(self.data_iter)
         return batch
+
+
+class _PrefetchEpoch:
+    """One epoch's iterator: owns ITS queue, stop event, and worker thread — a
+    straggler surviving close() can never feed a later epoch's queue, and
+    ``for`` re-calling ``iter()`` on this object is a no-op (no restart)."""
+
+    def __init__(self, loader, engine, depth):
+        self._q = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        # curriculum difficulty is a function of the step the batch will be
+        # CONSUMED at; staging it `depth` steps early would truncate to a stale
+        # seqlen, so with curriculum on we prefetch host batches only and let
+        # train_batch stage at consume time
+        stage = engine.curriculum_scheduler is None
+        self._thread = threading.Thread(
+            target=self._worker, args=(loader, engine, stage, self._q, self._stop),
+            daemon=True)
+        self._thread.start()
+
+    @staticmethod
+    def _worker(loader, engine, stage, q, stop):
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        try:
+            for batch in loader:
+                if stop.is_set():
+                    return
+                item = engine.stage_train_batch(batch=batch) if stage \
+                    else FusedHostBatch(batch)
+                if not put(item):
+                    return
+            put(_END)
+        except BaseException as e:  # surface loader errors at the consumer
+            put(_Err(e))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._q is None:
+            raise StopIteration
+        item = self._q.get()
+        if item is _END:
+            self._thread.join()
+            self._q = None
+            raise StopIteration
+        if isinstance(item, _Err):
+            self._q = None
+            raise item.exc
+        return item
+
+    def close(self):
+        """Stop the worker and drop in-flight batches (safe mid-epoch)."""
+        if self._thread is not None and self._thread.is_alive():
+            self._stop.set()
+            try:  # drop whatever was queued
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=5)
+        self._q = None
+
+
+class PrefetchingLoader:
+    """Iterate ``loader`` with staging moved to a background thread.
+
+    ``loader`` must yield *global fused batches* (shape [gas*micro_global, ...]
+    per leaf — what ``engine.train_batch(batch=...)`` accepts). Each ``iter()``
+    starts a fresh epoch yielding :class:`StagedBatch` objects (or
+    :class:`FusedHostBatch` under curriculum) that ``train_batch`` consumes.
+    ``depth`` bounds in-flight batches (double-buffering at 2).
+    """
+
+    def __init__(self, loader, engine, depth: int = 2):
+        self.loader = loader
+        self.engine = engine
+        self.depth = max(1, depth)
+        self._epoch = None
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __iter__(self):
+        self.close()
+        self._epoch = _PrefetchEpoch(self.loader, self.engine, self.depth)
+        return self._epoch
+
+    def close(self):
+        if self._epoch is not None:
+            self._epoch.close()
+            self._epoch = None
+
+
+_END = object()
+
+
+class _Err:
+    def __init__(self, exc):
+        self.exc = exc
 
 
 def _default_collate(samples):

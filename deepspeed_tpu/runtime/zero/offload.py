@@ -214,6 +214,11 @@ class NvmeOffloadPlan(OptimizerOffloadPlan):
         return self.swapper.swap_out(opt_state)
 
     def checkpoint_view(self, opt_state):
+        import jax
+        if jax.process_count() > 1:
+            # multi-host: hand orbax sharded jax.Arrays (each process
+            # contributes its shards); host materialization is single-process
+            return self.swapper.swap_in(opt_state, self.compute_shardings)
         return self.swapper.materialize_host(opt_state)
 
     def restore_template(self, opt_state):
