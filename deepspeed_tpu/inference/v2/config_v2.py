@@ -31,10 +31,23 @@ class DeepSpeedEPConfig(DeepSpeedConfigModel):
     variable-size a2a needs no capacity but pays a host-side size exchange."""
 
 
+class QuantizationConfig(DeepSpeedConfigModel):
+    """ZeRO-Inference weight quantization (reference README.md:17 news item +
+    deepspeed/inference/quantization): int8 at-rest weights, dequantized
+    inside the jitted forward so the convert fuses into each consumer."""
+
+    enabled: bool = False
+    bits: int = 8
+    min_size: int = 4096
+    """Leaves smaller than this (norms, biases) stay full precision."""
+
+
 class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     """Top-level FastGen engine config."""
 
     tensor_parallel: DeepSpeedTPConfig = Field(default_factory=DeepSpeedTPConfig, alias="tp")
+    quantization: QuantizationConfig = Field(default_factory=QuantizationConfig,
+                                             alias="weight_quantization")
     expert_parallel: DeepSpeedEPConfig = Field(default_factory=DeepSpeedEPConfig, alias="ep")
     state_manager: DSStateManagerConfig = Field(default_factory=DSStateManagerConfig, alias="manager")
 

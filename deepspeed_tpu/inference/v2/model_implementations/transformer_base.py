@@ -30,6 +30,17 @@ class DSTransformerModelBase:
     ``unembed(params, x)``."""
 
     def __init__(self, params, config, engine_config, state_manager=None):
+        wq = getattr(engine_config, "quantization", None)
+        if wq is not None and wq.enabled:
+            # ZeRO-Inference weight quantization: int8 at rest, dequantized
+            # inside the jitted forward (inference/v2/quantization.py)
+            if engine_config.tensor_parallel.tp_size > 1:
+                raise NotImplementedError(
+                    "weight_quantization with TP>1: AutoTP classifies by leaf "
+                    "paths, which quantized subtrees change — quantize per-shard "
+                    "after placement instead (not yet wired)")
+            from deepspeed_tpu.inference.v2.quantization import quantize_tree
+            params = quantize_tree(params, min_size=wq.min_size, bits=wq.bits)
         self._params = params
         self._config = config
         self._engine_config = engine_config
@@ -172,7 +183,9 @@ class DSTransformerModelBase:
 
     def _forward_impl(self, params, cache, batch):
         import jax.numpy as jnp
+        from deepspeed_tpu.inference.v2.quantization import dequantize_tree
 
+        params = dequantize_tree(params)  # no-op without quantized leaves
         batch = self._unpack_batch(batch)
         x = self.embed(params, batch["input_ids"])
         attn = partial(self._paged_attention, batch=batch)
@@ -190,16 +203,23 @@ class DSTransformerModelBase:
         for observability; the reference pays CUDA-event overhead instead)."""
         import jax
         import jax.numpy as jnp
+        from deepspeed_tpu.inference.v2.quantization import dequantize_tree
 
+        # one cached jit; with quantization on, tracing mode holds a full-
+        # precision weight copy for the duration of the phase-split forward
+        # (observability mode trades memory+speed for timers, as documented)
+        if not hasattr(self, "_dequant_fn"):
+            self._dequant_fn = jax.jit(dequantize_tree)
+        params = self._dequant_fn(self._params)
         batch_j = self._unpack_batch({k: jnp.asarray(v) for k, v in batch.items()})
         with record("embed"):
-            x = jax.jit(self.embed)(self._params, batch_j["input_ids"])
+            x = jax.jit(self.embed)(params, batch_j["input_ids"])
             x.block_until_ready()
         attn = partial(self._paged_attention, batch=batch_j)
         for li in range(self.num_layers):
-            x, cache = self.layer_forward_traced(self._params, li, x, cache, attn, batch_j)
+            x, cache = self.layer_forward_traced(params, li, x, cache, attn, batch_j)
         with record("unembed"):
-            logits = jax.jit(self.unembed)(self._params, x[batch_j["last_tok"]])
+            logits = jax.jit(self.unembed)(params, x[batch_j["last_tok"]])
             logits = logits.astype(jnp.float32)
             logits.block_until_ready()
         self._state_manager.kv_cache.set_cache(cache)

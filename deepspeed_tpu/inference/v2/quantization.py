@@ -1,0 +1,79 @@
+"""ZeRO-Inference-style weight quantization for the ragged engine.
+
+Reference: the ZeRO-Inference release (reference README.md:17 — "20x faster
+inference" via weight quantization + KV-cache offload) and
+``deepspeed/inference/quantization`` (per-channel symmetric int8 of the
+matmul weights, dequantized on use).
+
+TPU formulation: quantized leaves are stored int8 in HBM with per-output-
+channel fp scales; ``dequantize_tree`` runs *inside* the jitted forward, so
+XLA fuses the int8→bf16 convert+scale into each weight's consumer — weights
+stream from HBM at 1 byte/element (the decode-path win; matmuls stay MXU
+bf16). Pytree-native: a quantized leaf becomes a ``{QKEY, SKEY, DKEY}`` dict
+subtree, invisible to checkpointing and sharding machinery.
+"""
+
+from typing import Any
+
+import numpy as np
+
+QKEY = "__wq_int8__"
+SKEY = "__wq_scale__"
+DKEY = "__wq_dtype__"
+
+
+def _quantize_leaf(w):
+    import jax.numpy as jnp
+    # per-output-channel symmetric int8: reduce the contraction axis (-2),
+    # keep leading (expert/stack) dims
+    scale = jnp.max(jnp.abs(w), axis=-2, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-12).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    # dtype marker as a 0-d array so the subtree stays a pure array pytree
+    return {QKEY: q, SKEY: scale, DKEY: jnp.zeros((), w.dtype)}
+
+
+def is_quantized_leaf(node) -> bool:
+    return isinstance(node, dict) and QKEY in node
+
+
+def quantize_tree(params, min_size: int = 4096, bits: int = 8):
+    """Quantize every floating leaf with ndim >= 2 and >= ``min_size`` elements
+    (norm scales, biases and small tensors stay full precision — the
+    reference's exclusion list)."""
+    import jax.numpy as jnp
+    if bits != 8:
+        raise NotImplementedError(f"only int8 weight quantization is implemented (got {bits})")
+
+    def rec(node):
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        if (hasattr(node, "ndim") and node.ndim >= 2
+                and jnp.issubdtype(node.dtype, jnp.floating)
+                and int(np.prod(node.shape)) >= min_size):
+            return _quantize_leaf(node)
+        return node
+
+    return rec(params)
+
+
+def dequantize_tree(params):
+    """Collapse quantized subtrees back to full-precision arrays. Called inside
+    jit: the convert+scale fuses into each weight's consumer, so the at-rest
+    representation stays int8."""
+    import jax.numpy as jnp
+
+    def rec(node):
+        if is_quantized_leaf(node):
+            return (node[QKEY].astype(jnp.float32) * node[SKEY]).astype(node[DKEY].dtype)
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        return node
+
+    return rec(params)
+
+
+def tree_nbytes(params) -> int:
+    """Total array bytes in a (possibly quantized) tree — the memory claim."""
+    import jax
+    return sum(l.nbytes for l in jax.tree.leaves(params) if hasattr(l, "nbytes"))

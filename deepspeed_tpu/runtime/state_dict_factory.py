@@ -1,0 +1,225 @@
+"""Checkpoint state-dict loading with TP-degree conversion.
+
+Reference: ``deepspeed/runtime/state_dict_factory.py`` (SDLoaderFactory:21,
+SDLoaderBase:48, MegatronSDLoader:190): given a list of per-TP-rank checkpoint
+files, ``load(mp_world_size, mp_rank)`` returns that rank's state dict —
+loading directly when the degrees match, **merging** neighbor shards when the
+new TP degree is smaller, **splitting** a shard when it is larger. Fused
+query-key-value tensors need version-aware treatment (ckpt_ver 0 interleaves
+heads as [q1 k1 v1 q2 ...]; later versions store [q* k* v*] contiguously).
+
+TPU formulation: checkpoint files are flat ``name -> numpy array`` dicts
+(``.npz`` — what ``save_16bit_model`` writes) instead of torch pickles; the
+merge/split axis per tensor follows the same Megatron naming rules the
+reference hard-codes. All host-side numpy; the result feeds ``jax.device_put``
+against whatever shardings the new topology assigns.
+"""
+
+import json
+import os
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+AUTO_MODULE_KEY = "auto"
+
+
+class SDLoaderFactory:
+
+    @staticmethod
+    def get_sd_loader_json(json_file, checkpoint_engine=None):
+        """Reference :24 — a checkpoint-description JSON ({"type", "version",
+        "checkpoints"}) or its already-parsed dict."""
+        if isinstance(json_file, str):
+            with open(json_file) as f:
+                data = json.load(f)
+        else:
+            data = json_file
+        sd_type = data["type"]
+        ckpt_list = data["checkpoints"]
+        version = data.get("version")
+        if isinstance(ckpt_list, dict):  # BLOOM-style {"tp_size": n, "files": [...]}
+            ckpt_list = ckpt_list["files"]
+        if sd_type.lower() in ("bloom", "ds_model"):
+            return data  # reference returns the raw dict for these types
+        return SDLoaderFactory.get_sd_loader(ckpt_list, checkpoint_engine, sd_type, version)
+
+    @staticmethod
+    def get_sd_loader(ckpt_list, checkpoint_engine=None, sd_type="Megatron", version=None):
+        if sd_type.lower() == "megatron":
+            return MegatronSDLoader(ckpt_list, version, checkpoint_engine)
+        raise NotImplementedError(f"SD loader for type {sd_type!r}")
+
+
+def _load_file(path) -> Dict[str, np.ndarray]:
+    if str(path).endswith(".npz"):
+        with np.load(path, allow_pickle=True) as z:
+            return {k: z[k] for k in z.files}
+    raise ValueError(f"unsupported checkpoint file {path!r} (expected .npz)")
+
+
+class SDLoaderBase(ABC):
+
+    def __init__(self, ckpt_list: List[str], version, checkpoint_engine=None):
+        self.module_key = AUTO_MODULE_KEY
+        self.ckpt_list = list(ckpt_list)
+        self.version = version
+        self.check_ckpt_list()
+
+    # ------------------------------------------------------------------- load --
+    def load(self, mp_world_size: int, mp_rank: int, module_key=AUTO_MODULE_KEY,
+             is_pipe_parallel=False, quantize=False, quantize_bits=8,
+             quantize_groups=64, mlp_extra_grouping=True):
+        """Reference :57. Returns (load_path, state_dict)."""
+        self.module_key = module_key
+        num_ckpt = len(self.ckpt_list)
+
+        if num_ckpt == mp_world_size:
+            path = self.ckpt_list[mp_rank]
+            return path, _load_file(path)
+        if num_ckpt > mp_world_size:
+            if num_ckpt % mp_world_size != 0:
+                raise ValueError(f"cannot merge {num_ckpt} shards into {mp_world_size}")
+            return None, self.merge_state_dict(mp_world_size, mp_rank)
+        if mp_world_size % num_ckpt != 0:
+            raise ValueError(f"cannot split {num_ckpt} shards into {mp_world_size}")
+        return None, self.split_state_dict(mp_world_size, mp_rank)
+
+    def get_merge_state_dicts(self, mp_world_size: int, mp_rank: int):
+        """The ckpt-file group this rank merges (reference :115)."""
+        num_to_merge = len(self.ckpt_list) // mp_world_size
+        files = self.ckpt_list[num_to_merge * mp_rank:num_to_merge * (mp_rank + 1)]
+        logger.info(f"mp_rank {mp_rank}: merging {files}")
+        return [_load_file(f) for f in files]
+
+    def get_split_state_dict(self, mp_world_size: int, mp_rank: int):
+        """The (ckpt file, intra-file offset) this rank splits from (:126)."""
+        num_to_split = mp_world_size // len(self.ckpt_list)
+        ckpt_index = mp_rank // num_to_split
+        offset = mp_rank % num_to_split
+        logger.info(f"mp_rank {mp_rank}: splitting {self.ckpt_list[ckpt_index]} "
+                    f"({offset}/{num_to_split})")
+        return _load_file(self.ckpt_list[ckpt_index]), num_to_split, offset
+
+    def check_ckpt_list(self):
+        assert len(self.ckpt_list) > 0, "empty checkpoint list"
+        for p in self.ckpt_list:
+            if not os.path.exists(p):
+                raise FileNotFoundError(f"checkpoint shard {p} missing")
+
+    @abstractmethod
+    def merge_state_dict(self, mp_world_size, mp_rank):
+        ...
+
+    @abstractmethod
+    def split_state_dict(self, mp_world_size, mp_rank):
+        ...
+
+
+class MegatronSDLoader(SDLoaderBase):
+    """Megatron-naming merge/split rules (reference :190).
+
+    - cat dim 0 (column-parallel fan-out): ``word_embeddings``,
+      ``mlp.dense_h_to_4h`` (weight AND bias), fused QKV (version-aware).
+    - cat dim 1 (row-parallel fan-in): ``attention.dense.weight``,
+      ``mlp.dense_4h_to_h.weight``; their biases are replicated.
+    - everything else (norms, row-parallel biases): identical across ranks.
+    """
+
+    # ------------------------------------------------------------ qkv helpers --
+    def merge_query_key_value(self, param_list: List[np.ndarray], ckpt_ver):
+        """Reference :220. ckpt_ver 0: each shard is [n_heads_local*3*hn, h]
+        with per-head q/k/v interleaved — merge by concatenating per-section;
+        ckpt_ver >= 1: shards are [3*d_local, ...] with q*, k*, v* contiguous —
+        split each in 3, concatenate sections, restack [q|k|v]."""
+        if ckpt_ver == 0:
+            return np.concatenate(param_list, axis=0)
+        qs, ks, vs = [], [], []
+        for p in param_list:
+            q, k, v = np.split(p, 3, axis=0)
+            qs.append(q)
+            ks.append(k)
+            vs.append(v)
+        return np.concatenate([np.concatenate(qs, axis=0),
+                               np.concatenate(ks, axis=0),
+                               np.concatenate(vs, axis=0)], axis=0)
+
+    def split_query_key_value(self, param: np.ndarray, num_to_split: int, offset: int,
+                              ckpt_ver):
+        """Reference :258 — the inverse of :meth:`merge_query_key_value`."""
+        if ckpt_ver == 0:
+            return np.split(param, num_to_split, axis=0)[offset]
+        q, k, v = np.split(param, 3, axis=0)
+        return np.concatenate([np.split(q, num_to_split, axis=0)[offset],
+                               np.split(k, num_to_split, axis=0)[offset],
+                               np.split(v, num_to_split, axis=0)[offset]], axis=0)
+
+    # ---------------------------------------------------------- classification --
+    @staticmethod
+    def _is_qkv(key: str) -> bool:
+        return "attention.query_key_value" in key or "attn.qkv" in key
+
+    @staticmethod
+    def _cat_dim(key: str) -> Optional[int]:
+        """None = replicated."""
+        if "word_embeddings" in key or "position_embeddings" in key:
+            return 0 if "word" in key else None
+        if "mlp.dense_h_to_4h" in key:  # column-parallel: weight + bias split
+            return 0
+        if ("attention.dense.weight" in key or "mlp.dense_4h_to_h.weight" in key
+                or "attn.out_proj.weight" in key):
+            return 1
+        return None
+
+    # --------------------------------------------------------------- merge/split --
+    def merge_state_dict(self, mp_world_size, mp_rank, quantize=False, quantize_bits=8,
+                         groups=64, mlp_extra_grouping=True):
+        sds = self.get_merge_state_dicts(mp_world_size, mp_rank)
+        ver = self.get_checkpoint_version(sds[0])
+        out = {}
+        for key in sds[0]:
+            vals = [sd[key] for sd in sds]
+            if self._is_qkv(key):
+                out[key] = self.merge_query_key_value(vals, ver)
+            else:
+                dim = self._cat_dim(key)
+                if dim is None or vals[0].ndim <= dim:
+                    out[key] = vals[0]
+                else:
+                    out[key] = np.concatenate(vals, axis=dim)
+        return out
+
+    def split_state_dict(self, mp_world_size, mp_rank, quantize=False, quantize_bits=8,
+                         groups=64, mlp_extra_grouping=True):
+        sd, num_to_split, offset = self.get_split_state_dict(mp_world_size, mp_rank)
+        ver = self.get_checkpoint_version(sd)
+        out = {}
+        for key, val in sd.items():
+            if self._is_qkv(key):
+                out[key] = self.split_query_key_value(val, num_to_split, offset, ver)
+            else:
+                dim = self._cat_dim(key)
+                if dim is None or val.ndim <= dim:
+                    out[key] = val
+                else:
+                    out[key] = np.split(val, num_to_split, axis=dim)[offset]
+        return out
+
+    def get_checkpoint_version(self, state_dict) -> int:
+        """Reference :425 — an explicit ``version`` wins over the in-file tag."""
+        if self.version is not None:
+            return int(self.version)
+        tag = state_dict.get("checkpoint_version")
+        return int(np.asarray(tag)) if tag is not None else 0
+
+    def sanity_check(self, ckpt_file_name):
+        """Reference :403 — the Megatron keys the rules above rely on."""
+        sd = _load_file(ckpt_file_name)
+        required = ["attention.dense.weight", "mlp.dense_4h_to_h.weight"]
+        for part in required:
+            if not any(part in k for k in sd):
+                logger.warning(f"{ckpt_file_name}: no key matching {part!r} — "
+                               f"merge/split rules may not apply")

@@ -41,15 +41,21 @@ class NvmeSwappedLeaf:
     dtype: Any              # numpy dtype
     shards: Tuple[_ShardEntry, ...]
 
-    def _read_local(self, aio) -> np.ndarray:
-        """Read this process's shards back into a global-shaped host buffer
-        (regions owned by other processes stay zero — never consumed there)."""
-        out = np.zeros(self.shape, self.dtype)
+    def _submit_reads(self, aio):
+        """Start all shard preads; returns the pending list for
+        :meth:`_complete_reads` — split so the swapper can overlap reads of
+        MANY leaves (the pipelined swap-in)."""
         pending = []
         for sh in self.shards:
             buf = np.empty(sh.shape, self.dtype)
             rid = aio.async_pread(buf, self.path, offset=sh.offset)
             pending.append((rid, sh, buf))
+        return pending
+
+    def _complete_reads(self, aio, pending) -> np.ndarray:
+        """Wait the preads and assemble the global-shaped host buffer (regions
+        owned by other processes stay zero — never consumed there)."""
+        out = np.zeros(self.shape, self.dtype)
         for rid, sh, buf in pending:
             got = aio.wait(rid)
             if got != buf.nbytes:
@@ -59,6 +65,9 @@ class NvmeSwappedLeaf:
             idx = sh.index if out.ndim else ()
             out[idx] = np.reshape(buf, np.shape(out[idx]))
         return out
+
+    def _read_local(self, aio) -> np.ndarray:
+        return self._complete_reads(aio, self._submit_reads(aio))
 
 
 def _is_stub(x) -> bool:
@@ -73,7 +82,13 @@ def _addressable_shards(leaf):
         data = np.ascontiguousarray(np.asarray(leaf))
         return [(tuple(slice(None) for _ in data.shape), data)]
     out = []
+    seen = set()  # replicated-over-some-axes leaves repeat indices: write once
     for s in sorted(shards, key=lambda s: s.device.id):
+        key = tuple((sl.start, sl.stop, sl.step) if isinstance(sl, slice) else sl
+                    for sl in s.index)
+        if key in seen:
+            continue
+        seen.add(key)
         out.append((s.index, np.ascontiguousarray(np.asarray(s.data))))
     return out
 
@@ -156,11 +171,12 @@ class PartitionedOptimizerSwapper:
         if len(shard_leaves) != len(leaves):
             shard_leaves = [None] * len(leaves)
 
-        inflight = []  # (position, host_buffer)
-        out = [None] * len(leaves)
+        inflight = []  # (position, stub, pending preads) — reads of up to
+        out = [None] * len(leaves)  # buffer_count LEAVES overlap on the pool
 
         def complete_one():
-            i, host = inflight.pop(0)
+            i, stub, pending = inflight.pop(0)
+            host = stub._complete_reads(self.aio, pending)
             s = shard_leaves[i]
             out[i] = jax.device_put(host, s) if s is not None else jax.numpy.asarray(host)
 
@@ -168,7 +184,7 @@ class PartitionedOptimizerSwapper:
             if not _is_stub(leaf):
                 out[i] = leaf
                 continue
-            inflight.append((i, leaf._read_local(self.aio)))
+            inflight.append((i, leaf, leaf._submit_reads(self.aio)))
             if len(inflight) >= self.buffer_count:
                 complete_one()
         while inflight:

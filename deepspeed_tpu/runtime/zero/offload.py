@@ -217,8 +217,10 @@ class NvmeOffloadPlan(OptimizerOffloadPlan):
         import jax
         if jax.process_count() > 1:
             # multi-host: hand orbax sharded jax.Arrays (each process
-            # contributes its shards); host materialization is single-process
-            return self.swapper.swap_in(opt_state, self.compute_shardings)
+            # contributes its shards) — placed in PINNED HOST memory so taking
+            # a checkpoint never materializes the full state in HBM (the tier's
+            # whole point); host materialization to numpy is single-process
+            return self.swapper.swap_in(opt_state, host_shardings(self.compute_shardings))
         return self.swapper.materialize_host(opt_state)
 
     def restore_template(self, opt_state):

@@ -1,0 +1,126 @@
+"""TP-degree checkpoint conversion (reference runtime/state_dict_factory.py)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.state_dict_factory import MegatronSDLoader, SDLoaderFactory
+
+H, FF, HEADS = 8, 32, 4
+
+
+def _full_sd(rng):
+    return {
+        "word_embeddings.weight": rng.normal(size=(64, H)).astype(np.float32),
+        "layers.0.attention.query_key_value.weight": rng.normal(size=(3 * H, H)).astype(np.float32),
+        "layers.0.attention.dense.weight": rng.normal(size=(H, H)).astype(np.float32),
+        "layers.0.attention.dense.bias": rng.normal(size=(H, )).astype(np.float32),
+        "layers.0.mlp.dense_h_to_4h.weight": rng.normal(size=(FF, H)).astype(np.float32),
+        "layers.0.mlp.dense_h_to_4h.bias": rng.normal(size=(FF, )).astype(np.float32),
+        "layers.0.mlp.dense_4h_to_h.weight": rng.normal(size=(H, FF)).astype(np.float32),
+        "layers.0.input_layernorm.weight": rng.normal(size=(H, )).astype(np.float32),
+        "checkpoint_version": np.asarray(1),
+    }
+
+
+def _shard(sd, n, r):
+    """Reference-layout TP shard r of n (v1 qkv: contiguous q|k|v sections)."""
+    out = {}
+    for k, v in sd.items():
+        if "query_key_value" in k:
+            q, kk, vv = np.split(v, 3, axis=0)
+            out[k] = np.concatenate([np.split(x, n, axis=0)[r] for x in (q, kk, vv)])
+        elif "word_embeddings" in k or "dense_h_to_4h" in k:
+            out[k] = np.split(v, n, axis=0)[r]
+        elif "attention.dense.weight" in k or "dense_4h_to_h.weight" in k:
+            out[k] = np.split(v, n, axis=1)[r]
+        else:
+            out[k] = v
+    return out
+
+
+def _write(tmp_path, shards):
+    paths = []
+    for i, sd in enumerate(shards):
+        p = tmp_path / f"mp_rank_{i:02d}.npz"
+        np.savez(p, **sd)
+        paths.append(str(p))
+    return paths
+
+
+def test_load_matching_degree(tmp_path):
+    rng = np.random.default_rng(0)
+    full = _full_sd(rng)
+    paths = _write(tmp_path, [_shard(full, 2, r) for r in range(2)])
+    loader = SDLoaderFactory.get_sd_loader(paths)
+    path, sd = loader.load(mp_world_size=2, mp_rank=1)
+    assert path == paths[1]
+    np.testing.assert_array_equal(sd["layers.0.input_layernorm.weight"],
+                                  full["layers.0.input_layernorm.weight"])
+
+
+def test_merge_to_smaller_degree(tmp_path):
+    """4 shards → TP 1: every merged tensor equals the original full tensor
+    (incl. the section-aware fused QKV)."""
+    rng = np.random.default_rng(1)
+    full = _full_sd(rng)
+    paths = _write(tmp_path, [_shard(full, 4, r) for r in range(4)])
+    loader = SDLoaderFactory.get_sd_loader(paths)
+    _, merged = loader.load(mp_world_size=1, mp_rank=0)
+    for k in full:
+        np.testing.assert_array_equal(merged[k], full[k], err_msg=k)
+
+
+def test_split_to_larger_degree(tmp_path):
+    """1 shard → TP 4: each piece equals the directly computed shard."""
+    rng = np.random.default_rng(2)
+    full = _full_sd(rng)
+    paths = _write(tmp_path, [full])
+    loader = SDLoaderFactory.get_sd_loader(paths)
+    for r in range(4):
+        _, sd = loader.load(mp_world_size=4, mp_rank=r)
+        want = _shard(full, 4, r)
+        for k in want:
+            np.testing.assert_array_equal(sd[k], want[k], err_msg=f"{k} rank {r}")
+
+
+def test_merge_split_roundtrip_2_to_4(tmp_path):
+    """2 shards → TP 4 (split each in 2): reassembling all 4 gives the full
+    tensors back."""
+    rng = np.random.default_rng(3)
+    full = _full_sd(rng)
+    paths = _write(tmp_path, [_shard(full, 2, r) for r in range(2)])
+    loader = SDLoaderFactory.get_sd_loader(paths)
+    pieces = [loader.load(mp_world_size=4, mp_rank=r)[1] for r in range(4)]
+    merged_qkv = MegatronSDLoader([paths[0]], version=1).merge_query_key_value(
+        [p["layers.0.attention.query_key_value.weight"] for p in pieces], 1)
+    np.testing.assert_array_equal(merged_qkv,
+                                  full["layers.0.attention.query_key_value.weight"])
+
+
+def test_qkv_version0_interleaved():
+    """ckpt_ver 0 merges by plain concat and splits by plain chunking."""
+    rng = np.random.default_rng(4)
+    full = rng.normal(size=(24, H)).astype(np.float32)
+    loader = MegatronSDLoader.__new__(MegatronSDLoader)
+    loader.version = 0
+    shards = np.split(full, 4, axis=0)
+    np.testing.assert_array_equal(loader.merge_query_key_value(shards, 0), full)
+    np.testing.assert_array_equal(loader.split_query_key_value(full, 4, 2, 0), shards[2])
+
+
+def test_factory_json(tmp_path):
+    rng = np.random.default_rng(5)
+    full = _full_sd(rng)
+    paths = _write(tmp_path, [full])
+    desc = tmp_path / "ckpt.json"
+    desc.write_text(json.dumps({"type": "Megatron", "version": 1, "checkpoints": paths}))
+    loader = SDLoaderFactory.get_sd_loader_json(str(desc))
+    assert isinstance(loader, MegatronSDLoader)
+    assert loader.version == 1
+
+
+def test_missing_shard_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        SDLoaderFactory.get_sd_loader([str(tmp_path / "nope.npz")])
