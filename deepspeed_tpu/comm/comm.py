@@ -288,6 +288,182 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, async_op=False, log_name=
     return all_reduce(tensor, op=op, group=group)
 
 
+def all_gather(tensor, group=None, async_op=False, log_name=None):
+    """Reference list-based all_gather; the SPMD form returns the stacked
+    [G, ...] tensor (what the reference writes into its tensor_list)."""
+    return all_gather_into_tensor(tensor, group=group)
+
+
+def all_gather_coalesced(tensors, group=None, async_op=False):
+    return [all_gather_into_tensor(t, group=group) for t in tensors]
+
+
+def all_reduce_coalesced(tensors, op=ReduceOp.SUM, group=None, async_op=False):
+    return [all_reduce(t, op=op, group=group) for t in tensors]
+
+
+def all_to_all(tensor, group=None, async_op=False, log_name=None):
+    return all_to_all_single(tensor, group=group)
+
+
+def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, async_op=False, log_name=None):
+    return reduce_scatter_tensor(tensor, op=op, group=group)
+
+
+def gather(tensor, dst=0, group=None, async_op=False, log_name=None):
+    """Rooted gather: under SPMD the gathered result exists on every rank (a
+    rooted variant has no cost advantage on a mesh) — reference semantics are
+    a superset."""
+    return all_gather_into_tensor(tensor, group=group)
+
+
+def scatter(tensor, src=0, group=None, async_op=False, log_name=None):
+    """Rank r receives chunk r of the SOURCE rank's row (stacked layout:
+    dim0 = ranks, each row = the flattened scatter list) — the inverse of
+    :func:`all_gather`."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.utils import groups as _g
+
+    axes = _resolve_group(group)
+    spec = _group_spec(axes)
+    tensor = _device_put_grouped(tensor, axes)
+    mesh = _g.get_mesh()
+    G = 1
+    for ax in (axes if isinstance(axes, (tuple, list)) else (axes, )):
+        G *= mesh.shape.get(ax, 1)
+
+    if tensor.shape[-1] % G != 0:
+        raise ValueError(f"scatter: dim {tensor.shape[-1]} must divide evenly into "
+                         f"{G} chunks (the reference rejects unequal chunks too)")
+
+    def f(x):
+        idx = jax.lax.axis_index(axes)
+        masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+        full = jax.lax.psum(masked, axes)  # the source row, on every rank
+        chunk = full.shape[1] // G
+        return jax.lax.dynamic_slice_in_dim(full, idx * chunk, chunk, axis=1)
+
+    return _shard_map(f, spec, spec)(tensor)
+
+
+# -- point-to-point: no user-level p2p under single-program SPMD ---------------
+def send(tensor, dst, group=None, tag=0):
+    raise NotImplementedError("point-to-point send/recv does not exist under "
+                              "single-program SPMD; express neighbor exchange with "
+                              "jax.lax.ppermute inside shard_map (see runtime/pipe)")
+
+
+def recv(tensor, src, group=None, tag=0):
+    raise NotImplementedError("see send(): use jax.lax.ppermute inside shard_map")
+
+
+def isend(tensor, dst, group=None, tag=0):
+    return send(tensor, dst, group, tag)
+
+
+def irecv(tensor, src, group=None, tag=0):
+    return recv(tensor, src, group, tag)
+
+
+# -- groups / ranks -------------------------------------------------------------
+def get_world_group():
+    """The whole-mesh group (None = all axes in this API)."""
+    return None
+
+
+def new_group(ranks=None):
+    """Mesh axes ARE the process groups here; arbitrary rank sets cannot be
+    carved out of an SPMD mesh. The world group (all ranks, device-count
+    convention like get_world_size) is allowed for compatibility."""
+    if ranks is None or sorted(ranks) == list(range(get_world_size())):
+        return None
+    raise NotImplementedError("arbitrary-rank groups: use mesh axis names "
+                              "(groups.initialize_mesh) as the group structure")
+
+
+def get_global_rank(group=None, group_rank=0):
+    if group is None:
+        return int(group_rank)
+    raise NotImplementedError(
+        "an axis-name group has one replica per remaining-mesh coordinate, so "
+        "group_rank alone does not determine a global rank; compute positions "
+        "with jax.lax.axis_index inside shard_map instead")
+
+
+def get_all_ranks_from_group(group=None):
+    from deepspeed_tpu.utils import groups as _g
+    axes = _resolve_group(group)
+    size = 1
+    mesh = _g.get_mesh()
+    for ax in (axes if isinstance(axes, (tuple, list)) else (axes, )):
+        size *= mesh.shape.get(ax, 1)
+    return list(range(size))
+
+
+# -- capability probes (reference has_* feature detection) ----------------------
+def is_available() -> bool:
+    return True
+
+
+def has_all_gather_into_tensor() -> bool:
+    return True
+
+
+def has_reduce_scatter_tensor() -> bool:
+    return True
+
+
+def has_all_reduce_coalesced() -> bool:
+    return True
+
+
+def has_coalescing_manager() -> bool:
+    return False  # XLA fuses collectives; there is no manual manager
+
+
+def set_backend(backend_name=None):
+    ...  # the XLA backend is the only one; kept for API parity
+
+
+def init_deepspeed_backend(ds_backend=None, timeout=None, init_method=None):
+    ...  # init_distributed covers this
+
+
+def mpi_discovery(distributed_port=29500, verbose=True):
+    """Populate DSTPU_* rendezvous env from OpenMPI env (reference comm.py
+    mpi_discovery; init_distributed applies the same mapping internally)."""
+    import os
+    env = os.environ
+    if "OMPI_COMM_WORLD_RANK" in env:
+        env.setdefault("DSTPU_PROCESS_ID", env["OMPI_COMM_WORLD_RANK"])
+        env.setdefault("DSTPU_NUM_PROCESSES", env["OMPI_COMM_WORLD_SIZE"])
+
+
+# -- cloud-environment detectors (reference comm.py:586-676) --------------------
+def in_aml() -> bool:
+    import os
+    return "AZUREML_EXPERIMENT_ID" in os.environ
+
+
+def in_aws_sm() -> bool:
+    import os
+    return "SM_TRAINING_ENV" in os.environ
+
+
+def in_dlts() -> bool:
+    import os
+    return "DLTS_JOB_ID" in os.environ
+
+
+def patch_aml_env_for_torch_nccl_backend(*a, **k):
+    ...  # NCCL env shims do not apply to the XLA backend
+
+
+def patch_aws_sm_env_for_torch_nccl_backend(*a, **k):
+    ...
+
+
 def barrier(group=None):
     import jax
     jax.effects_barrier()

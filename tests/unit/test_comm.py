@@ -79,3 +79,48 @@ def test_comms_logger_records():
     summary = dist.comm.comms_logger.log_all(print_log=False)
     assert "all_reduce" in summary
     dist.configure(enabled=False)
+
+
+# ---- reference-surface breadth (reference comm.py exports) --------------------
+def test_alias_and_list_collectives():
+    x = np.arange(1.0, 9.0).reshape(8, 1).astype(np.float32)
+    np.testing.assert_array_equal(np.asarray(dist.all_gather(x)),
+                                  np.asarray(dist.all_gather_into_tensor(x)))
+    rs = np.ones((8, 16), np.float32)  # per-rank [16]; chunk per rank = [2]
+    np.testing.assert_array_equal(np.asarray(dist.reduce_scatter(rs)),
+                                  np.asarray(dist.reduce_scatter_tensor(rs)))
+    outs = dist.all_reduce_coalesced([x, 2 * x])
+    np.testing.assert_allclose(np.asarray(outs[1]), 2 * np.asarray(outs[0]))
+    outs = dist.all_gather_coalesced([x])
+    assert np.asarray(outs[0]).shape[0] == 8
+
+
+def test_scatter_hands_each_rank_its_chunk():
+    # src rank 0 holds chunks [0..7]; after scatter, rank r holds chunk r —
+    # stacked per-rank layout == the identity
+    x = np.tile(np.arange(8.0, dtype=np.float32).reshape(1, 8), (8, 1))
+    out = np.asarray(dist.scatter(x, src=0))
+    np.testing.assert_array_equal(out, np.arange(8.0, dtype=np.float32).reshape(8, 1))
+
+
+def test_p2p_raises_with_guidance():
+    with pytest.raises(NotImplementedError, match="ppermute"):
+        dist.send(np.zeros(4), dst=1)
+    with pytest.raises(NotImplementedError, match="ppermute"):
+        dist.recv(np.zeros(4), src=0)
+
+
+def test_group_and_capability_surface():
+    assert dist.get_world_group() is None
+    assert dist.new_group() is None
+    assert dist.new_group(list(range(dist.get_world_size()))) is None  # world idiom
+    with pytest.raises(NotImplementedError):
+        dist.new_group([0, 2])
+    with pytest.raises(NotImplementedError):
+        dist.get_global_rank("model", 1)
+    assert dist.get_global_rank(None, 3) == 3
+    assert dist.get_all_ranks_from_group(None) == list(range(8))
+    assert dist.is_available()
+    assert dist.has_all_gather_into_tensor() and dist.has_reduce_scatter_tensor()
+    assert dist.has_all_reduce_coalesced() and not dist.has_coalescing_manager()
+    assert not dist.in_aml() and not dist.in_aws_sm() and not dist.in_dlts()
