@@ -15,6 +15,7 @@ from deepspeed_tpu import comm as comm
 from deepspeed_tpu.accelerator import get_accelerator
 from deepspeed_tpu.comm.comm import init_distributed
 from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.runtime import zero
 from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
 from deepspeed_tpu.utils import groups, logger, log_dist
 from deepspeed_tpu.version import __version__, git_branch, git_hash

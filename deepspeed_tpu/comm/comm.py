@@ -333,9 +333,12 @@ def scatter(tensor, src=0, group=None, async_op=False, log_name=None):
     for ax in (axes if isinstance(axes, (tuple, list)) else (axes, )):
         G *= mesh.shape.get(ax, 1)
 
-    if tensor.shape[-1] % G != 0:
-        raise ValueError(f"scatter: dim {tensor.shape[-1]} must divide evenly into "
-                         f"{G} chunks (the reference rejects unequal chunks too)")
+    if tensor.ndim < 2:
+        raise ValueError("scatter expects the stacked [ranks, chunks...] layout "
+                         "(dim0 = ranks, dim1 = the flattened scatter list)")
+    if tensor.shape[1] % G != 0:
+        raise ValueError(f"scatter: dim-1 size {tensor.shape[1]} must divide evenly "
+                         f"into {G} chunks (the reference rejects unequal chunks too)")
 
     def f(x):
         idx = jax.lax.axis_index(axes)
@@ -431,13 +434,30 @@ def init_deepspeed_backend(ds_backend=None, timeout=None, init_method=None):
 
 
 def mpi_discovery(distributed_port=29500, verbose=True):
-    """Populate DSTPU_* rendezvous env from OpenMPI env (reference comm.py
-    mpi_discovery; init_distributed applies the same mapping internally)."""
+    """Populate the full DSTPU_* rendezvous contract from OpenMPI env
+    (reference comm.py mpi_discovery: rank/size from env, the coordinator
+    address broadcast from rank 0 via mpi4py — MASTER_ADDR/PORT there)."""
     import os
+    import socket
     env = os.environ
-    if "OMPI_COMM_WORLD_RANK" in env:
-        env.setdefault("DSTPU_PROCESS_ID", env["OMPI_COMM_WORLD_RANK"])
-        env.setdefault("DSTPU_NUM_PROCESSES", env["OMPI_COMM_WORLD_SIZE"])
+    if "OMPI_COMM_WORLD_RANK" not in env:
+        return
+    env.setdefault("DSTPU_PROCESS_ID", env["OMPI_COMM_WORLD_RANK"])
+    env.setdefault("DSTPU_NUM_PROCESSES", env["OMPI_COMM_WORLD_SIZE"])
+    if "DSTPU_COORDINATOR" not in env:
+        try:
+            from mpi4py import MPI
+            comm = MPI.COMM_WORLD
+            host = comm.bcast(socket.gethostbyname(socket.gethostname()), root=0)
+            env["DSTPU_COORDINATOR"] = f"{host}:{distributed_port}"
+        except ImportError:
+            logger.warning("mpi_discovery: mpi4py unavailable — set DSTPU_COORDINATOR "
+                           "to rank-0's host:port yourself or use the dstpu launcher "
+                           "(it exports the full contract)")
+    if verbose:
+        logger.info(f"mpi_discovery: rank={env['DSTPU_PROCESS_ID']} "
+                    f"world={env['DSTPU_NUM_PROCESSES']} "
+                    f"coordinator={env.get('DSTPU_COORDINATOR', 'UNSET')}")
 
 
 # -- cloud-environment detectors (reference comm.py:586-676) --------------------

@@ -49,6 +49,26 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     quant: QuantizationConfig = {}
     max_tokens: int = Field(1024, alias="max_out_tokens")
 
+    # accept-for-parity knobs (reference config.py fields users routinely set)
+    mp_size: int = 1  # deprecated alias of tensor_parallel.tp_size (see validator)
+    training_mp_size: int = 1
+    moe_type: str = "standard"
+    replace_method: str = "auto"
+    base_dir: str = ""
+    checkpoint_config: dict = Field({}, alias="checkpoint_dict")
+    save_mp_checkpoint_path: Optional[str] = None
+    ep_size: int = 1
+    return_tuple: bool = True
+    set_empty_params: bool = False
+    transposed_mode: bool = False
+    use_triton: bool = False  # triton is a CUDA concept; Pallas kernels are built in
+    triton_autotune: bool = False
+
+    def model_post_init(self, __context):
+        # reference semantics: mp_size is the legacy spelling of tp_size
+        if self.mp_size > 1 and self.tensor_parallel.tp_size == 1:
+            self.tensor_parallel.tp_size = self.mp_size
+
     @property
     def jnp_dtype(self):
         import jax.numpy as jnp

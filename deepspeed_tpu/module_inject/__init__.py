@@ -1,1 +1,3 @@
 from deepspeed_tpu.module_inject.auto_tp import auto_tp_specs
+from deepspeed_tpu.module_inject.layers import (EmbeddingLayer, LinearAllreduce, LinearLayer,
+                                                Normalize)

@@ -1,0 +1,107 @@
+"""Tensor-parallel layer library.
+
+Reference: ``deepspeed/module_inject/layers.py`` (LinearAllreduce:15,
+LinearLayer:40, EmbeddingLayer:75, Normalize:63 — the Megatron-style building
+blocks ``replace_module`` swaps in, each carrying its own collective).
+
+TPU formulation: flax modules that declare their sharding intent with
+``with_sharding_constraint`` over the ``model`` mesh axis; XLA's partitioner
+then inserts exactly the collective the reference hand-codes (the row-parallel
+all-reduce, the column-parallel identity). Each class exposes
+``kernel_spec()`` so param-placement machinery (AutoTP, hand specs) agrees
+with the activation constraints.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.utils import groups
+
+
+def _constraint(x, spec):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    if not groups.mesh_is_initialized():
+        return x
+    mesh = groups.get_mesh()
+    if mesh.shape.get(groups.MODEL_AXIS, 1) <= 1:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
+class LinearLayer(nn.Module):
+    """Column-parallel linear (reference LinearLayer:40): the weight splits on
+    the OUTPUT dim; each TP rank computes its slice, no collective (its
+    consumer is a row-parallel layer that contracts the sliced dim)."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+
+    @staticmethod
+    def kernel_spec():
+        from jax.sharding import PartitionSpec as P
+        return P(None, groups.MODEL_AXIS)
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Dense(self.features, use_bias=self.use_bias, dtype=self.dtype,
+                     name="linear")(x)
+        return _constraint(y, (None, ) * (y.ndim - 1) + (groups.MODEL_AXIS, ))
+
+
+class LinearAllreduce(nn.Module):
+    """Row-parallel linear (reference LinearAllreduce:15): the weight splits on
+    the INPUT dim; each rank contracts its slice of the (column-parallel
+    sharded) activations and the partial sums all-reduce — the collective XLA
+    inserts when the constrained-sharded input meets a replicated output."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+
+    @staticmethod
+    def kernel_spec():
+        from jax.sharding import PartitionSpec as P
+        return P(groups.MODEL_AXIS, None)
+
+    @nn.compact
+    def __call__(self, x):
+        x = _constraint(x, (None, ) * (x.ndim - 1) + (groups.MODEL_AXIS, ))
+        y = nn.Dense(self.features, use_bias=self.use_bias, dtype=self.dtype,
+                     name="linear")(x)
+        return _constraint(y, (None, ) * y.ndim)  # replicated → psum on the wire
+
+
+class EmbeddingLayer(nn.Module):
+    """Vocab-parallel embedding (reference EmbeddingLayer:75): the table splits
+    on the vocab dim; out-of-shard ids contribute zeros and the partial
+    lookups all-reduce (XLA lowers the sharded gather exactly so)."""
+
+    num_embeddings: int
+    features: int
+    dtype: Optional[jnp.dtype] = None
+
+    @staticmethod
+    def kernel_spec():
+        from jax.sharding import PartitionSpec as P
+        return P(groups.MODEL_AXIS, None)
+
+    @nn.compact
+    def __call__(self, ids):
+        emb = nn.Embed(self.num_embeddings, self.features, dtype=self.dtype,
+                       name="embedding")(ids)
+        return _constraint(emb, (None, ) * emb.ndim)
+
+
+class Normalize(nn.Module):
+    """LayerNorm, replicated (reference Normalize:63 — norms never shard)."""
+
+    epsilon: float = 1e-5
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.LayerNorm(epsilon=self.epsilon, dtype=self.dtype, name="norm")(x)

@@ -223,6 +223,13 @@ class DeepSpeedEngine:
                 self.params = jax.jit(_born_sharded_init,
                                       out_shardings=self._param_shardings)(sub)
             except Exception as e:
+                from deepspeed_tpu.runtime.zero.partition_parameters import init_context_active
+                if init_context_active():
+                    # the user demanded construction-time sharding (zero.Init):
+                    # failing beats silently materializing the full tree on host
+                    raise RuntimeError(f"zero.Init is active but sharded-at-birth init "
+                                       f"failed ({e}); fix the model's init traceability "
+                                       f"instead of falling back to eager materialization") from e
                 # non-traceable init (e.g. host-side setup): eager fallback
                 logger.warning(f"sharded-at-birth init unavailable ({e}); "
                                f"materializing params eagerly")
@@ -939,9 +946,11 @@ class DeepSpeedEngine:
         return self.progressive_layer_drop.get_theta() if self.progressive_layer_drop else 1.0
 
     def empty_partition_cache(self):
-        """Reference: frees ZeRO-3 gathered params; XLA owns those buffers
-        here, so clearing the compiled programs is the analog."""
-        self._compiled.clear()
+        """Reference: frees ZeRO-3 gathered params between phases. XLA owns the
+        gathered buffers here (freed when the program ends), so there is
+        nothing to release — and dropping compiled programs would turn this
+        routinely-called, near-free API into a forced recompilation."""
+        ...
 
     def update_optimizer_step(self, step):
         ...  # optimizer step counters live in the functional opt state

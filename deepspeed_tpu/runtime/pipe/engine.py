@@ -242,6 +242,11 @@ class PipelineEngine(DeepSpeedEngine):
             micro = [next(data_iter) for _ in range(self._micro_batches)]
             batch = jax.tree.map(lambda *xs: np.concatenate([np.asarray(x) for x in xs]), *micro)
 
+        if self.is_gradient_accumulation_boundary() is False:
+            raise PipelineError(
+                "set_gradient_accumulation_boundary(False) cannot suppress the "
+                "optimizer step: the pipeline fuses schedule+step into one program. "
+                "Drive micro-steps through the base engine instead.")
         batch = self.shard_batch(batch)
         rng = self._next_rng()
         loss, grads = self._grad_fn()(self.params, batch, rng, self.scale_state.cur_scale)
@@ -281,4 +286,9 @@ class PipelineEngine(DeepSpeedEngine):
         raise PipelineError("Only train_batch() is accessible when using pipeline parallelism")
 
     def is_gradient_accumulation_boundary(self):
+        # train_batch fuses the whole 1F1B schedule + step into one program, so
+        # every call IS a boundary — unless the user forced it off (reference
+        # _force_grad_boundary, honored by set_gradient_accumulation_boundary)
+        if self._gas_boundary_override is not None:
+            return self._gas_boundary_override
         return True

@@ -1,0 +1,10 @@
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.runtime.zero.partition_parameters import (GatheredParameters, Init,
+                                                             register_external_parameter,
+                                                             unregister_external_parameter)
+from deepspeed_tpu.runtime.zero.policy import ZeroShardingPolicy
+from deepspeed_tpu.runtime.zero.tiling import TiledLinear
+
+__all__ = ["DeepSpeedZeroConfig", "GatheredParameters", "Init", "TiledLinear",
+           "ZeroShardingPolicy", "register_external_parameter",
+           "unregister_external_parameter"]

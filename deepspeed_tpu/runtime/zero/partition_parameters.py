@@ -1,0 +1,120 @@
+"""User-facing ``zero.Init`` / ``GatheredParameters`` surface.
+
+Reference: ``deepspeed/runtime/zero/partition_parameters.py`` (Init:786 — a
+module-subclass post-init hook that partitions parameters at construction;
+GatheredParameters:2044 — a context that all-gathers partitioned params for
+host-side reads/edits; register_external_parameter:132 — manual dependency
+registration for params used outside their owning module).
+
+TPU formulation: parameters are born sharded when the engine jit-inits with
+ZeRO ``out_shardings`` (engine.py step 7), so ``Init`` is a *declaration*
+rather than a mechanism — it records the config and flags intent, and the
+engine init path honors it by refusing the eager-materialization fallback
+(construction-time OOM beats silently materializing a 7B tree on one host).
+``GatheredParameters`` yields replicated host copies (the all-gather); since
+jax arrays are immutable, write-back goes through the returned handle's
+``update()`` instead of in-place mutation.
+"""
+
+import contextlib
+from typing import Any, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+_INIT_CONTEXT = {"active": False, "config": None}
+
+
+class Init:
+    """``with zero.Init(config_dict_or_path=...):`` around model construction.
+
+    Under jax there is nothing to intercept at construction (flax modules are
+    shape-free until ``init``); the engine's sharded-at-birth path
+    (``initialize(..., example_batch=...)``) is the actual mechanism. This
+    context records that the user demanded construction-time sharding so the
+    engine can fail loudly instead of falling back to eager host
+    materialization.
+    """
+
+    def __init__(self, module=None, data_parallel_group=None, mem_efficient_linear=True,
+                 remote_device=None, pin_memory=False, config_dict_or_path=None,
+                 config=None, enabled=True, dtype=None, mpu=None, zero_param_parallel_group=None,
+                 zero_quantized_weights=False, zero_quantized_nontrainable_weights=False,
+                 sequence_data_parallel_group=None, param_swapper=None):
+        self.enabled = enabled
+        self.config = config_dict_or_path if config_dict_or_path is not None else config
+
+    def __enter__(self):
+        if self.enabled:
+            _INIT_CONTEXT["active"] = True
+            _INIT_CONTEXT["config"] = self.config
+            logger.info("zero.Init active: engine init must take the sharded-at-birth "
+                        "path (pass example_batch to initialize())")
+        return self
+
+    def __exit__(self, *exc):
+        _INIT_CONTEXT["active"] = False
+        _INIT_CONTEXT["config"] = None
+        return False
+
+
+def init_context_active() -> bool:
+    return _INIT_CONTEXT["active"]
+
+
+# reference partition_parameters.shutdown_init_context/restore_init_context
+# (used by deepspeed.initialize around engine construction)
+_SAVED = {"state": None}
+
+
+def shutdown_init_context():
+    _SAVED["state"] = dict(_INIT_CONTEXT)
+    _INIT_CONTEXT["active"] = False
+
+
+def restore_init_context():
+    if _SAVED["state"] is not None:
+        _INIT_CONTEXT.update(_SAVED["state"])
+        _SAVED["state"] = None
+
+
+class GatheredParameters:
+    """``with GatheredParameters(tree) as g:`` — host-replicated copies of
+    (possibly ZeRO-sharded) parameters; the all-gather is ``device_get`` of the
+    global arrays.
+
+    jax arrays are immutable, so the reference's modifier_rank in-place edit
+    becomes: mutate ``g.params`` (host numpy) inside the context, then call
+    ``g.update(engine)`` (or read ``g.params``) — exiting without ``update``
+    discards edits, matching the reference's modifier_rank=None read-only mode.
+    """
+
+    def __init__(self, params, modifier_rank: Optional[int] = None, fwd_module=None,
+                 enabled: bool = True):
+        self._src = params
+        self.modifier_rank = modifier_rank
+        self.enabled = enabled
+        self.params: Any = None
+
+    def __enter__(self):
+        import jax
+        if self.enabled:
+            self.params = jax.device_get(self._src)
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def update(self, engine):
+        """Write the (host-edited) tree back through the engine's shardings."""
+        engine.load_module_state_dict(self.params)
+
+
+def register_external_parameter(module, parameter):
+    """Reference :132 — manual autograd-dependency registration for params
+    accessed outside their owning module. XLA's dataflow graph tracks every
+    use of every array, so there is nothing to register."""
+    ...
+
+
+def unregister_external_parameter(module, parameter):
+    ...

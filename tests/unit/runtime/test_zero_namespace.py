@@ -1,0 +1,75 @@
+"""deepspeed_tpu.zero public namespace (reference zero.Init:786,
+GatheredParameters:2044, register_external_parameter:132)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.utils import groups
+
+from ..simple_model import make_simple_model, random_batches
+
+HIDDEN = 16
+
+
+def test_namespace_exports():
+    z = deepspeed_tpu.zero
+    assert hasattr(z, "Init") and hasattr(z, "GatheredParameters")
+    assert hasattr(z, "TiledLinear") and hasattr(z, "register_external_parameter")
+    z.register_external_parameter(None, None)  # well-defined no-op
+
+
+def test_init_context_flags_and_engine_honors_it():
+    from deepspeed_tpu.runtime.zero.partition_parameters import init_context_active
+
+    assert not init_context_active()
+    with deepspeed_tpu.zero.Init(config_dict_or_path={"zero_optimization": {"stage": 3}}):
+        assert init_context_active()
+    assert not init_context_active()
+
+
+def test_init_context_rejects_eager_fallback():
+    """Under zero.Init, a model whose init cannot trace must FAIL, not silently
+    materialize the full tree on host (the reference's whole point)."""
+    groups.initialize_mesh(force=True)
+
+    class HostSideInit:
+        def init(self, rng, batch):
+            raise RuntimeError("host-side setup")  # untraceable by construction
+
+        def apply(self, variables, batch):
+            return 0.0
+
+    with deepspeed_tpu.zero.Init():
+        with pytest.raises(RuntimeError, match="sharded-at-birth"):
+            deepspeed_tpu.initialize(
+                model=HostSideInit(), example_batch=np.zeros((2, HIDDEN), np.float32),
+                loss_fn=lambda p, b: 0.0,
+                config={"train_micro_batch_size_per_gpu": 2,
+                        "optimizer": {"type": "AdamW", "params": {"lr": 0.01}},
+                        "zero_optimization": {"stage": 3}})
+
+
+def test_gathered_parameters_read_and_update():
+    import jax
+
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params0,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 0.01}},
+                "zero_optimization": {"stage": 3, "stage3_param_persistence_threshold": 0}})
+
+    with deepspeed_tpu.zero.GatheredParameters(eng.params) as g:
+        host = g.params  # replicated host copies of the sharded tree
+        leaves = jax.tree.leaves(host)
+        assert all(isinstance(np.asarray(l), np.ndarray) for l in leaves)
+        # host-side edit + write-back through the engine's shardings
+        g.params = jax.tree.map(lambda l: np.zeros_like(np.asarray(l)), host)
+        g.update(eng)
+    assert all(np.all(np.asarray(l) == 0) for l in jax.tree.leaves(eng.params))
+
+    # disabled context gathers nothing (reference enabled=False short-circuit)
+    with deepspeed_tpu.zero.GatheredParameters(eng.params, enabled=False) as g:
+        assert g.params is None
