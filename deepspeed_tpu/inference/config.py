@@ -50,7 +50,8 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     max_tokens: int = Field(1024, alias="max_out_tokens")
 
     # accept-for-parity knobs (reference config.py fields users routinely set)
-    mp_size: int = 1  # deprecated alias of tensor_parallel.tp_size (see validator)
+    mp_size: int = Field(1, json_schema_extra={
+        "deprecated": True, "new_param": "tensor_parallel.tp_size"})
     training_mp_size: int = 1
     moe_type: str = "standard"
     replace_method: str = "auto"
@@ -63,11 +64,6 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     transposed_mode: bool = False
     use_triton: bool = False  # triton is a CUDA concept; Pallas kernels are built in
     triton_autotune: bool = False
-
-    def model_post_init(self, __context):
-        # reference semantics: mp_size is the legacy spelling of tp_size
-        if self.mp_size > 1 and self.tensor_parallel.tp_size == 1:
-            self.tensor_parallel.tp_size = self.mp_size
 
     @property
     def jnp_dtype(self):

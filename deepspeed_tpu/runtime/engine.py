@@ -222,9 +222,11 @@ class DeepSpeedEngine:
                 self._param_shardings = self.zero_policy.param_shardings(abstract, self.param_specs)
                 self.params = jax.jit(_born_sharded_init,
                                       out_shardings=self._param_shardings)(sub)
+                from deepspeed_tpu.runtime.zero.partition_parameters import consume_init_context
+                consume_init_context()  # zero.Init demand honored
             except Exception as e:
-                from deepspeed_tpu.runtime.zero.partition_parameters import init_context_active
-                if init_context_active():
+                from deepspeed_tpu.runtime.zero.partition_parameters import init_context_demanded
+                if init_context_demanded():
                     # the user demanded construction-time sharding (zero.Init):
                     # failing beats silently materializing the full tree on host
                     raise RuntimeError(f"zero.Init is active but sharded-at-birth init "

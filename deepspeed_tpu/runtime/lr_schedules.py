@@ -271,4 +271,51 @@ def add_tuning_arguments(parser):
     group.add_argument("--lr_range_test_step_size", type=int, default=1000)
     group.add_argument("--lr_range_test_step_rate", type=float, default=1.0)
     group.add_argument("--lr_range_test_staircase", type=bool, default=False)
+    group.add_argument("--cycle_min_lr", type=float, default=0.001)
+    group.add_argument("--cycle_max_lr", type=float, default=0.01)
+    group.add_argument("--cycle_first_step_size", type=int, default=2000)
+    group.add_argument("--decay_lr_rate", type=float, default=0.0)
+    group.add_argument("--warmup_min_lr", type=float, default=0)
+    group.add_argument("--warmup_max_lr", type=float, default=0.001)
+    group.add_argument("--warmup_num_steps", type=int, default=1000)
+    group.add_argument("--warmup_type", type=str, default="log")
     return parser
+
+
+def parse_arguments():
+    """Reference lr_schedules.py:124 — (known LR args, the rest)."""
+    import argparse
+    parser = add_tuning_arguments(argparse.ArgumentParser())
+    return parser.parse_known_args()
+
+
+def get_config_from_args(args):
+    """Reference lr_schedules.py:208 — a scheduler config block from argparse
+    flags; returns (config, error_string)."""
+    if not getattr(args, "lr_schedule", None):
+        return None, "--lr_schedule not specified on command line"
+    if args.lr_schedule not in VALID_LR_SCHEDULES:
+        return None, f"{args.lr_schedule} is not supported LR schedule"
+    # only flags the chosen scheduler actually accepts (each class has its own
+    # parameter vocabulary — WarmupCosineLR takes ratios, not warmup_*_lr)
+    import inspect
+    accepted = set(inspect.signature(_SCHEDULES[args.lr_schedule].__init__).parameters)
+    params = {k: v for k, v in vars(args).items()
+              if k in accepted and v is not None and k != "lr_schedule"}
+    return {"type": args.lr_schedule, "params": params}, None
+
+
+def get_lr_from_config(config):
+    """Reference lr_schedules.py — the schedule's peak/base LR; returns
+    (lr, explanation)."""
+    if "type" not in config:
+        return None, "LR schedule type not defined in config"
+    params = config.get("params", {})
+    stype = config["type"]
+    if stype not in VALID_LR_SCHEDULES:
+        return None, f"{stype} is not a valid LR schedule"
+    if stype == "LRRangeTest":
+        return params.get("lr_range_test_min_lr", 0.001), "LR range test minimum"
+    if stype == "OneCycle":
+        return params.get("cycle_max_lr", 0.001), "OneCycle maximum"
+    return params.get("warmup_max_lr", 0.001), "warmup maximum"

@@ -237,16 +237,18 @@ class PipelineEngine(DeepSpeedEngine):
         micro-batches and performs one optimizer step."""
         import jax
         import jax.numpy as jnp
+        if self.is_gradient_accumulation_boundary() is False:
+            # raise BEFORE consuming the caller's iterator — micro-batches
+            # pulled past a raise would be silently lost
+            raise PipelineError(
+                "set_gradient_accumulation_boundary(False) cannot suppress the "
+                "optimizer step: the pipeline fuses schedule+step into one program. "
+                "Drive micro-steps through the base engine instead.")
         if batch is None:
             assert data_iter is not None
             micro = [next(data_iter) for _ in range(self._micro_batches)]
             batch = jax.tree.map(lambda *xs: np.concatenate([np.asarray(x) for x in xs]), *micro)
 
-        if self.is_gradient_accumulation_boundary() is False:
-            raise PipelineError(
-                "set_gradient_accumulation_boundary(False) cannot suppress the "
-                "optimizer step: the pipeline fuses schedule+step into one program. "
-                "Drive micro-steps through the base engine instead.")
         batch = self.shard_batch(batch)
         rng = self._next_rng()
         loss, grads = self._grad_fn()(self.params, batch, rng, self.scale_state.cur_scale)

@@ -21,7 +21,7 @@ from typing import Any, Optional
 
 from deepspeed_tpu.utils.logging import logger
 
-_INIT_CONTEXT = {"active": False, "config": None}
+_INIT_CONTEXT = {"active": False, "config": None, "demanded": False}
 
 
 class Init:
@@ -46,8 +46,12 @@ class Init:
     def __enter__(self):
         if self.enabled:
             _INIT_CONTEXT["active"] = True
+            # the demand OUTLIVES the with-block: the reference pattern
+            # constructs inside and calls initialize() after, so the flag must
+            # still be visible when the engine builds (it is consumed there)
+            _INIT_CONTEXT["demanded"] = True
             _INIT_CONTEXT["config"] = self.config
-            logger.info("zero.Init active: engine init must take the sharded-at-birth "
+            logger.info("zero.Init: engine init must take the sharded-at-birth "
                         "path (pass example_batch to initialize())")
         return self
 
@@ -58,7 +62,18 @@ class Init:
 
 
 def init_context_active() -> bool:
+    """Inside a live ``with zero.Init()`` block."""
     return _INIT_CONTEXT["active"]
+
+
+def init_context_demanded() -> bool:
+    """A zero.Init was opened this process and not yet consumed by an engine."""
+    return _INIT_CONTEXT["active"] or _INIT_CONTEXT["demanded"]
+
+
+def consume_init_context():
+    """Engine init honored (or rejected) the demand; clear it."""
+    _INIT_CONTEXT["demanded"] = False
 
 
 # reference partition_parameters.shutdown_init_context/restore_init_context

@@ -12,6 +12,14 @@ from ..simple_model import make_simple_model, random_batches
 HIDDEN = 16
 
 
+@pytest.fixture(autouse=True)
+def _reset_init_demand():
+    yield
+    from deepspeed_tpu.runtime.zero import partition_parameters as pp
+    pp._INIT_CONTEXT["active"] = False
+    pp.consume_init_context()
+
+
 def test_namespace_exports():
     z = deepspeed_tpu.zero
     assert hasattr(z, "Init") and hasattr(z, "GatheredParameters")
@@ -20,12 +28,16 @@ def test_namespace_exports():
 
 
 def test_init_context_flags_and_engine_honors_it():
-    from deepspeed_tpu.runtime.zero.partition_parameters import init_context_active
+    from deepspeed_tpu.runtime.zero.partition_parameters import (init_context_active,
+                                                                 init_context_demanded)
 
-    assert not init_context_active()
+    assert not init_context_active() and not init_context_demanded()
     with deepspeed_tpu.zero.Init(config_dict_or_path={"zero_optimization": {"stage": 3}}):
         assert init_context_active()
     assert not init_context_active()
+    # the demand OUTLIVES the block: the reference pattern constructs inside
+    # and calls initialize() after it
+    assert init_context_demanded()
 
 
 def test_init_context_rejects_eager_fallback():
@@ -41,13 +53,14 @@ def test_init_context_rejects_eager_fallback():
             return 0.0
 
     with deepspeed_tpu.zero.Init():
-        with pytest.raises(RuntimeError, match="sharded-at-birth"):
-            deepspeed_tpu.initialize(
-                model=HostSideInit(), example_batch=np.zeros((2, HIDDEN), np.float32),
-                loss_fn=lambda p, b: 0.0,
-                config={"train_micro_batch_size_per_gpu": 2,
-                        "optimizer": {"type": "AdamW", "params": {"lr": 0.01}},
-                        "zero_optimization": {"stage": 3}})
+        pass  # reference pattern: construct inside, initialize() AFTER the block
+    with pytest.raises(RuntimeError, match="sharded-at-birth"):
+        deepspeed_tpu.initialize(
+            model=HostSideInit(), example_batch=np.zeros((2, HIDDEN), np.float32),
+            loss_fn=lambda p, b: 0.0,
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 0.01}},
+                    "zero_optimization": {"stage": 3}})
 
 
 def test_gathered_parameters_read_and_update():

@@ -72,3 +72,24 @@ def test_state_dict_roundtrip():
     s2 = WarmupLR(warmup_max_lr=0.1, warmup_num_steps=10)
     s2.load_state_dict(sd)
     assert s2.last_batch_iteration == s.last_batch_iteration
+
+
+def test_cli_config_helpers():
+    """parse_arguments/get_config_from_args/get_lr_from_config (reference
+    lr_schedules.py:124,208)."""
+    import argparse
+    from deepspeed_tpu.runtime.lr_schedules import (add_tuning_arguments, get_config_from_args,
+                                                    get_lr_from_config)
+
+    parser = add_tuning_arguments(argparse.ArgumentParser())
+    args, rest = parser.parse_known_args(
+        ["--lr_schedule", "WarmupLR", "--warmup_max_lr", "0.01", "--unrelated", "1"])
+    assert rest == ["--unrelated", "1"]
+    cfg, err = get_config_from_args(args)
+    assert err is None and cfg["type"] == "WarmupLR"
+    assert cfg["params"]["warmup_max_lr"] == 0.01
+    lr, why = get_lr_from_config(cfg)
+    assert lr == 0.01 and "warmup" in why
+
+    bad, err = get_config_from_args(argparse.Namespace(lr_schedule="NopeLR"))
+    assert bad is None and "not supported" in err
