@@ -240,6 +240,16 @@ class DeepSpeedEngine:
             raise ValueError("model_parameters (the initial parameter pytree) is required "
                              "(or pass example_batch with a flax model to init in-engine)")
         if model_parameters is not None:
+            from deepspeed_tpu.runtime.zero.partition_parameters import (consume_init_context,
+                                                                         init_context_demanded)
+            if init_context_demanded():
+                # the tree is already host-materialized, so the zero.Init demand
+                # cannot be honored on this path — say so and consume it rather
+                # than silently arming a later engine's fallback check
+                logger.warning("zero.Init was requested but model_parameters arrived "
+                               "pre-materialized on host; pass example_batch (and no "
+                               "model_parameters) for sharded-at-birth init")
+                consume_init_context()
             params = cast_tree(model_parameters, self.master_dtype)
             self._param_shardings = self.zero_policy.param_shardings(params, self.param_specs)
             # jit-copy (not plain device_put): the step donates param buffers, and

@@ -63,6 +63,22 @@ def test_init_context_rejects_eager_fallback():
                     "zero_optimization": {"stage": 3}})
 
 
+def test_init_demand_consumed_by_materialized_path():
+    """model_parameters pre-materialized: the demand is diagnosed + consumed so
+    it cannot spuriously fail a LATER unrelated engine init."""
+    from deepspeed_tpu.runtime.zero.partition_parameters import init_context_demanded
+
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+    with deepspeed_tpu.zero.Init():
+        pass
+    assert init_context_demanded()
+    deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                             config={"train_micro_batch_size_per_gpu": 2,
+                                     "optimizer": {"type": "AdamW", "params": {"lr": 0.01}}})
+    assert not init_context_demanded(), "materialized-path init must consume the demand"
+
+
 def test_gathered_parameters_read_and_update():
     import jax
 
