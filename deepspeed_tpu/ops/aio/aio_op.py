@@ -73,18 +73,30 @@ class AsyncIOHandle:
     # -- fallback engine ---------------------------------------------------------
     def _py_submit(self, is_write: bool, path: str, buf: np.ndarray, offset: int) -> int:
         def run():
-            mode = "r+b" if is_write and os.path.exists(path) else ("wb" if is_write else "rb")
-            with open(path, mode) as f:
-                f.seek(offset)
+            # O_CREAT without O_TRUNC (mirroring the C++ engine's open flags):
+            # concurrent first writes to a new file must not truncate each
+            # other's shards. pwrite/pread keep each request's offset private.
+            flags = (os.O_CREAT | os.O_WRONLY) if is_write else os.O_RDONLY
+            fd = os.open(path, flags, 0o644)
+            try:
                 if is_write:
-                    f.write(memoryview(buf).cast("B"))
-                    f.flush()
-                    os.fsync(f.fileno())
+                    view = memoryview(buf).cast("B")
+                    done = 0
+                    while done < buf.nbytes:
+                        done += os.pwrite(fd, view[done:], offset + done)
+                    os.fsync(fd)
                     return buf.nbytes
-                data = f.read(buf.nbytes)
                 flat = memoryview(buf).cast("B")
-                flat[:len(data)] = data
-                return len(data)
+                done = 0
+                while done < buf.nbytes:
+                    chunk = os.pread(fd, buf.nbytes - done, offset + done)
+                    if not chunk:
+                        break  # EOF
+                    flat[done:done + len(chunk)] = chunk
+                    done += len(chunk)
+                return done
+            finally:
+                os.close(fd)
 
         rid = self._next_id
         self._next_id += 1

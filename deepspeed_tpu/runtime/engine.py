@@ -109,6 +109,12 @@ class DeepSpeedEngine:
         import jax
         import jax.numpy as jnp
 
+        # Snapshot-and-clear the zero.Init demand FIRST: it governs this engine
+        # only, and an exception anywhere below must not leave it armed for the
+        # next (unrelated) engine built in this process.
+        from deepspeed_tpu.runtime.zero.partition_parameters import snapshot_and_clear_init_demand
+        zero_init_demanded = snapshot_and_clear_init_demand()
+
         self.module = model
         self.client_optimizer = optimizer
         self.client_lr_scheduler = lr_scheduler
@@ -222,11 +228,8 @@ class DeepSpeedEngine:
                 self._param_shardings = self.zero_policy.param_shardings(abstract, self.param_specs)
                 self.params = jax.jit(_born_sharded_init,
                                       out_shardings=self._param_shardings)(sub)
-                from deepspeed_tpu.runtime.zero.partition_parameters import consume_init_context
-                consume_init_context()  # zero.Init demand honored
             except Exception as e:
-                from deepspeed_tpu.runtime.zero.partition_parameters import init_context_demanded
-                if init_context_demanded():
+                if zero_init_demanded:
                     # the user demanded construction-time sharding (zero.Init):
                     # failing beats silently materializing the full tree on host
                     raise RuntimeError(f"zero.Init is active but sharded-at-birth init "
@@ -240,16 +243,13 @@ class DeepSpeedEngine:
             raise ValueError("model_parameters (the initial parameter pytree) is required "
                              "(or pass example_batch with a flax model to init in-engine)")
         if model_parameters is not None:
-            from deepspeed_tpu.runtime.zero.partition_parameters import (consume_init_context,
-                                                                         init_context_demanded)
-            if init_context_demanded():
+            if zero_init_demanded:
                 # the tree is already host-materialized, so the zero.Init demand
-                # cannot be honored on this path — say so and consume it rather
-                # than silently arming a later engine's fallback check
+                # cannot be honored on this path — say so (the demand was already
+                # consumed at entry)
                 logger.warning("zero.Init was requested but model_parameters arrived "
                                "pre-materialized on host; pass example_batch (and no "
                                "model_parameters) for sharded-at-birth init")
-                consume_init_context()
             params = cast_tree(model_parameters, self.master_dtype)
             self._param_shardings = self.zero_policy.param_shardings(params, self.param_specs)
             # jit-copy (not plain device_put): the step donates param buffers, and

@@ -5,14 +5,18 @@ SDLoaderBase:48, MegatronSDLoader:190): given a list of per-TP-rank checkpoint
 files, ``load(mp_world_size, mp_rank)`` returns that rank's state dict —
 loading directly when the degrees match, **merging** neighbor shards when the
 new TP degree is smaller, **splitting** a shard when it is larger. Fused
-query-key-value tensors need version-aware treatment (ckpt_ver 0 interleaves
-heads as [q1 k1 v1 q2 ...]; later versions store [q* k* v*] contiguously).
+query-key-value tensors need version-aware treatment (see below).
 
 TPU formulation: checkpoint files are flat ``name -> numpy array`` dicts
 (``.npz`` — what ``save_16bit_model`` writes) instead of torch pickles; the
 merge/split axis per tensor follows the same Megatron naming rules the
 reference hard-codes. All host-side numpy; the result feeds ``jax.device_put``
 against whatever shardings the new topology assigns.
+
+Fused-QKV layouts (reference :220): ckpt_ver 0 stores [(3*np*hn), h] — the
+q/k/v *sections* are contiguous within a shard, so TP conversion must operate
+per-section; ckpt_ver 1.0/2.0 store [(np*hn*3), h] / [(np*3*hn), h] — each
+head's qkv travels with it, so conversion is plain concat/chunk on dim 0.
 """
 
 import json
@@ -131,31 +135,36 @@ class MegatronSDLoader(SDLoaderBase):
 
     # ------------------------------------------------------------ qkv helpers --
     def merge_query_key_value(self, param_list: List[np.ndarray], ckpt_ver):
-        """Reference :220. ckpt_ver 0: each shard is [n_heads_local*3*hn, h]
-        with per-head q/k/v interleaved — merge by concatenating per-section;
-        ckpt_ver >= 1: shards are [3*d_local, ...] with q*, k*, v* contiguous —
-        split each in 3, concatenate sections, restack [q|k|v]."""
+        """Reference :220. ckpt_ver 0: each shard is [(3*np*hn), h] — the q/k/v
+        sections are contiguous *within each shard*, so merging concatenates
+        per-section (split each shard in 3, concat q-sections, k-sections,
+        v-sections, restack [q|k|v]). ckpt_ver 1.0/2.0: [(np*hn*3), h] or
+        [(np*3*hn), h] — heads carry their own qkv, so merge is plain concat."""
         if ckpt_ver == 0:
+            qs, ks, vs = [], [], []
+            for p in param_list:
+                q, k, v = np.split(p, 3, axis=0)
+                qs.append(q)
+                ks.append(k)
+                vs.append(v)
+            return np.concatenate([np.concatenate(qs, axis=0),
+                                   np.concatenate(ks, axis=0),
+                                   np.concatenate(vs, axis=0)], axis=0)
+        if ckpt_ver in (1, 2):
             return np.concatenate(param_list, axis=0)
-        qs, ks, vs = [], [], []
-        for p in param_list:
-            q, k, v = np.split(p, 3, axis=0)
-            qs.append(q)
-            ks.append(k)
-            vs.append(v)
-        return np.concatenate([np.concatenate(qs, axis=0),
-                               np.concatenate(ks, axis=0),
-                               np.concatenate(vs, axis=0)], axis=0)
+        raise ValueError(f"checkpoint version: {ckpt_ver} is not supported")
 
     def split_query_key_value(self, param: np.ndarray, num_to_split: int, offset: int,
                               ckpt_ver):
         """Reference :258 — the inverse of :meth:`merge_query_key_value`."""
         if ckpt_ver == 0:
+            q, k, v = np.split(param, 3, axis=0)
+            return np.concatenate([np.split(q, num_to_split, axis=0)[offset],
+                                   np.split(k, num_to_split, axis=0)[offset],
+                                   np.split(v, num_to_split, axis=0)[offset]], axis=0)
+        if ckpt_ver in (1, 2):
             return np.split(param, num_to_split, axis=0)[offset]
-        q, k, v = np.split(param, 3, axis=0)
-        return np.concatenate([np.split(q, num_to_split, axis=0)[offset],
-                               np.split(k, num_to_split, axis=0)[offset],
-                               np.split(v, num_to_split, axis=0)[offset]], axis=0)
+        raise ValueError(f"checkpoint version: {ckpt_ver} is not supported")
 
     # ---------------------------------------------------------- classification --
     @staticmethod

@@ -76,6 +76,17 @@ def consume_init_context():
     _INIT_CONTEXT["demanded"] = False
 
 
+def snapshot_and_clear_init_demand() -> bool:
+    """Consume the demand at engine-init entry. The armed flag applies to
+    exactly the next engine built in this process and never beyond it — an
+    abandoned ``with zero.Init()`` block (model construction aborted, or a
+    test that never calls initialize) must not escalate a later unrelated
+    engine's benign eager-init fallback into a hard RuntimeError."""
+    demanded = init_context_demanded()
+    consume_init_context()
+    return demanded
+
+
 # reference partition_parameters.shutdown_init_context/restore_init_context
 # (used by deepspeed.initialize around engine construction)
 _SAVED = {"state": None}
@@ -88,8 +99,13 @@ def shutdown_init_context():
 
 def restore_init_context():
     if _SAVED["state"] is not None:
-        _INIT_CONTEXT.update(_SAVED["state"])
+        saved = _SAVED["state"]
         _SAVED["state"] = None
+        # never resurrect a demand the engine consumed in between: restoring
+        # 'demanded' would re-arm the stale-demand escalation for a later
+        # unrelated engine (the leak snapshot_and_clear_init_demand closes)
+        saved["demanded"] = _INIT_CONTEXT["demanded"]
+        _INIT_CONTEXT.update(saved)
 
 
 class GatheredParameters:

@@ -69,3 +69,40 @@ def test_read_error_surfaces(tmp_path):
     with pytest.raises(OSError):
         h.wait(rid)
     h.close()
+
+
+def test_py_fallback_concurrent_first_writes_no_truncation(tmp_path, monkeypatch):
+    """Python fallback: concurrent writes to a NEW file must not truncate each
+    other (regression: exists-check + 'wb' raced, zeroing the earlier shard)."""
+    from deepspeed_tpu.ops.aio import aio_op
+
+    monkeypatch.setattr(aio_op, "_LIB", None)
+    monkeypatch.setattr(aio_op, "_LIB_TRIED", True)
+    for trial in range(5):  # several trials to give a race a chance
+        p = str(tmp_path / f"fresh_{trial}.bin")
+        h = AsyncIOHandle(thread_count=8)
+        assert h._handle is None and h._pool is not None  # really the fallback
+        shards = [np.full(4096, i, dtype=np.float32) for i in range(8)]
+        ids = [h.async_pwrite(s, p, offset=i * s.nbytes) for i, s in enumerate(shards)]
+        for rid in ids:
+            assert h.wait(rid) == shards[0].nbytes
+        out = np.zeros(8 * 4096, np.float32)
+        h.sync_pread(out, p)
+        h.close()
+        for i in range(8):
+            assert (out[i * 4096:(i + 1) * 4096] == i).all(), f"shard {i} corrupted"
+
+
+def test_py_fallback_short_read_reports_bytes(tmp_path, monkeypatch):
+    from deepspeed_tpu.ops.aio import aio_op
+
+    monkeypatch.setattr(aio_op, "_LIB", None)
+    monkeypatch.setattr(aio_op, "_LIB_TRIED", True)
+    h = AsyncIOHandle(thread_count=1)
+    p = str(tmp_path / "small.bin")
+    src = np.arange(16, dtype=np.float32)
+    h.sync_pwrite(src, p)
+    big = np.zeros(64, np.float32)
+    assert h.sync_pread(big, p) == src.nbytes  # EOF -> short read, not a hang
+    np.testing.assert_array_equal(big[:16], src)
+    h.close()

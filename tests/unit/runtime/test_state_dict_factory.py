@@ -1,4 +1,13 @@
-"""TP-degree checkpoint conversion (reference runtime/state_dict_factory.py)."""
+"""TP-degree checkpoint conversion (reference runtime/state_dict_factory.py).
+
+Fused-QKV formats (reference merge_query_key_value docstring):
+  ver 0   — [(3*np*hn), h]: q/k/v sections contiguous within each shard, so a
+            TP shard of the full [q_all|k_all|v_all] tensor is [q_r|k_r|v_r]
+            and merge/split must be section-aware.
+  ver 1/2 — [(np*hn*3), h] / [(np*3*hn), h]: each head carries its own qkv, so
+            a TP shard is a contiguous chunk and merge/split is plain
+            concat/chunk on dim 0.
+"""
 
 import json
 
@@ -10,7 +19,7 @@ from deepspeed_tpu.runtime.state_dict_factory import MegatronSDLoader, SDLoaderF
 H, FF, HEADS = 8, 32, 4
 
 
-def _full_sd(rng):
+def _full_sd(rng, ver=1):
     return {
         "word_embeddings.weight": rng.normal(size=(64, H)).astype(np.float32),
         "layers.0.attention.query_key_value.weight": rng.normal(size=(3 * H, H)).astype(np.float32),
@@ -20,17 +29,22 @@ def _full_sd(rng):
         "layers.0.mlp.dense_h_to_4h.bias": rng.normal(size=(FF, )).astype(np.float32),
         "layers.0.mlp.dense_4h_to_h.weight": rng.normal(size=(H, FF)).astype(np.float32),
         "layers.0.input_layernorm.weight": rng.normal(size=(H, )).astype(np.float32),
-        "checkpoint_version": np.asarray(1),
+        "checkpoint_version": np.asarray(ver),
     }
 
 
-def _shard(sd, n, r):
-    """Reference-layout TP shard r of n (v1 qkv: contiguous q|k|v sections)."""
+def _shard(sd, n, r, ver=1):
+    """Reference-layout TP shard r of n for the given checkpoint version."""
     out = {}
     for k, v in sd.items():
         if "query_key_value" in k:
-            q, kk, vv = np.split(v, 3, axis=0)
-            out[k] = np.concatenate([np.split(x, n, axis=0)[r] for x in (q, kk, vv)])
+            if ver == 0:
+                # full = [q_all|k_all|v_all]; shard = [q_r|k_r|v_r]
+                q, kk, vv = np.split(v, 3, axis=0)
+                out[k] = np.concatenate([np.split(x, n, axis=0)[r] for x in (q, kk, vv)])
+            else:
+                # per-head qkv: shard = contiguous chunk
+                out[k] = np.split(v, n, axis=0)[r]
         elif "word_embeddings" in k or "dense_h_to_4h" in k:
             out[k] = np.split(v, n, axis=0)[r]
         elif "attention.dense.weight" in k or "dense_4h_to_h.weight" in k:
@@ -60,54 +74,80 @@ def test_load_matching_degree(tmp_path):
                                   full["layers.0.input_layernorm.weight"])
 
 
-def test_merge_to_smaller_degree(tmp_path):
+@pytest.mark.parametrize("ver", [0, 1])
+def test_merge_to_smaller_degree(tmp_path, ver):
     """4 shards → TP 1: every merged tensor equals the original full tensor
-    (incl. the section-aware fused QKV)."""
+    (incl. version-aware fused QKV)."""
     rng = np.random.default_rng(1)
-    full = _full_sd(rng)
-    paths = _write(tmp_path, [_shard(full, 4, r) for r in range(4)])
+    full = _full_sd(rng, ver)
+    paths = _write(tmp_path, [_shard(full, 4, r, ver) for r in range(4)])
     loader = SDLoaderFactory.get_sd_loader(paths)
     _, merged = loader.load(mp_world_size=1, mp_rank=0)
     for k in full:
         np.testing.assert_array_equal(merged[k], full[k], err_msg=k)
 
 
-def test_split_to_larger_degree(tmp_path):
+@pytest.mark.parametrize("ver", [0, 1])
+def test_split_to_larger_degree(tmp_path, ver):
     """1 shard → TP 4: each piece equals the directly computed shard."""
     rng = np.random.default_rng(2)
-    full = _full_sd(rng)
+    full = _full_sd(rng, ver)
     paths = _write(tmp_path, [full])
     loader = SDLoaderFactory.get_sd_loader(paths)
     for r in range(4):
         _, sd = loader.load(mp_world_size=4, mp_rank=r)
-        want = _shard(full, 4, r)
+        want = _shard(full, 4, r, ver)
         for k in want:
             np.testing.assert_array_equal(sd[k], want[k], err_msg=f"{k} rank {r}")
 
 
-def test_merge_split_roundtrip_2_to_4(tmp_path):
+@pytest.mark.parametrize("ver", [0, 1])
+def test_merge_split_roundtrip_2_to_4(tmp_path, ver):
     """2 shards → TP 4 (split each in 2): reassembling all 4 gives the full
     tensors back."""
     rng = np.random.default_rng(3)
-    full = _full_sd(rng)
-    paths = _write(tmp_path, [_shard(full, 2, r) for r in range(2)])
+    full = _full_sd(rng, ver)
+    paths = _write(tmp_path, [_shard(full, 2, r, ver) for r in range(2)])
     loader = SDLoaderFactory.get_sd_loader(paths)
     pieces = [loader.load(mp_world_size=4, mp_rank=r)[1] for r in range(4)]
-    merged_qkv = MegatronSDLoader([paths[0]], version=1).merge_query_key_value(
-        [p["layers.0.attention.query_key_value.weight"] for p in pieces], 1)
+    merged_qkv = MegatronSDLoader([paths[0]], version=ver).merge_query_key_value(
+        [p["layers.0.attention.query_key_value.weight"] for p in pieces], ver)
     np.testing.assert_array_equal(merged_qkv,
                                   full["layers.0.attention.query_key_value.weight"])
 
 
-def test_qkv_version0_interleaved():
-    """ckpt_ver 0 merges by plain concat and splits by plain chunking."""
+def test_qkv_version0_section_aware():
+    """ckpt_ver 0 ([(3*np*hn), h]) merges/splits per q/k/v section — NOT by
+    plain chunking (reference :239-248)."""
     rng = np.random.default_rng(4)
-    full = rng.normal(size=(24, H)).astype(np.float32)
+    full = rng.normal(size=(24, H)).astype(np.float32)  # [q(8)|k(8)|v(8)]
     loader = MegatronSDLoader.__new__(MegatronSDLoader)
     loader.version = 0
-    shards = np.split(full, 4, axis=0)
+    q, k, v = np.split(full, 3, axis=0)
+    shards = [np.concatenate([np.split(x, 4, axis=0)[r] for x in (q, k, v)])
+              for r in range(4)]
     np.testing.assert_array_equal(loader.merge_query_key_value(shards, 0), full)
     np.testing.assert_array_equal(loader.split_query_key_value(full, 4, 2, 0), shards[2])
+
+
+def test_qkv_version1_plain_chunk():
+    """ckpt_ver 1.0/2.0 merge by plain concat and split by plain chunking
+    (reference :249-251)."""
+    rng = np.random.default_rng(5)
+    full = rng.normal(size=(24, H)).astype(np.float32)
+    loader = MegatronSDLoader.__new__(MegatronSDLoader)
+    loader.version = 1
+    shards = np.split(full, 4, axis=0)
+    np.testing.assert_array_equal(loader.merge_query_key_value(shards, 1), full)
+    np.testing.assert_array_equal(loader.split_query_key_value(full, 4, 2, 1), shards[2])
+
+
+def test_qkv_unknown_version_raises():
+    loader = MegatronSDLoader.__new__(MegatronSDLoader)
+    with pytest.raises(ValueError, match="not supported"):
+        loader.merge_query_key_value([np.zeros((6, 2))], 3)
+    with pytest.raises(ValueError, match="not supported"):
+        loader.split_query_key_value(np.zeros((6, 2)), 2, 0, 3)
 
 
 def test_factory_json(tmp_path):

@@ -79,6 +79,40 @@ def test_init_demand_consumed_by_materialized_path():
     assert not init_context_demanded(), "materialized-path init must consume the demand"
 
 
+def test_init_demand_scoped_to_one_engine():
+    """An armed demand applies to exactly the next initialize() — even one that
+    FAILS — so an abandoned zero.Init cannot escalate a later unrelated
+    engine's benign eager-init fallback into a hard RuntimeError."""
+    from deepspeed_tpu.runtime.zero.partition_parameters import init_context_demanded
+
+    groups.initialize_mesh(force=True)
+
+    class HostSideInit:
+        def init(self, rng, batch):
+            raise RuntimeError("host-side setup")
+
+        def apply(self, variables, batch):
+            return 0.0
+
+    with deepspeed_tpu.zero.Init():
+        pass
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "optimizer": {"type": "AdamW", "params": {"lr": 0.01}},
+           "zero_optimization": {"stage": 3}}
+    with pytest.raises(RuntimeError, match="sharded-at-birth"):
+        deepspeed_tpu.initialize(model=HostSideInit(),
+                                 example_batch=np.zeros((2, HIDDEN), np.float32),
+                                 loss_fn=lambda p, b: 0.0, config=cfg)
+    # the failed init consumed the demand: the next (unrelated) engine's
+    # eager fallback is benign again
+    assert not init_context_demanded()
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=HostSideInit(), example_batch=None,
+        model_parameters={"w": np.zeros((HIDDEN,), np.float32)},
+        loss_fn=lambda p, b: 0.0, config=cfg)
+    assert eng is not None
+
+
 def test_gathered_parameters_read_and_update():
     import jax
 
