@@ -146,6 +146,64 @@ class InferenceEngineV2:
             self._model.maybe_free_kv(seq_desc)
         return logits
 
+    # ------------------------------------------------------------ decode_loop --
+    def decode_loop(self, batch_uids: Iterable[int], batch_tokens: Iterable,
+                    n_steps: int, do_checks: bool = True) -> np.ndarray:
+        """Greedy-generate ``n_steps`` tokens per sequence in ONE device
+        program (no host round-trip per token — see
+        DSTransformerModelBase.decode_loop). ``batch_tokens`` holds each
+        sequence's next input token (e.g. the argmax of its prefill logits);
+        returns generated tokens ``[n_seqs, n_steps]``.
+
+        EOS is not monitored on device: the loop always runs ``n_steps``; the
+        caller trims at the first EOS (the fixed-shape scan is what makes the
+        loop a single compiled program).
+        """
+        batch_uids = list(batch_uids)
+        batch_tokens = [np.atleast_1d(np.asarray(t)) for t in batch_tokens]
+        if any(t.size != 1 for t in batch_tokens):
+            raise ValueError("decode_loop takes exactly one next-input token per sequence")
+        if n_steps < 1:
+            raise ValueError("n_steps must be >= 1")
+        if do_checks:
+            # each SCAN STEP's ragged batch holds one token per sequence, so
+            # the token budget is checked against n_seqs — but the KV-block
+            # budget must cover all n_steps appended tokens per sequence
+            if len(batch_uids) > self._config.state_manager.max_ragged_sequence_count:
+                raise SchedulingError(SchedulingResult.BatchSequenceLimitExceeded)
+            if len(batch_uids) > self._config.state_manager.max_ragged_batch_size:
+                raise SchedulingError(SchedulingResult.BatchTokenLimitExceeded)
+            free_blocks = self._state_manager.free_blocks
+            for uid in batch_uids:
+                seq_desc = self._state_manager.get_sequence(uid)
+                if seq_desc is None:
+                    seq_desc = PlaceholderSequenceDescriptor()
+                sched_len, sched_blocks = self._model.get_kv_requirements(
+                    seq_desc, n_steps, free_blocks)
+                if sched_len != n_steps:
+                    raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
+                free_blocks -= sched_blocks
+
+        self._batch.clear()
+        for uid, tokens in zip(batch_uids, batch_tokens):
+            seq_desc = self._state_manager.get_or_create_sequence(uid)
+            # pre-allocate KV blocks for the WHOLE generation: the device loop
+            # cannot allocate mid-scan, and the block table is static inside it
+            self._model.maybe_allocate_kv(seq_desc, n_steps)
+            seq_desc.pre_forward(tokens.size)
+            self._batch.insert_sequence(seq_desc, tokens, do_checks=do_checks)
+
+        self._batch.finalize()
+        tokens = self._model.decode_loop(self._batch, n_steps)  # [n_steps, S_bucket]
+        for uid in batch_uids:
+            seq_desc = self._state_manager.get_sequence(uid)
+            seq_desc.post_forward()           # the token passed in
+            if n_steps > 1:                   # the n_steps-1 tokens the loop inserted
+                seq_desc.pre_forward(n_steps - 1)
+                seq_desc.post_forward()
+            self._model.maybe_free_kv(seq_desc)
+        return tokens[:, :len(batch_uids)].T
+
     # ------------------------------------------------------------- scheduling --
     def query(self, uid: int, max_request_tokens: int, max_request_blocks: int) -> Tuple[int, int]:
         """(tokens schedulable, blocks required) for a hypothetical request."""

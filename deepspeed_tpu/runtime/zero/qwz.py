@@ -1,0 +1,154 @@
+"""qwZ — ZeRO++ quantized weight all-gather.
+
+Reference: ``deepspeed/runtime/zero/partition_parameters.py:1152``
+(``all_gather_coalesced`` with ``quantization`` — each rank quantizes its
+shard to int8 + scales, all-gathers the int8 payload, dequantizes after) and
+``CUDAQuantizer`` at ``partition_parameters.py:731`` over
+``csrc/quantization/quantize.cu``.
+
+TPU formulation: under ZeRO-3 the forward/backward parameter all-gathers are
+inserted by the SPMD partitioner at each weight's consumer. qwZ interposes on
+the master→compute cast: the (still sharded) fp32 shard is quantized to int8
+with per-row scales along the ZeRO-sharded dimension — an elementwise op, so
+no pre-gather communication — and a sharding constraint then *forces the
+all-gather on the int8 payload* (1 byte/element on the ICI wire instead of 2)
+before the dequantize+cast runs replicated. XLA fuses dequant into each
+weight's consumer. Gradients take the straight-through path (``custom_vjp``
+identity): the quantization error perturbs the forward like the reference's,
+while the backward reduce-scatter stays exact.
+"""
+
+import functools
+
+import numpy as np
+
+from deepspeed_tpu.utils import groups
+
+
+def qwz_supported(stage: int) -> bool:
+    return stage >= 3
+
+
+def _sharded_dim(spec, zero_axes):
+    """The dim of ``spec`` carrying any ZeRO axis, or None (replicated /
+    TP-only leaves have nothing to gather cheaply)."""
+    zset = set(zero_axes)
+    for d, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry, )
+        if any(ax in zset for ax in axes):
+            return d
+    return None
+
+
+def _gathered_spec(spec, zero_axes):
+    """``spec`` with the ZeRO axes removed (TP/EP placement survives)."""
+    from jax.sharding import PartitionSpec as P
+    zset = set(zero_axes)
+    out = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = tuple(ax for ax in (entry if isinstance(entry, tuple) else (entry, ))
+                     if ax not in zset)
+        out.append(axes if len(axes) > 1 else (axes[0] if axes else None))
+    return P(*out)
+
+
+def _make_quantized_gather(dim, spec, gathered_spec, gather_axes, mesh, compute_dtype):
+    """fp32 shard -> compute-dtype full weight, moving int8 over the wire.
+
+    The all-gather is an *explicit* ``jax.lax.all_gather`` on the s8 payload
+    inside ``shard_map`` — a mere sharding constraint lets the partitioner
+    hoist the int8→fp convert ahead of the gather and put fp32 on the wire
+    (observed; the same reason qgZ routes through shard_map).
+
+    Straight-through: the vjp is identity (grad flows to the master shard as
+    if the cast were exact) — the partitioner still emits the exact
+    reduce-scatter for the gradient.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    axis_name = gather_axes if len(gather_axes) > 1 else gather_axes[0]
+    # the scale is size-1 on every dim but ``dim``: only that entry survives
+    scale_spec = P(*[entry if i == dim else None for i, entry in enumerate(tuple(spec))])
+    scale_gathered = P(*[entry if i == dim else None
+                         for i, entry in enumerate(tuple(gathered_spec))])
+
+    def gather_block(q_blk, s_blk):
+        q_full = jax.lax.all_gather(q_blk, axis_name, axis=dim, tiled=True)
+        s_full = jax.lax.all_gather(s_blk, axis_name, axis=dim, tiled=True)
+        return q_full, s_full
+
+    gather_sm = jax.shard_map(gather_block, mesh=mesh, in_specs=(spec, scale_spec),
+                              out_specs=(gathered_spec, scale_gathered),
+                              check_vma=False)
+
+    @jax.custom_vjp
+    def qgather(w):
+        # per-row symmetric int8 along the ZeRO-sharded dim: the scale reduces
+        # every OTHER dim, so it is elementwise w.r.t. the sharding — no
+        # communication before the gather
+        red = tuple(i for i in range(w.ndim) if i != dim)
+        scale = jnp.max(jnp.abs(w), axis=red, keepdims=True) / 127.0
+        scale = jnp.maximum(scale, 1e-12)
+        q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+        q, scale = gather_sm(q, scale)
+        return (q.astype(jnp.float32) * scale).astype(compute_dtype)
+
+    def fwd(w):
+        # 0-d residual carries the master dtype (a bare dtype is not a pytree leaf)
+        return qgather(w), jnp.zeros((), w.dtype)
+
+    def bwd(res, g):
+        # restore the master dtype: the incoming cotangent arrives in
+        # compute dtype (bf16), and the optimizer accumulates in fp32
+        return (g.astype(res.dtype), )
+
+    qgather.defvjp(fwd, bwd)
+    return qgather
+
+
+def make_qwz_cast(param_shardings, mesh, compute_dtype, zero_axes=None,
+                  threshold: int = 2048):
+    """Build the qwZ master→compute cast for the engine's parameter tree.
+
+    Leaves that are floating, ndim>=2, >= ``threshold`` elements AND actually
+    ZeRO-sharded take the quantized gather; everything else (norm scales,
+    biases, small or replicated params) casts exactly.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    zero_axes = tuple(zero_axes) if zero_axes is not None else groups.get_zero_partition_axes()
+    zero_axes = tuple(ax for ax in zero_axes if mesh.shape.get(ax, 1) > 1)
+
+    def leaf_cast_factory(sharding):
+        spec = getattr(sharding, "spec", None)
+        dim = _sharded_dim(spec, zero_axes) if spec is not None else None
+        if dim is None:
+            return None
+        entry = tuple(spec)[dim]
+        gather_axes = tuple(ax for ax in (entry if isinstance(entry, tuple) else (entry, ))
+                            if ax in set(zero_axes))
+        return _make_quantized_gather(dim, spec, _gathered_spec(spec, zero_axes),
+                                      gather_axes, mesh, compute_dtype)
+
+    def cast(params):
+        def one(w, sharding):
+            if not hasattr(w, "dtype") or not jnp.issubdtype(w.dtype, jnp.floating):
+                return w  # match cast_tree: non-floating leaves pass through
+            if w.ndim < 2 or int(np.prod(w.shape)) < threshold:
+                return w.astype(compute_dtype)
+            fn = leaf_cast_factory(sharding)
+            if fn is None:
+                return w.astype(compute_dtype)
+            return fn(w)
+
+        return jax.tree.map(one, params, param_shardings)
+
+    return cast

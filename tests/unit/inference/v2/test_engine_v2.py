@@ -151,3 +151,63 @@ def test_serialize_roundtrip(llama_setup, tmp_path):
     data = np.load(tmp_path / "params_rank0.npz")
     flat = jax.tree.leaves(params)
     assert len(data.files) == len(flat)
+
+
+def test_decode_loop_matches_host_loop(llama_setup):
+    """Device-side scan decode (engine.decode_loop) generates EXACTLY the same
+    greedy tokens as the host loop of put()+argmax, and leaves the sequence
+    state (seen_tokens, blocks) identical."""
+    cfg, model, params = llama_setup
+    rng = np.random.default_rng(7)
+    prompts = {0: rng.integers(0, cfg.vocab_size, 23), 1: rng.integers(0, cfg.vocab_size, 9)}
+    N = 6
+
+    # host loop
+    eng_a = build_engine(params, cfg, _engine_config())
+    logits = np.asarray(eng_a.put(list(prompts), list(prompts.values())))
+    cur = np.argmax(logits, -1).astype(np.int32)
+    host_tokens = []
+    for _ in range(N):
+        logits = np.asarray(eng_a.put(list(prompts), [np.array([c]) for c in cur]))
+        cur = np.argmax(logits, -1).astype(np.int32)
+        host_tokens.append(cur)
+    host_tokens = np.stack(host_tokens, axis=1)  # [n_seqs, N]
+
+    # device loop
+    eng_b = build_engine(params, cfg, _engine_config())
+    logits = np.asarray(eng_b.put(list(prompts), list(prompts.values())))
+    first = np.argmax(logits, -1).astype(np.int32)
+    dev_tokens = eng_b.decode_loop(list(prompts), [np.array([c]) for c in first], N)
+    assert dev_tokens.shape == (2, N)
+    np.testing.assert_array_equal(dev_tokens, host_tokens)
+
+    for uid in prompts:
+        sa = eng_a._state_manager.get_sequence(uid)
+        sb = eng_b._state_manager.get_sequence(uid)
+        assert sa.seen_tokens == sb.seen_tokens
+        assert sa.cur_allocated_blocks == sb.cur_allocated_blocks
+
+
+def test_decode_loop_validation(llama_setup):
+    cfg, model, params = llama_setup
+    engine = build_engine(params, cfg, _engine_config())
+    engine.put([0], [np.arange(5) % cfg.vocab_size])
+    with pytest.raises(ValueError, match="one next-input token"):
+        engine.decode_loop([0], [np.array([1, 2])], 4)
+    with pytest.raises(ValueError, match="n_steps"):
+        engine.decode_loop([0], [np.array([1])], 0)
+    # block-budget check: n_steps beyond free blocks must be rejected up front
+    with pytest.raises(SchedulingError):
+        engine.decode_loop([0], [np.array([1])], 10_000)
+
+
+def test_decode_loop_token_budget_is_per_step(llama_setup):
+    """Admission: n_steps counts against the KV-block budget, NOT the ragged
+    token budget — each scan step carries one token per sequence (regression:
+    n_seqs*n_steps was charged against max_ragged_batch_size)."""
+    cfg, model, params = llama_setup
+    engine = build_engine(params, cfg, _engine_config(max_ragged_batch_size=64))
+    prompt = np.arange(40) % cfg.vocab_size  # fits the 64-token ragged budget
+    first = int(np.argmax(np.asarray(engine.put([0], [prompt]))[0]))
+    toks = engine.decode_loop([0], [np.array([first])], 70)  # 70 > 64 and KV fits
+    assert toks.shape == (1, 70)

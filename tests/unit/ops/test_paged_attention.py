@@ -148,3 +148,34 @@ def test_engine_kernel_vs_dense_path():
         outs[kernel] = logits
     for a, b in zip(outs[False], outs[True]):
         np.testing.assert_allclose(a, b, rtol=3e-5, atol=3e-5)
+
+
+def test_decode_loop_kernel_vs_gather_path():
+    """engine.decode_loop (the on-device scan) must generate identical greedy
+    tokens whichever attention implementation runs inside the scan."""
+    from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_factory import build_engine
+    from deepspeed_tpu.inference.v2.ragged.manager_configs import (AllocationMode,
+                                                                   DSStateManagerConfig,
+                                                                   MemoryConfig)
+    from deepspeed_tpu.models.llama import LlamaConfig, init_params
+    from deepspeed_tpu.utils import groups
+
+    groups.initialize_mesh(force=True)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    _, params = init_params(cfg)
+
+    def ecfg(kernel):
+        mgr = DSStateManagerConfig(memory_config=MemoryConfig(mode=AllocationMode.ALLOCATE,
+                                                              size=64), max_context=512)
+        return RaggedInferenceEngineConfig(state_manager=mgr, kv_block_size=16,
+                                           use_paged_kernel=kernel)
+
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, cfg.vocab_size, 19)
+    toks = {}
+    for kernel in (False, True):
+        eng = build_engine(params, cfg, ecfg(kernel))
+        first = int(np.argmax(np.asarray(eng.put([0], [prompt]))[0]))
+        toks[kernel] = eng.decode_loop([0], [np.asarray([first])], 4)
+    np.testing.assert_array_equal(toks[False], toks[True])

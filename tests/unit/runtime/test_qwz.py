@@ -1,0 +1,128 @@
+"""qwZ weight-gather wiring: `zero_quantized_weights` must put int8 on the
+ZeRO-3 parameter all-gather wire (reference ZeRO++,
+partition_parameters.py:1152 all_gather_coalesced quantized path +
+CUDAQuantizer:731).
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.utils import groups
+
+from ..simple_model import make_simple_model, random_batches
+
+HIDDEN = 64
+
+
+def _cfg(qwz, stage=3, gas=1):
+    return {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "AdamW", "params": {"lr": 0.01, "weight_decay": 0.0}},
+        "zero_optimization": {"stage": stage, "zero_quantized_weights": bool(qwz),
+                              "stage3_param_persistence_threshold": 0},
+    }
+
+
+def test_qwz_hlo_has_int8_all_gather():
+    """The compiled gradient program must all-gather an s8 payload — wire
+    compression for real, not a numerics-only decoration."""
+    import jax
+    import jax.numpy as jnp
+
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                            config=_cfg(qwz=True))
+    assert eng._qwz
+    batch = eng.shard_batch(random_batches(1, 16, HIDDEN)[0])
+    hlo = eng._grad_fn().lower(eng.params, batch, jax.random.PRNGKey(0),
+                               jnp.float32(1.0)).compile().as_text()
+    assert "all-gather" in hlo
+    import re
+    assert re.search(r"s8\[[\d,]*\][^=]* all-gather", hlo), \
+        "the all-gather payload must be int8 on the wire"
+
+
+def test_qwz_trains_close_to_exact():
+    """int8-gathered weights track the exact run closely on a smooth problem —
+    and are NOT bit-identical (the quantizer really ran)."""
+    import jax
+
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+    batches = random_batches(4, 16, HIDDEN)
+
+    losses = {}
+    params = {}
+    for qwz in (False, True):
+        groups.initialize_mesh(force=True)
+        eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                                config=_cfg(qwz=qwz))
+        ls = [float(eng.train_batch(batch=b)) for b in batches]
+        losses[qwz] = ls
+        params[qwz] = jax.tree.leaves(jax.device_get(eng.params))
+
+    # same trajectory within quantization tolerance
+    np.testing.assert_allclose(losses[True], losses[False], rtol=0.05)
+    for a, b in zip(params[True], params[False]):
+        np.testing.assert_allclose(a, b, atol=0.05)
+    assert any(not np.array_equal(a, b) for a, b in zip(params[True], params[False])), \
+        "bit-identical params mean the quantizer never ran"
+
+
+def test_qwz_requires_stage3():
+    """A config knob that cannot be honored must raise, not be swallowed."""
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+    with pytest.raises(ValueError, match="requires ZeRO stage 3"):
+        deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                 config=_cfg(qwz=True, stage=2))
+
+
+def test_qwz_nontrainable_knob_rejected():
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+    cfg = _cfg(qwz=True)
+    cfg["zero_optimization"]["zero_quantized_nontrainable_weights"] = True
+    with pytest.raises(NotImplementedError, match="nontrainable"):
+        deepspeed_tpu.initialize(model=model, model_parameters=params0, config=cfg)
+
+
+def test_qwz_small_and_replicated_leaves_cast_exactly():
+    """Leaves under the threshold (or not ZeRO-sharded) keep the exact cast:
+    the eval loss with qwZ on equals the fp eval loss when every leaf is
+    below the quantization threshold."""
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=8, batch_size=16)  # all tiny leaves
+    batches = random_batches(1, 16, 8)
+    outs = {}
+    for qwz in (False, True):
+        groups.initialize_mesh(force=True)
+        cfg = _cfg(qwz=qwz)
+        cfg["train_micro_batch_size_per_gpu"] = 16
+        eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                                config=cfg)
+        eng.eval()
+        outs[qwz] = float(eng.forward(batches[0]))
+    assert outs[True] == outs[False]
+
+
+def test_qwz_bf16_grads_keep_master_dtype():
+    """Straight-through vjp must hand back MASTER-dtype cotangents: with bf16
+    compute the gradient of an fp32 master weight stays fp32 (regression:
+    bwd returned the bf16 cotangent unchanged)."""
+    import jax
+    import jax.numpy as jnp
+
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+    cfg = _cfg(qwz=True)
+    cfg["bf16"] = {"enabled": True}
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                            config=cfg)
+    loss = eng.forward(random_batches(1, 16, HIDDEN)[0])
+    eng.backward(loss)
+    for g in jax.tree.leaves(eng.acc_grads):
+        assert g.dtype == jnp.float32, g.dtype
