@@ -192,6 +192,132 @@ def _bench_inference(llama, groups, jnp):
     return out
 
 
+def _bench_sparse_attention(jnp):
+    """Block-sparse attention leg (VERDICT r4 #4): 8k sequence — where dense
+    S² scores OOM on 16 GB — BigBird layouts at two densities; fwd+bwd time
+    must scale with layout density. Timing: chained on-device scans, two-point
+    differenced, with a host value fetch as the barrier."""
+    import time
+    import jax
+    from deepspeed_tpu.ops.pallas.block_sparse_attention import block_sparse_attention
+    from deepspeed_tpu.ops.sparse_attention.sparsity_config import BigBirdSparsityConfig
+
+    B, H, S, D, LB = 1, 16, 8192, 128, 64
+    N1, N2 = 2, 10
+    mk = lambda s: jax.jit(lambda k: jax.random.normal(k, (B, H, S, D), jnp.bfloat16))(
+        jax.random.PRNGKey(s))
+    q, k, v = mk(1), mk(2), mk(3)
+
+    def leg(nrand, nwin):
+        layout = BigBirdSparsityConfig(num_heads=H, block=LB, num_random_blocks=nrand,
+                                       num_sliding_window_blocks=nwin,
+                                       num_global_blocks=1).make_layout(S)
+
+        def loss(q, k, v):
+            return (block_sparse_attention(q, k, v, layout, LB).astype(jnp.float32) ** 2).mean()
+
+        def make(n):
+            @jax.jit
+            def scan_fn(q, k, v):
+                def body(x, _):
+                    l, gq = jax.value_and_grad(loss)(x, k, v)
+                    return x + gq.astype(x.dtype) * 1e-4, l
+                return jax.lax.scan(body, q, None, length=n)
+            return scan_fn
+
+        f1, f2 = make(N1), make(N2)
+        x, ls = f1(q, k, v)
+        float(ls[-1])
+        x, ls = f2(x, k, v)
+        float(ls[-1])
+
+        def t(f):
+            nonlocal x
+            best = 1e9
+            for _ in range(3):
+                t0 = time.perf_counter()
+                x, ls = f(x, k, v)
+                float(ls[-1])  # host fetch = true barrier
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        ms = (t(f2) - t(f1)) / (N2 - N1) * 1e3
+        return {"density": round(float(layout.mean()), 4), "fwd_bwd_ms": round(ms, 2)}
+
+    lo = leg(1, 3)
+    hi = leg(4, 9)
+    return {"seq": S, "layout": "bigbird", "low": lo, "high": hi,
+            "time_ratio": round(hi["fwd_bwd_ms"] / max(lo["fwd_bwd_ms"], 1e-9), 2),
+            "density_ratio": round(hi["density"] / lo["density"], 2)}
+
+
+def _bench_evoformer(jnp, peak):
+    """Evoformer attention at MSA-realistic shapes (VERDICT r4 #10): fwd+bwd
+    time and achieved FLOP/s for the XLA-fused einsum formulation, with and
+    without remat — the measured justification for not hand-writing the
+    reference's 15k-LoC CUTLASS tier. Two-point differenced scans, host-fetch
+    barrier."""
+    import time
+    import jax
+    from deepspeed_tpu.ops.evoformer import DS4Sci_EvoformerAttention
+
+    B, N, S, H, D = 1, 128, 256, 4, 32  # MSA row-attention shape (AF2)
+    key = jax.random.PRNGKey(0)
+    mk = lambda i, shape: jax.random.normal(jax.random.fold_in(key, i), shape, jnp.bfloat16)
+    q = mk(0, (B, N, S, H, D))
+    k = mk(1, (B, N, S, H, D))
+    v = mk(2, (B, N, S, H, D))
+    b1 = mk(3, (B, N, 1, 1, S))
+    b2 = mk(4, (B, 1, H, S, S))
+
+    def one(remat):
+        attn = DS4Sci_EvoformerAttention
+        if remat:
+            attn = jax.checkpoint(lambda *a: DS4Sci_EvoformerAttention(a[0], a[1], a[2],
+                                                                       biases=[a[3], a[4]]))
+            loss0 = lambda q: (attn(q, k, v, b1, b2).astype(jnp.float32) ** 2).mean()
+        else:
+            loss0 = lambda q: (attn(q, k, v, biases=[b1, b2]).astype(jnp.float32) ** 2).mean()
+
+        def make(n):
+            @jax.jit
+            def f(q):
+                def body(x, _):
+                    l, g = jax.value_and_grad(loss0)(x)
+                    return x + g.astype(x.dtype) * 1e-4, l
+                return jax.lax.scan(body, q, None, length=n)
+            return f
+
+        f1, f2 = make(2), make(10)
+        x, ls = f1(q)
+        float(ls[-1])
+        x, ls = f2(x)
+        float(ls[-1])
+
+        def t(f, x):
+            best = 1e9
+            for _ in range(3):
+                t0 = time.perf_counter()
+                x, ls = f(x)
+                float(ls[-1])
+                best = min(best, time.perf_counter() - t0)
+            return best, x
+
+        ta, x = t(f1, x)
+        tb, x = t(f2, x)
+        return (tb - ta) / 8
+
+    plain = one(False)
+    remat = one(True)
+    # fwd 4*N*H*S^2*D mults-adds *2, bwd ~2.5x
+    flops = 2 * 4 * B * N * H * S * S * D * 3.5
+    return {"shape": f"B{B} N{N} S{S} H{H} D{D}", "fwd_bwd_ms": round(plain * 1e3, 2),
+            "achieved_tflops": round(flops / plain / 1e12, 1),
+            "peak_fraction": round(flops / plain / peak, 3),
+            "remat_fwd_bwd_ms": round(remat * 1e3, 2),
+            "remat_time_ratio": round(remat / max(plain, 1e-12), 2)}
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -281,6 +407,14 @@ def main():
             extra["inference"] = _bench_inference(llama, groups, jnp)
         except Exception as e:
             extra["inference"] = {"error": str(e)[:200]}
+        try:
+            extra["sparse_attention"] = _bench_sparse_attention(jnp)
+        except Exception as e:
+            extra["sparse_attention"] = {"error": str(e)[:200]}
+        try:
+            extra["evoformer"] = _bench_evoformer(jnp, _peak_flops())
+        except Exception as e:
+            extra["evoformer"] = {"error": str(e)[:200]}
 
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",

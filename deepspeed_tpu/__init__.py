@@ -124,8 +124,15 @@ def default_inference_config():
     return DeepSpeedInferenceConfig().model_dump()
 
 
-def init_inference(model, config=None, **kwargs):
-    """Reference deepspeed/__init__.py:263."""
+def init_inference(model=None, config=None, checkpoint=None, **kwargs):
+    """Reference deepspeed/__init__.py:263.
+
+    ``model`` may be a flax module / {"module","params"} dict, OR a HuggingFace
+    checkpoint directory path (equivalently pass ``checkpoint=...``): the
+    injection-policy registry (module_inject/containers.py — the reference's
+    containers/ + replace_module tier) detects the architecture from
+    config.json, builds the native model and converts the weights.
+    """
     from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
     from deepspeed_tpu.inference.engine import InferenceEngine
 
@@ -134,4 +141,24 @@ def init_inference(model, config=None, **kwargs):
         config = DeepSpeedInferenceConfig(**{**config, **kwargs})
     elif config is None:
         config = DeepSpeedInferenceConfig(**kwargs)
+    if checkpoint is None and isinstance(model, str):
+        checkpoint = model
+        model = None
+    if model is None and checkpoint is None:
+        raise ValueError("init_inference requires a model or a checkpoint directory")
+    if model is not None and checkpoint is not None:
+        raise ValueError("pass model OR checkpoint, not both — the checkpoint path "
+                         "builds its own module and would silently ignore the model")
+    if checkpoint is not None:
+        import os
+        if not (isinstance(checkpoint, str) and os.path.isdir(checkpoint)):
+            raise ValueError(f"checkpoint must be a HF checkpoint directory, got {checkpoint!r}")
+        from deepspeed_tpu.module_inject.containers import load_hf_checkpoint
+        module, params, _cfg = load_hf_checkpoint(checkpoint)
+        param_specs = None
+        if config.tensor_parallel.tp_size > 1:
+            from deepspeed_tpu.module_inject.auto_tp import auto_tp_specs
+            param_specs = auto_tp_specs(params)
+        return InferenceEngine({"module": module, "params": params}, config=config,
+                               param_specs=param_specs)
     return InferenceEngine(model, config=config)

@@ -28,6 +28,14 @@ DEFAULT_SPACE = {
     "train_micro_batch_size_per_gpu": [1, 2, 4, 8],
 }
 
+# the model-based tuner searches the reference's wider knob set
+DEFAULT_MODEL_BASED_SPACE = {
+    "zero_optimization.stage": [0, 1, 2, 3],
+    "train_micro_batch_size_per_gpu": [1, 2, 4, 8],
+    "gradient_accumulation_steps": [1, 2, 4],
+    "zero_optimization.offload_optimizer.device": ["none", "cpu"],
+}
+
 
 def _set_nested(cfg: dict, dotted: str, value):
     node = cfg
@@ -97,7 +105,10 @@ class Autotuner:
             return None
 
     def tune(self) -> dict:
-        """Reference Autotuner.tune():404 — run the space, keep the fastest."""
+        """Reference Autotuner.tune():404 — run the space, keep the fastest.
+        ``tuner_type`` model_based routes through the cost-model search."""
+        if self.tuner_type == "model_based":
+            return self.tune_model_based()
         best = None
         for overrides in self._candidates():
             tput = self._run_experiment(overrides)
@@ -107,6 +118,9 @@ class Autotuner:
             logger.info(f"autotuning: {rec}")
             if tput is not None and (best is None or tput > best[1]):
                 best = (overrides, tput)
+        return self._write_results(best)
+
+    def _write_results(self, best) -> dict:
         os.makedirs(self.results_dir, exist_ok=True)
         summary = {"experiments": self.results,
                    "best": None if best is None else
@@ -116,3 +130,72 @@ class Autotuner:
         if best is None:
             raise RuntimeError("autotuning: every experiment failed")
         return summary["best"]
+
+    # --------------------------------------------------------- model-based --
+    def _profile(self) -> dict:
+        """One static profile pass (reference model_info_path role): parameter
+        count + ZeRO degree + device HBM feed the analytic cost model."""
+        import jax
+        from deepspeed_tpu.autotuning.cost_model import device_memory_bytes
+        from deepspeed_tpu.utils import groups
+
+        if self.model_parameters is not None:
+            n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(self.model_parameters))
+        else:
+            n_params = 0
+        zero_degree = 1
+        if groups.mesh_is_initialized():
+            mesh = groups.get_mesh()
+            zero_degree = int(np.prod([mesh.shape[ax] for ax in groups.get_zero_partition_axes()
+                                       if ax in mesh.shape]))
+        return {"n_params": n_params, "zero_degree": max(1, zero_degree),
+                "hbm_bytes": device_memory_bytes()}
+
+    def tune_model_based(self) -> dict:
+        """Cost-model-guided search (reference tuner/model_based_tuner.py +
+        cost_model.py): the analytic prior prunes OOM configs and orders the
+        rest; after each measurement a ridge regression re-ranks the remaining
+        candidates; stops at ``max_experiments`` or when the regressor predicts
+        no remaining candidate beats the best measured. results.json records
+        the estimate next to every measurement."""
+        from deepspeed_tpu.autotuning.cost_model import AnalyticCostModel, LearnedCostModel
+
+        space = self.space if self.space is not DEFAULT_SPACE else DEFAULT_MODEL_BASED_SPACE
+        keys = list(space.keys())
+        candidates = [dict(zip(keys, c)) for c in itertools.product(*(space[k] for k in keys))]
+
+        prof = self._profile()
+        prior = AnalyticCostModel(prof["n_params"], prof["zero_degree"], prof["hbm_bytes"])
+        pruned = [c for c in candidates if not prior.fits(c)]
+        candidates = [c for c in candidates if prior.fits(c)]
+        for c in pruned:
+            self.results.append({"config": c, "pruned": "predicted OOM",
+                                 "predicted_bytes": int(prior.memory_bytes(c))})
+        candidates.sort(key=prior.throughput_prior, reverse=True)
+
+        learned = LearnedCostModel()
+        best = None
+        measured = 0
+        while candidates and measured < self.max_experiments:
+            if learned.trained:
+                candidates.sort(key=learned.predict, reverse=True)
+                # convergence: nothing left is predicted to beat the best
+                if best is not None and learned.predict(candidates[0]) <= best[1]:
+                    logger.info("autotuning(model_based): converged — no remaining "
+                                "candidate predicted to beat the best measured")
+                    break
+            overrides = candidates.pop(0)
+            predicted = learned.predict(overrides) if learned.trained else None
+            tput = self._run_experiment(overrides)
+            measured += 1
+            rec = {"config": overrides,
+                   "predicted_samples_per_sec": None if predicted is None else round(predicted, 2),
+                   "prior_rank_score": round(prior.throughput_prior(overrides), 4),
+                   "throughput_samples_per_sec": None if tput is None else round(tput, 2)}
+            self.results.append(rec)
+            logger.info(f"autotuning(model_based): {rec}")
+            if tput is not None:
+                learned.observe(overrides, tput)
+                if best is None or tput > best[1]:
+                    best = (overrides, tput)
+        return self._write_results(best)

@@ -120,9 +120,13 @@ class InferenceEngineV2:
         batch_tokens = [np.atleast_1d(np.asarray(t)) for t in batch_tokens]
 
         if do_checks:
+            # BEFORE restoring: can_schedule counts offloaded sequences'
+            # restore cost, so admission failure is a SchedulingError here,
+            # never a raw allocator error mid-restore
             schedule_check = self.can_schedule(batch_uids, [t.size for t in batch_tokens])
             if schedule_check != SchedulingResult.Success:
                 raise SchedulingError(schedule_check)
+        self._restore_offloaded(batch_uids)
 
         self._batch.clear()
         if self._tracer:
@@ -178,11 +182,13 @@ class InferenceEngineV2:
                 seq_desc = self._state_manager.get_sequence(uid)
                 if seq_desc is None:
                     seq_desc = PlaceholderSequenceDescriptor()
+                restore = self._restore_cost(uid, seq_desc)
                 sched_len, sched_blocks = self._model.get_kv_requirements(
-                    seq_desc, n_steps, free_blocks)
+                    seq_desc, n_steps, free_blocks - restore)
                 if sched_len != n_steps:
                     raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
-                free_blocks -= sched_blocks
+                free_blocks -= sched_blocks + restore
+        self._restore_offloaded(batch_uids)
 
         self._batch.clear()
         for uid, tokens in zip(batch_uids, batch_tokens):
@@ -212,7 +218,16 @@ class InferenceEngineV2:
             if self._state_manager.n_tracked_sequences >= self._config.state_manager.max_tracked_sequences:
                 return (0, 0)
             seq_desc = PlaceholderSequenceDescriptor()
-        return self._model.get_kv_requirements(seq_desc, max_request_tokens, max_request_blocks)
+        restore = self._restore_cost(uid, seq_desc)
+        toks, blocks = self._model.get_kv_requirements(
+            seq_desc, max_request_tokens, max_request_blocks - restore)
+        return toks, blocks + restore
+
+    def _restore_cost(self, uid, seq_desc) -> int:
+        """Device blocks a touch of ``uid`` must re-allocate first: an
+        offloaded sequence's stale descriptor still reports its (freed)
+        blocks as resident."""
+        return seq_desc.cur_allocated_blocks if self._state_manager.is_offloaded(uid) else 0
 
     def can_schedule(self, uids: Iterable[int], lengths: Iterable[int]) -> SchedulingResult:
         uids, lengths = list(uids), list(lengths)
@@ -228,11 +243,13 @@ class InferenceEngineV2:
             if seq_desc is None:
                 cur_seqs += 1
                 seq_desc = PlaceholderSequenceDescriptor()
-            sched_len, sched_blocks = self._model.get_kv_requirements(seq_desc, length, free_blocks)
+            restore = self._restore_cost(uid, seq_desc)
+            sched_len, sched_blocks = self._model.get_kv_requirements(
+                seq_desc, length, free_blocks - restore)
             if sched_len != length:
                 return SchedulingResult.KVCacheLimitExceeded
             batch_len += length
-            free_blocks -= sched_blocks
+            free_blocks -= sched_blocks + restore
 
         if cur_seqs > self._config.state_manager.max_tracked_sequences:
             return SchedulingResult.EngineSequenceLimitExceeded
@@ -248,6 +265,25 @@ class InferenceEngineV2:
 
     def flush(self, uid: int) -> None:
         self._state_manager.flush_sequence(uid)
+
+    # ------------------------------------------------------------- kv offload --
+    def _restore_offloaded(self, batch_uids) -> None:
+        """Touching an offloaded sequence restores it first (ZeRO-Inference
+        KV-offload choreography; see ragged_manager.offload_sequence)."""
+        for uid in batch_uids:
+            if self._state_manager.is_offloaded(uid):
+                self._state_manager.restore_sequence(uid)
+
+    def offload_sequence(self, uid: int) -> None:
+        """Evict a cold sequence's KV blocks to the host (or NVMe, when
+        ``state_manager.offload_path`` is set), freeing device blocks for
+        other sequences. The next put/decode_loop touching ``uid`` restores
+        it transparently. Reference role: ``kv_cache.py:166`` offload +
+        the ZeRO-Inference KV-offload leg (BASELINE.md)."""
+        self._state_manager.offload_sequence(uid)
+
+    def is_offloaded(self, uid: int) -> bool:
+        return self._state_manager.is_offloaded(uid)
 
     def flush_all(self) -> None:
         """Recycle every tracked sequence's KV blocks (hybrid-engine post-

@@ -104,6 +104,11 @@ class DSTransformerModelBase:
         blocks, and how many blocks that takes (reference
         inference_transformer_base.py get_kv_requirements)."""
         bs = self._state_manager.kv_block_size
+        # the per-sequence table cap (max_context) bounds schedulable tokens
+        # too: admission must reject here, not crash in extend_kv_cache after
+        # blocks were already pulled from the pool
+        seq_cap = seq_desc.max_blocks - seq_desc.cur_allocated_blocks
+        max_new_blocks = min(max_new_blocks, seq_cap)
         total = seq_desc.seen_tokens + max_new_tokens
         blocks_needed = (total + bs - 1) // bs - seq_desc.cur_allocated_blocks
         if blocks_needed <= max_new_blocks:
@@ -117,7 +122,17 @@ class DSTransformerModelBase:
         return seq_desc.cur_allocated_blocks * bs - seq_desc.seen_tokens
 
     def maybe_allocate_kv(self, seq_desc: DSSequenceDescriptor, n_new_tokens: int) -> None:
-        _, n_blocks = self.get_kv_requirements(seq_desc, n_new_tokens, self._state_manager.free_blocks)
+        sched, n_blocks = self.get_kv_requirements(seq_desc, n_new_tokens,
+                                                   self._state_manager.free_blocks)
+        if sched < n_new_tokens:
+            # the do_checks=True path rejects this earlier with a
+            # SchedulingError; an unchecked put must fail LOUDLY — silently
+            # under-allocating would scatter KV through out-of-range block-
+            # table entries and corrupt other sequences
+            raise ValueError(
+                f"sequence {seq_desc.tracking_id}: {n_new_tokens} new tokens need more "
+                f"KV blocks than the free pool / per-sequence max_context allows "
+                f"(schedulable: {sched})")
         if n_blocks > 0:
             seq_desc.extend_kv_cache(self._state_manager.allocate_blocks(n_blocks))
 

@@ -5,7 +5,7 @@ DSStateManagerConfig, AllocationMode).
 """
 
 from enum import Enum
-from typing import Tuple
+from typing import Optional, Tuple
 
 from pydantic import Field
 
@@ -37,3 +37,6 @@ class DSStateManagerConfig(DeepSpeedConfigModel):
     max_context: int = Field(8192, gt=0)
     memory_config: MemoryConfig = MemoryConfig()
     offload: bool = Field(False)
+    # spill offloaded KV blocks to files under this dir (NVMe tier, via the
+    # native AIO engine) instead of holding them in host memory
+    offload_path: Optional[str] = None

@@ -18,8 +18,10 @@ class DSStateManager:
         self._config = config
         self._kv_config = kv_config
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
+        self._offloaded: Dict[int, int] = {}  # uid -> host-pool handle
         self._kv_cache = BlockedKVCache(kv_config, config.memory_config, mp_group=mp_group,
-                                        offload=config.offload)
+                                        offload=config.offload,
+                                        offload_path=config.offload_path)
 
     # ------------------------------------------------------------- sequences --
     def get_sequence(self, uid: int) -> Optional[DSSequenceDescriptor]:
@@ -47,8 +49,44 @@ class DSStateManager:
         if seq is None:
             logger.warning(f"flush_sequence: unknown uid {uid}")
             return
-        if seq.cur_allocated_blocks > 0:
+        handle = self._offloaded.pop(uid, None)
+        if handle is not None:
+            self._kv_cache.drop_offloaded(handle)
+        elif seq.cur_allocated_blocks > 0:
             self._kv_cache.free(seq.kv_blocks)
+
+    # ----------------------------------------------------------- kv offload --
+    def is_offloaded(self, uid: int) -> bool:
+        return uid in self._offloaded
+
+    def offload_sequence(self, uid: int) -> None:
+        """Evict a (cold) sequence's KV blocks to the host tier, freeing its
+        device blocks for other sequences. The sequence stays tracked; the
+        next forward that touches it restores it (engine put/decode_loop)."""
+        seq = self._seqs.get(uid)
+        if seq is None:
+            raise ValueError(f"offload_sequence: unknown uid {uid}")
+        if uid in self._offloaded:
+            return
+        if seq.in_flight_tokens:
+            raise RuntimeError(f"offload_sequence: uid {uid} has in-flight tokens")
+        if seq.cur_allocated_blocks == 0:
+            return
+        self._offloaded[uid] = self._kv_cache.offload(seq.kv_blocks)
+
+    def restore_sequence(self, uid: int) -> None:
+        """Bring an offloaded sequence's KV back into fresh device blocks and
+        rewrite its block table. Raises if the device pool cannot hold it
+        (offload other sequences first)."""
+        handle = self._offloaded.pop(uid, None)
+        if handle is None:
+            return
+        try:
+            new_blocks = self._kv_cache.restore(handle)
+        except Exception:
+            self._offloaded[uid] = handle  # payload intact; caller may evict + retry
+            raise
+        self._seqs[uid].replace_kv_blocks(new_blocks)
 
     @property
     def tracked_sequences(self) -> Dict[int, DSSequenceDescriptor]:

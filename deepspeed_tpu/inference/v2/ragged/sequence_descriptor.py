@@ -31,6 +31,10 @@ class DSSequenceDescriptor:
         return len(self._kv_blocks)
 
     @property
+    def max_blocks(self) -> int:
+        return self._max_blocks
+
+    @property
     def kv_blocks(self) -> np.ndarray:
         return np.asarray(self._kv_blocks, dtype=np.int64)
 
@@ -42,6 +46,15 @@ class DSSequenceDescriptor:
         if len(self._kv_blocks) + len(new_blocks) > self._max_blocks:
             raise ValueError(f"Sequence {self.tracking_id} exceeds max blocks {self._max_blocks}")
         self._kv_blocks.extend(int(b) for b in new_blocks)
+
+    def replace_kv_blocks(self, new_blocks) -> None:
+        """Swap the whole block table for fresh ids (KV offload→restore hands
+        back different device blocks; token order is preserved)."""
+        new_blocks = np.atleast_1d(np.asarray(new_blocks)).tolist()
+        if len(new_blocks) != len(self._kv_blocks):
+            raise ValueError(f"restore returned {len(new_blocks)} blocks for a "
+                             f"{len(self._kv_blocks)}-block sequence")
+        self._kv_blocks = [int(b) for b in new_blocks]
 
     def pre_forward(self, num_tokens: int) -> None:
         """Reference: mark tokens as in-flight before the forward."""

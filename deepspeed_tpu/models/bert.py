@@ -1,0 +1,109 @@
+"""BERT encoder (bidirectional) for the v1 injection-container family.
+
+Reference exercises BERT through ``deepspeed/module_inject/containers/bert.py``
+(HFBertLayerPolicy); here it is a native flax encoder whose parameter layout
+the container policy (``module_inject/containers.py``) maps HF checkpoints
+into. Faithful to ``transformers.BertModel``: post-LN residuals, exact-erf
+gelu, eps 1e-12, learned absolute positions + token-type embeddings, tanh
+pooler over [CLS].
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: any = jnp.float32
+
+    @classmethod
+    def tiny(cls, **kw):
+        base = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    max_position_embeddings=64)
+        base.update(kw)
+        return cls(**base)
+
+
+class BertSelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask):
+        cfg = self.cfg
+        H = cfg.num_attention_heads
+        D = cfg.hidden_size // H
+        dense = partial(nn.Dense, dtype=cfg.dtype)
+        q = dense(cfg.hidden_size, name="query")(x).reshape(*x.shape[:-1], H, D)
+        k = dense(cfg.hidden_size, name="key")(x).reshape(*x.shape[:-1], H, D)
+        v = dense(cfg.hidden_size, name="value")(x).reshape(*x.shape[:-1], H, D)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(D)
+        if attention_mask is not None:
+            logits = jnp.where(attention_mask[:, None, None, :] > 0, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return out.reshape(*x.shape[:-1], cfg.hidden_size)
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask):
+        cfg = self.cfg
+        ln = partial(nn.LayerNorm, epsilon=cfg.layer_norm_eps, dtype=cfg.dtype)
+        dense = partial(nn.Dense, dtype=cfg.dtype)
+        attn = BertSelfAttention(cfg, name="attention")(x, attention_mask)
+        attn = dense(cfg.hidden_size, name="attention_output")(attn)
+        x = ln(name="attention_layernorm")(x + attn)          # post-LN
+        h = nn.gelu(dense(cfg.intermediate_size, name="intermediate")(x),
+                    approximate=False)
+        h = dense(cfg.hidden_size, name="output")(h)
+        return ln(name="output_layernorm")(x + h)
+
+
+class BertModel(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     name="word_embeddings")(input_ids)
+        x = x + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, dtype=cfg.dtype,
+                         name="position_embeddings")(jnp.arange(S)[None])
+        x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                         name="token_type_embeddings")(token_type_ids)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="embeddings_layernorm")(x)
+        for i in range(cfg.num_hidden_layers):
+            x = BertLayer(cfg, name=f"layer_{i}")(x, attention_mask)
+        pooled = nn.tanh(nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="pooler")(x[:, 0]))
+        return x, pooled
+
+
+def init_params(cfg: BertConfig, batch_size: int = 2, seq_len: Optional[int] = None,
+                rng=None):
+    model = BertModel(cfg)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    S = seq_len or min(cfg.max_position_embeddings, 16)
+    ids = jnp.zeros((batch_size, S), jnp.int32)
+    return model, model.init(rng, ids)["params"]

@@ -44,10 +44,12 @@ class DecoderConfig:
     rotary_pct: float = 1.0            # fraction of head_dim that rotates (phi)
     pos_embed: str = "rotary"          # "rotary" | "learned"
     learned_pos_offset: int = 0        # OPT's +2
-    parallel_residual: bool = False    # falcon/phi topology
-    activation: str = "gelu"           # "gelu" | "relu"
+    parallel_residual: bool = False    # falcon/phi/neox topology
+    activation: str = "gelu"           # "gelu" | "gelu_exact" | "relu"
     attention_bias: bool = True
     mlp_bias: bool = True
+    embed_layernorm: bool = False      # bloom's word_embeddings_layernorm
+    parallel_mlp_norm: bool = False    # neox: separate norm for the parallel MLP
     model_type: str = "decoder"
     dtype: any = jnp.float32
 
@@ -77,6 +79,26 @@ class DecoderConfig:
         return cls(**base)
 
     @classmethod
+    def gpt_neox(cls, **kw):
+        # HF GPTNeoX: partial rotary (rotary_pct, default 0.25), parallel
+        # residual, exact-erf gelu, biased linears
+        base = dict(pos_embed="rotary", rotary_pct=0.25, parallel_residual=True,
+                    parallel_mlp_norm=True, activation="gelu_exact",
+                    attention_bias=True, mlp_bias=True, model_type="gpt_neox")
+        base.update(kw)
+        return cls(**base)
+
+    @classmethod
+    def bloom(cls, **kw):
+        # HF Bloom: ALiBi (no rotary/learned positions), post-embedding
+        # LayerNorm, tanh-approx gelu, serial residual
+        base = dict(pos_embed="alibi", parallel_residual=False, activation="gelu",
+                    attention_bias=True, mlp_bias=True, embed_layernorm=True,
+                    model_type="bloom")
+        base.update(kw)
+        return cls(**base)
+
+    @classmethod
     def tiny(cls, variant="opt", **kw):
         base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
                     num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
@@ -88,7 +110,22 @@ class DecoderConfig:
 
 
 def _act(cfg):
-    return {"relu": nn.relu, "gelu": partial(nn.gelu, approximate=True)}[cfg.activation]
+    return {"relu": nn.relu, "gelu": partial(nn.gelu, approximate=True),
+            "gelu_exact": partial(nn.gelu, approximate=False)}[cfg.activation]
+
+
+def alibi_slopes(num_heads: int) -> np.ndarray:
+    """ALiBi per-head slopes, matching the HF Bloom construction exactly
+    (``transformers`` ``build_alibi_tensor``) so converted checkpoints are
+    numerically faithful."""
+    closest = 2 ** int(np.floor(np.log2(num_heads)))
+    base = 2.0 ** (-(2.0 ** -(np.log2(closest) - 3)))
+    slopes = base ** np.arange(1, closest + 1)
+    if closest != num_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(np.log2(2 * closest) - 3)))
+        extra = extra_base ** np.arange(1, 2 * (num_heads - closest), 2)
+        slopes = np.concatenate([slopes, extra])
+    return slopes.astype(np.float32)
 
 
 def partial_rotary(x, cos, sin, pct):
@@ -120,6 +157,10 @@ class DecoderAttention(nn.Module):
             v = jnp.repeat(v, H // KVH, axis=2)
         S = x.shape[1]
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(D)
+        if cfg.pos_embed == "alibi":
+            slopes = jnp.asarray(alibi_slopes(H))
+            rel = jnp.arange(S)[None, :] - jnp.arange(S)[:, None]  # k - q (<=0 causal)
+            logits = logits + slopes[None, :, None, None] * rel[None, None].astype(jnp.float32)
         mask = jnp.tril(jnp.ones((S, S), bool))
         logits = jnp.where(mask[None, None], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
@@ -147,8 +188,11 @@ class DecoderBlock(nn.Module):
         ln = partial(nn.LayerNorm, epsilon=cfg.layer_norm_eps, dtype=cfg.dtype)
         if cfg.parallel_residual:
             h = ln(name="input_layernorm")(x)
+            # gpt-neox norms attn and mlp separately even in the parallel
+            # topology; falcon/phi share one norm
+            hm = ln(name="post_attention_layernorm")(x) if cfg.parallel_mlp_norm else h
             return x + DecoderAttention(cfg, name="self_attn")(h, cos, sin, pos_ids) \
-                + DecoderMLP(cfg, name="mlp")(h)
+                + DecoderMLP(cfg, name="mlp")(hm)
         h = ln(name="input_layernorm")(x)
         x = x + DecoderAttention(cfg, name="self_attn")(h, cos, sin, pos_ids)
         h = ln(name="post_attention_layernorm")(x)
@@ -163,6 +207,9 @@ class DecoderModel(nn.Module):
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                      name="embed_tokens")(input_ids)
+        if cfg.embed_layernorm:
+            x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                             name="embed_layernorm")(x)
         S = input_ids.shape[1]
         pos_ids = jnp.arange(S)
         cos = sin = None

@@ -62,24 +62,38 @@ class GPT2Block(nn.Module):
         return x
 
 
+def _gpt2_logits(cfg: GPT2Config, input_ids):
+    """Shared trunk (called inside @nn.compact): every submodule is explicitly
+    named, so GPT2LMHeadModel and GPT2Model expose the SAME parameter tree —
+    one converted checkpoint serves training and inference."""
+    S = input_ids.shape[1]
+    wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype, name="wte")
+    x = wte(input_ids)
+    pos = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype, name="wpe")(jnp.arange(S)[None])
+    x = x + pos
+    block = nn.remat(GPT2Block) if cfg.remat else GPT2Block
+    for i in range(cfg.n_layer):
+        x = block(cfg, name=f"h_{i}")(x)
+    x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_f")(x)
+    return wte.attend(x.astype(jnp.float32))  # tied embeddings
+
+
 class GPT2LMHeadModel(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
     def __call__(self, batch):
         input_ids, labels = batch
-        cfg = self.cfg
-        B, S = input_ids.shape
-        wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype, name="wte")
-        x = wte(input_ids)
-        pos = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype, name="wpe")(jnp.arange(S)[None])
-        x = x + pos
-        block = nn.remat(GPT2Block) if cfg.remat else GPT2Block
-        for i in range(cfg.n_layer):
-            x = block(cfg, name=f"h_{i}")(x)
-        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_f")(x)
-        logits = wte.attend(x.astype(jnp.float32))  # tied embeddings
-        return cross_entropy_loss(logits, labels)
+        return cross_entropy_loss(_gpt2_logits(self.cfg, input_ids), labels)
+
+
+class GPT2Model(nn.Module):
+    """Logits-returning module over the shared trunk."""
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids):
+        return _gpt2_logits(self.cfg, input_ids)
 
 
 def init_params(cfg: GPT2Config, rng=None, batch_size=1, seq_len=16):

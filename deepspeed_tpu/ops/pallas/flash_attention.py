@@ -7,10 +7,12 @@ and the training softmax/attention CUDA kernels (``csrc/transformer/softmax_kern
 Design:
 - Forward: a Pallas kernel, grid over (batch*heads, q_blocks); each program streams
   KV blocks through VMEM with an online-softmax accumulator (flash-attention-2
-  schedule). Causal masking skips fully-masked KV blocks.
-- Backward: custom VJP that recomputes attention blockwise in pure JAX
-  (lax.scan over KV blocks) — O(S) memory like the forward, fused by XLA. A Pallas
-  backward kernel is a later optimization; this keeps training memory-correct now.
+  schedule). Causal masking skips fully-masked KV blocks. The backward's softmax
+  stats (lse) are saved lane-broadcast as a second output.
+- Backward: hand Pallas kernels (``_flash_bwd_pallas``): a dK/dV kernel owning one
+  KV block and streaming q/do rows, and a dQ kernel owning one Q block and
+  streaming KV — the FA2 backward, O(S) memory. The blockwise-JAX backward
+  (``_flash_bwd_manual``) stays as the numerical oracle and debug fallback.
 - CPU (tests): interpret mode.
 
 Layout: q, k, v are [B, S, H, D] (kv may have fewer heads — GQA is expanded by the
@@ -38,8 +40,8 @@ def _fit_block(seq_len, cap):
     return b
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale, causal, block_q,
-                block_k, nkb):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, scale, causal,
+                block_q, block_k, nkb):
     """Flash-attention-2 schedule: grid (bh, q_blocks, kv_blocks); the kv dim is the
     innermost (sequential) grid axis so Pallas double-buffers the K/V block fetches
     while the scratch accumulators carry the online softmax across iterations."""
@@ -82,9 +84,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale, cau
     def _finish():
         l = l_scr[...][:, :1]
         o_ref[...] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # softmax stats for the backward, lane-broadcast ([bq, 128] — the
+            # TPU-tileable layout for per-row scalars)
+            lse_ref[...] = m_scr[...] + jnp.log(jnp.maximum(l_scr[...], 1e-30))
 
 
-def _flash_fwd_pallas(q, k, v, scale, causal, block_q=512, block_k=1024):
+def _flash_fwd_pallas(q, k, v, scale, causal, block_q=512, block_k=1024, save_lse=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -99,17 +105,27 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q=512, block_k=1024):
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, block_q=block_q,
                                block_k=block_k, nkb=nkb)
+    if not save_lse:
+        inner = kernel
+
+        def kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
+            inner(q_ref, k_ref, v_ref, o_ref, None, m_scr, l_scr, acc_scr)
     on_cpu = _on_cpu()
     scratch = [
         pltpu.VMEM((block_q, 128), jnp.float32),  # m (lane-broadcast)
         pltpu.VMEM((block_q, 128), jnp.float32),  # l (lane-broadcast)
         pltpu.VMEM((block_q, D), jnp.float32),  # acc
     ]
+    out_specs = [pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((B * H, S, D), q.dtype)]
+    if save_lse:
+        out_specs.append(pl.BlockSpec((None, block_q, 128), lambda b, i, j: (b, i, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((B * H, S, 128), jnp.float32))
     kwargs = {}
     if not on_cpu:
         kwargs["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
-    out = pl.pallas_call(
+    outs = pl.pallas_call(
         kernel,
         grid=(B * H, S // block_q, nkb),
         in_specs=[
@@ -117,13 +133,19 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q=512, block_k=1024):
             pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        out_specs=out_specs if save_lse else out_specs[0],
+        out_shape=out_shape if save_lse else out_shape[0],
         scratch_shapes=scratch,
         interpret=on_cpu,
         **kwargs,
     )(qr, kr, vr)
-    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    if save_lse:
+        out, lse = outs
+        # keep ONE lane as the residual: all 128 are identical, and holding
+        # the broadcast across the fwd→bwd window would cost 128x the bytes
+        # of the per-row scalar (2x the attention output itself)
+        return out.reshape(B, H, S, D).transpose(0, 2, 1, 3), lse[..., :1]
+    return outs.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 
 
 def _blockwise_attention_ref(q, k, v, scale, causal, block_k=256):
@@ -228,18 +250,183 @@ def _flash_bwd_manual(q, k, v, out, g, scale, causal, block_k=256):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dk_ref, dv_ref,
+                    dk_scr, dv_scr, *, scale, causal, block_q, block_k, nqb):
+    """dK/dV: grid (BH, kv_blocks, q_steps) — each program owns one KV block
+    and streams the q/do/lse/delta row blocks through (FA2 backward, the role
+    of the reference's csrc/transformer training kernels)."""
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    run = (qi * block_q + block_q - 1 >= kb * block_k) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[...].astype(jnp.float32)        # [bq, d]
+        do = do_ref[...].astype(jnp.float32)      # [bq, d]
+        k_blk = k_ref[...].astype(jnp.float32)    # [bk, d]
+        v_blk = v_ref[...].astype(jnp.float32)
+        lse = lse_ref[...][:, :1]                 # [bq, 1]
+        delta = delta_ref[...][:, :1]
+        s = jax.lax.dot_general(q, k_blk, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                      # masked: exp(NEG_INF - lse) = 0
+        dv_scr[...] += jax.lax.dot_general(p, do, (((0, ), (0, )), ((), ())),
+                                           preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_blk, (((1, ), (1, )), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_scr[...] += jax.lax.dot_general(ds, q, (((0, ), (0, )), ((), ())),
+                                           preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nqb - 1)
+    def _finish():
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *,
+                   scale, causal, block_q, block_k, nkb):
+    """dQ: grid (BH, q_blocks, kv_steps) — each program owns one Q block and
+    streams the KV blocks through."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    run = (kb * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        k_blk = k_ref[...].astype(jnp.float32)
+        v_blk = v_ref[...].astype(jnp.float32)
+        lse = lse_ref[...][:, :1]
+        delta = delta_ref[...][:, :1]
+        s = jax.lax.dot_general(q, k_blk, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v_blk, (((1, ), (1, )), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[...] += jax.lax.dot_general(ds, k_blk, (((1, ), (0, )), ((), ())),
+                                           preferred_element_type=jnp.float32)
+
+    @pl.when(kb == nkb - 1)
+    def _finish():
+        dq_ref[...] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, g, lse, scale, causal, block_q=512, block_k=512):
+    """Hand Pallas backward (VERDICT r4 #6): dq/dk/dv via two kernels over the
+    forward-saved lse, delta precomputed in XLA. [B, S, H, D] in/out."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, H, D = q.shape
+    bq = _fit_block(S, block_q)
+    bk = _fit_block(S, block_k)
+    nqb, nkb = S // bq, S // bk
+
+    qr = q.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    kr = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    vr = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    dor = g.transpose(0, 2, 1, 3).reshape(B * H, S, D).astype(q.dtype)
+    # delta = rowsum(dO * O); single-lane [BH, S, 1] like the lse residual
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = delta.transpose(0, 2, 1).reshape(B * H, S)[..., None]
+
+    on_cpu = _on_cpu()
+    kwargs = {}
+    if not on_cpu:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+    dkv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq,
+                          block_k=bk, nqb=nqb),
+        grid=(B * H, nkb, nqb),
+        in_specs=[
+            pl.BlockSpec((None, bq, D), lambda b, j, i: (b, i, 0)),   # q rows
+            pl.BlockSpec((None, bq, D), lambda b, j, i: (b, i, 0)),   # do rows
+            pl.BlockSpec((None, bq, 1), lambda b, j, i: (b, i, 0)),   # lse rows
+            pl.BlockSpec((None, bq, 1), lambda b, j, i: (b, i, 0)),   # delta rows
+            pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),   # k block
+            pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),   # v block
+        ],
+        out_specs=[pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),
+                   pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0))],
+        out_shape=[jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, S, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        interpret=on_cpu,
+        **kwargs,
+    )(qr, dor, lse, delta, kr, vr)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, block_q=bq,
+                          block_k=bk, nkb=nkb),
+        grid=(B * H, nqb, nkb),
+        in_specs=[
+            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),   # k block
+            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),   # v block
+            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),   # q rows
+            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),   # do rows
+            pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, i, 0)),   # lse
+            pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, i, 0)),   # delta
+        ],
+        out_specs=pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=on_cpu,
+        **kwargs,
+    )(kr, vr, qr, dor, lse, delta)
+
+    back = lambda x: x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    dk, dv = dkv
+    return back(dq), back(dk), back(dv)
+
+
+# test/debug escape hatch: the blockwise-JAX backward stays as the oracle
+_FORCE_MANUAL_BWD = False
+
+
 def _fa_fwd(q, k, v, scale, causal):
-    out = flash_attention(q, k, v, scale, causal)
+    ke, ve = _expand_gqa(q, k, v)
     # `out` is a live activation either way — saving it adds no memory (XLA
-    # aliases), and it gives the backward delta = rowsum(dO * O) for free
-    return out, (q, k, v, out)
+    # aliases); lse feeds the hand backward kernels
+    out, lse = _flash_fwd_pallas(q, ke, ve, scale, causal, save_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(scale, causal, res, g):
-    q, k, v, out = res
+    q, k, v, out, lse = res
     kvh = k.shape[2]
     ke, ve = _expand_gqa(q, k, v)
-    dq, dke, dve = _flash_bwd_manual(q, ke, ve, out, g, scale, causal)
+    if _FORCE_MANUAL_BWD:
+        dq, dke, dve = _flash_bwd_manual(q, ke, ve, out, g, scale, causal)
+    else:
+        dq, dke, dve = _flash_bwd_pallas(q, ke, ve, out, g, lse, scale, causal)
     if kvh != q.shape[2]:  # fold expanded GQA grads back onto kv heads
         rep = q.shape[2] // kvh
         B, S, _, D = dke.shape

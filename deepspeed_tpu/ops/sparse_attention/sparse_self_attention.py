@@ -2,11 +2,15 @@
 
 Reference: ``deepspeed/ops/sparse_attention/sparse_self_attention.py``
 (SparseSelfAttention:15 — Triton block-sparse sdd/dsd matmuls + masked
-softmax). TPU formulation: the layout expands to a block-structured boolean
-mask consumed by a masked attention; XLA fuses mask-add into the softmax and
-the block structure keeps the mask cheap to materialize. For long sequences the
-flash path (``ops/pallas/flash_attention.py``) with a window is the
-sliding-window special case; this module is the general-layout surface.
+softmax). Two implementations:
+
+- ``impl="kernel"`` (default where it applies): the Pallas block-sparse flash
+  kernel (``ops/pallas/block_sparse_attention.py``) — compute and HBM scale
+  with the layout density, the role of the reference's Triton sdd/dsd tier.
+  Long sequences (8k+) where dense S² scores OOM run here.
+- ``impl="masked"``: dense scores + layout mask — the semantic reference and
+  the path for per-batch masks (key_padding/attn_mask), which the kernel does
+  not take.
 """
 
 from typing import Optional
@@ -22,13 +26,25 @@ def layout_to_dense_mask(layout, block: int):
 
 
 def sparse_self_attention(q, k, v, layout, block: int, scale: Optional[float] = None,
-                          key_padding_mask=None, attn_mask=None):
+                          key_padding_mask=None, attn_mask=None, impl: str = "auto"):
     """q/k/v: [B, H, S, D]; layout: [H, nb, nb]; returns [B, H, S, D].
 
     ``key_padding_mask`` [B, S] and ``attn_mask`` [S, S] follow the reference's
     additive/boolean semantics: True (or 0) = keep, False (or -inf) = drop.
+    ``impl``: "kernel" = Pallas block-sparse flash (density-scaling compute),
+    "masked" = dense scores + mask, "auto" = kernel when no per-batch masks.
     """
     import jax.numpy as jnp
+
+    if impl == "auto":
+        impl = "masked" if (key_padding_mask is not None or attn_mask is not None) \
+            else "kernel"
+    if impl == "kernel":
+        if key_padding_mask is not None or attn_mask is not None:
+            raise ValueError("the block-sparse kernel takes the layout only; "
+                             "fold per-batch masks into the layout or use impl='masked'")
+        from deepspeed_tpu.ops.pallas.block_sparse_attention import block_sparse_attention
+        return block_sparse_attention(q, k, v, layout, block, scale=scale)
 
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / float(np.sqrt(d))
@@ -44,10 +60,14 @@ def sparse_self_attention(q, k, v, layout, block: int, scale: Optional[float] = 
         am = jnp.asarray(attn_mask, bool)[None, None]
         scores = jnp.where(am, scores, neg)
 
-    probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
-    # rows with no attended block (possible under padding) become zeros, not NaN
+    row_max = jnp.max(scores, axis=-1, keepdims=True)
+    probs = jnp.exp(scores - row_max)
     denom = jnp.sum(probs, axis=-1, keepdims=True)
     probs = probs / jnp.maximum(denom, 1e-20)
+    # rows with no attended key (empty layout row, or padding masking a whole
+    # row) contribute zeros, not NaN — and not the uniform average that
+    # exp(min - min) = 1 would produce
+    probs = jnp.where(row_max > neg / 2, probs, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 

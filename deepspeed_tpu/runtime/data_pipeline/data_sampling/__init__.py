@@ -1,0 +1,3 @@
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.indexed_dataset import (
+    MMapIndexedDataset, MMapIndexedDatasetBuilder)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import DataAnalyzer

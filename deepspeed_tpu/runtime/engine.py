@@ -358,6 +358,14 @@ class DeepSpeedEngine:
             self.progressive_layer_drop = ProgressiveLayerDrop(
                 theta=pld_cfg.get("theta", 0.5), gamma=pld_cfg.get("gamma", 0.001))
 
+        # compression scheduler (reference engine.py:1264
+        # _configure_compression_scheduler + compression/scheduler.py)
+        self.compression_scheduler = None
+        from deepspeed_tpu.compression.scheduler import CompressionScheduler
+        _csched = CompressionScheduler(self._config._param_dict)
+        if _csched.enabled:
+            self.compression_scheduler = _csched
+
         # safe mode (SURVEY.md §5.2)
         if self._config.debug_nans:
             from deepspeed_tpu.utils.debug import enable_debug_nans
@@ -703,6 +711,8 @@ class DeepSpeedEngine:
         if self.training:
             batch = self._apply_curriculum(batch)
         batch = self.shard_batch(batch)
+        if self.training:
+            self._last_batch = batch  # eigenvalue gate / curvature probes
         if not self.training:
             self._cached_grads = None  # eval invalidates any pending backward()
             try:
@@ -770,6 +780,8 @@ class DeepSpeedEngine:
             self._step_lr_scheduler(overflow, **(lr_kwargs or {}))
             if self.progressive_layer_drop is not None:
                 self.progressive_layer_drop.update_state(self.global_steps)
+            if self.compression_scheduler is not None:
+                self.compression_scheduler.step(self)
             if self.monitor is not None and self.monitor.enabled and self.global_steps % max(
                     1, self._config.steps_per_print) == 0:
                 self._write_monitor()
@@ -892,6 +904,12 @@ class DeepSpeedEngine:
         self.global_samples += self.train_batch_size()
         self.micro_steps += gas
         self._step_lr_scheduler(overflow)
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
+        if self.compression_scheduler is not None:
+            # one micro-batch kept for the eigenvalue gate's HVPs
+            self._last_batch = jax.tree.map(lambda x: x[0], batch)
+            self.compression_scheduler.step(self)
         self.tput_timer.stop(global_step=True)
         if self.monitor is not None and self.monitor.enabled and self.global_steps % max(
                 1, self._config.steps_per_print) == 0:
@@ -1190,6 +1208,37 @@ class DeepSpeedEngine:
 
     def quantize_training(self):
         return self._config.compression_config
+
+    def apply_compression_transform(self, sub_config: dict) -> None:
+        """Apply compression transforms to the LIVE master parameters
+        (compression/scheduler.py hook; reference flips compressed-layer flags
+        — here the tree transform runs and the result keeps its shardings)."""
+        import jax
+        from deepspeed_tpu.compression.compress import init_compression
+        new_params = init_compression(self.params, sub_config)
+        self.params = jax.device_put(new_params, self._param_shardings)
+
+    def loss_curvature(self) -> Optional[float]:
+        """Top Hessian eigenvalue of the last cached batch's loss (power
+        iteration, runtime/eigenvalue.py) — the compression scheduler's
+        eigenvalue gate. None when no batch has been seen yet."""
+        if getattr(self, "_last_batch", None) is None:
+            return None
+        import jax
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+        eig = Eigenvalue(max_iter=8, tol=1e-2)
+        takes_rng = self._loss_fn_takes_rng
+        cast = self._cast_params
+        # fixed key, not None: rng-taking loss fns (dropout) must not crash
+        # inside the power iteration (same reason as the eval fallback)
+        key = jax.random.PRNGKey(0)
+
+        def loss_fn(p, b):
+            out = self.loss_fn(cast(p), b, key) if takes_rng else self.loss_fn(cast(p), b)
+            return out[0] if isinstance(out, tuple) else out
+
+        vals = eig.compute_eigenvalue(loss_fn, self.params, self._last_batch)
+        return max(vals.values()) if vals else None
 
     # -- flops profiler / autotuning accessors ------------------------------------
     def flops_profiler_enabled(self):

@@ -1,0 +1,137 @@
+"""Progressive compression scheduling (reference compression/scheduler.py —
+the engine steps technique schedules; transforms fire at their offsets)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.utils import groups
+
+from ..simple_model import make_simple_model, random_batches
+
+HIDDEN = 16
+
+
+def _cfg(extra_compression, gas=1):
+    return {
+        "train_micro_batch_size_per_gpu": 16 // gas,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "AdamW", "params": {"lr": 0.001, "weight_decay": 0.0}},
+        "compression_training": extra_compression,
+    }
+
+
+def _wq(offset, frequency=0, **shared_extra):
+    return {"weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": offset,
+                              "frequency": frequency, **shared_extra},
+        "different_groups": {"g": {"params": {"target_bits": 4}, "modules": ["*"]}},
+    }}
+
+
+def _n_distinct(engine):
+    import jax
+    leaves = [np.asarray(l) for l in jax.tree.leaves(jax.device_get(engine.params))
+              if np.asarray(l).ndim == 2]
+    return max(len(np.unique(l)) for l in leaves)
+
+
+def test_quantization_fires_at_offset():
+    """Parameters stay full precision until schedule_offset, then snap to the
+    4-bit grid — staged compression visible in the parameter statistics."""
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                            config=_cfg(_wq(offset=3)))
+    assert eng.compression_scheduler is not None
+    batches = random_batches(6, 16, HIDDEN)
+    for i, b in enumerate(batches):
+        eng.train_batch(batch=b)
+        distinct = _n_distinct(eng)
+        if eng.global_steps < 3:
+            assert distinct > 64, (eng.global_steps, distinct)
+        elif eng.global_steps == 3:
+            # 4-bit symmetric fake-quant: <= 16 levels per channel row, far
+            # fewer distinct values than the fp32 matrix had
+            assert distinct <= 16 * HIDDEN, distinct
+
+
+def test_quantization_reapplies_on_frequency():
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                            config=_cfg(_wq(offset=1, frequency=2)))
+    applied = []
+    orig = eng.apply_compression_transform
+
+    def spy(sub_cfg):
+        applied.append(eng.global_steps)
+        orig(sub_cfg)
+
+    eng.apply_compression_transform = spy
+    for b in random_batches(6, 16, HIDDEN):
+        eng.train_batch(batch=b)
+    assert applied == [1, 3, 5], applied
+
+
+def test_loss_curve_shows_staged_compression():
+    """The quantization event at the offset perturbs the loss trajectory
+    relative to an uncompressed run — before the offset the two runs are
+    IDENTICAL (scheduling really is staged, not at-init)."""
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+    batches = random_batches(8, 16, HIDDEN)
+
+    def run(compression):
+        groups.initialize_mesh(force=True)
+        cfg = _cfg(compression) if compression else \
+            {k: v for k, v in _cfg({}).items() if k != "compression_training"}
+        eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                                config=cfg)
+        return [float(eng.train_batch(batch=b)) for b in batches]
+
+    plain = run(None)
+    comp = run(_wq(offset=4))
+    np.testing.assert_allclose(comp[:4], plain[:4], rtol=1e-6)
+    assert any(abs(a - b) > 1e-7 for a, b in zip(comp[5:], plain[5:])), \
+        "quantization at step 4 must perturb later losses"
+
+
+def test_eigenvalue_gate_defers_activation():
+    """eigenvalue_gated quantization waits for curvature below the threshold;
+    with an impossible threshold it never fires, with a huge one it fires at
+    the offset."""
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+    batches = random_batches(4, 16, HIDDEN)
+
+    def run(threshold):
+        groups.initialize_mesh(force=True)
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params0,
+            config=_cfg(_wq(offset=1, eigenvalue_gated=True,
+                            eigenvalue_threshold=threshold)))
+        fired = []
+        orig = eng.apply_compression_transform
+        eng.apply_compression_transform = lambda c: (fired.append(eng.global_steps), orig(c))
+        for b in batches:
+            eng.train_batch(batch=b)
+        return fired
+
+    assert run(threshold=1e-30) == []          # never smooth enough
+    assert run(threshold=1e30) == [1]          # gate trivially open at offset
+
+
+def test_scheduler_state_roundtrip():
+    from deepspeed_tpu.compression.scheduler import CompressionScheduler
+
+    cfg = {"compression_training": _wq(offset=2, frequency=3)}
+    a = CompressionScheduler(cfg)
+    a.techniques["weight_quantization"]["active"] = True
+    a.techniques["weight_quantization"]["last_applied"] = 5
+    a.training_steps = 6
+    b = CompressionScheduler(cfg)
+    b.load_state_dict(a.state_dict())
+    assert b.training_steps == 6
+    assert b.techniques["weight_quantization"]["active"]
+    assert b.techniques["weight_quantization"]["last_applied"] == 5

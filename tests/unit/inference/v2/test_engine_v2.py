@@ -211,3 +211,16 @@ def test_decode_loop_token_budget_is_per_step(llama_setup):
     first = int(np.argmax(np.asarray(engine.put([0], [prompt]))[0]))
     toks = engine.decode_loop([0], [np.array([first])], 70)  # 70 > 64 and KV fits
     assert toks.shape == (1, 70)
+
+
+def test_decode_loop_rejects_past_max_context(llama_setup):
+    """n_steps beyond the per-sequence table cap (max_context) must be a
+    SchedulingError up front — never an allocate-then-extend crash that leaks
+    pool blocks (regression)."""
+    cfg, model, params = llama_setup
+    engine = build_engine(params, cfg, _engine_config(num_blocks=64))  # max_context=512
+    engine.put([0], [np.arange(30) % cfg.vocab_size])
+    free_before = engine.free_blocks
+    with pytest.raises(SchedulingError):
+        engine.decode_loop([0], [np.array([1])], 500)  # 530 > 512 cap
+    assert engine.free_blocks == free_before  # nothing leaked

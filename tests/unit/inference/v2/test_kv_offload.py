@@ -1,0 +1,168 @@
+"""KV-cache host offload/restore (reference inference/v2/ragged/kv_cache.py:166
+offload / :176 restore — declared there, unimplemented; the ZeRO-Inference
+KV-offload leg of BASELINE.md depends on them)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.engine_factory import build_engine
+from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache
+from deepspeed_tpu.inference.v2.ragged.manager_configs import (AllocationMode,
+                                                               DSStateManagerConfig,
+                                                               KVCacheConfig, MemoryConfig)
+from deepspeed_tpu.inference.v2.scheduling_utils import SchedulingError
+from deepspeed_tpu.models.llama import LlamaConfig, init_params
+
+BS = 16
+
+
+def _cache(num_blocks=8, offload_path=None):
+    return BlockedKVCache(
+        KVCacheConfig(block_size=BS, cache_shape=(2, 2, 8), cache_dtype="float32"),
+        MemoryConfig(mode=AllocationMode.ALLOCATE, size=num_blocks),
+        offload_path=offload_path)
+
+
+@pytest.mark.parametrize("nvme", [False, True])
+def test_block_offload_restore_roundtrip(tmp_path, nvme):
+    """Offload frees the device blocks; restore returns FRESH ids holding the
+    exact contents; other blocks are untouched."""
+    kv = _cache(offload_path=str(tmp_path) if nvme else None)
+    ids = kv.reserve(3)
+    rng = np.random.default_rng(0)
+    payload = rng.normal(size=(2, 2, 3, 2, BS, 8)).astype(np.float32)
+    kv.set_cache(kv.cache.at[:, :, jnp.asarray(ids)].set(jnp.asarray(payload)))
+    other = kv.reserve(2)
+    sentinel = np.full((2, 2, 2, 2, BS, 8), 7.0, np.float32)
+    kv.set_cache(kv.cache.at[:, :, jnp.asarray(other)].set(jnp.asarray(sentinel)))
+
+    free_before = kv.free_blocks
+    h = kv.offload(ids)
+    assert kv.free_blocks == free_before + 3
+    # freed blocks are reusable while the payload lives on host
+    squatter = kv.reserve(3)
+    kv.set_cache(kv.cache.at[:, :, jnp.asarray(squatter)].set(-1.0))
+    kv.free(squatter)
+
+    new_ids = kv.restore(h)
+    assert len(new_ids) == 3
+    got = np.asarray(kv.cache[:, :, jnp.asarray(new_ids)])
+    np.testing.assert_array_equal(got, payload)
+    np.testing.assert_array_equal(np.asarray(kv.cache[:, :, jnp.asarray(other)]), sentinel)
+    with pytest.raises(KeyError):
+        kv.restore(h)  # single-shot handle
+
+
+def test_restore_failure_keeps_payload(tmp_path):
+    kv = _cache(num_blocks=4)
+    ids = kv.reserve(3)
+    h = kv.offload(ids)
+    blocker = kv.reserve(3)  # leaves 1 free — restore needs 3
+    with pytest.raises(ValueError):
+        kv.restore(h)
+    kv.free(blocker)
+    assert len(kv.restore(h)) == 3  # payload survived the failed attempt
+
+
+def _engine(params, cfg, num_blocks, **mgr_kw):
+    mgr = DSStateManagerConfig(memory_config=MemoryConfig(mode=AllocationMode.ALLOCATE,
+                                                          size=num_blocks),
+                               max_context=256, **mgr_kw)
+    return build_engine(params, cfg, RaggedInferenceEngineConfig(state_manager=mgr,
+                                                                 kv_block_size=BS))
+
+
+@pytest.fixture(scope="module")
+def llama():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    _, params = init_params(cfg)
+    return cfg, params
+
+
+def test_engine_eviction_choreography(llama):
+    """Fill PAST the device block budget by offloading cold sequences; touch
+    restores transparently; logits identical to an engine that never evicted."""
+    cfg, params = llama
+    rng = np.random.default_rng(1)
+    A = rng.integers(0, cfg.vocab_size, 40)   # 3 blocks
+    B = rng.integers(0, cfg.vocab_size, 40)   # 3 blocks
+    C = rng.integers(0, cfg.vocab_size, 40)   # 3 blocks — total 9 > 8 budget
+    tok = np.asarray([5])
+
+    # baseline: big engine, no eviction
+    big = _engine(params, cfg, num_blocks=64)
+    big.put([0], [A]); big.put([1], [B]); big.put([2], [C])
+    want_a = np.asarray(big.put([0], [tok]))
+    want_b = np.asarray(big.put([1], [tok]))
+
+    small = _engine(params, cfg, num_blocks=8)
+    small.put([0], [A])
+    small.put([1], [B])                      # 6/8 blocks live
+    with pytest.raises(SchedulingError):
+        small.put([2], [C])                  # C does NOT fit
+    small.offload_sequence(0)                # evict cold A -> 3 free + ...
+    assert small.is_offloaded(0)
+    small.put([2], [C])                      # now it does
+    small.offload_sequence(2)                # make room to touch A again
+    got_a = np.asarray(small.put([0], [tok]))  # restore-on-touch
+    assert not small.is_offloaded(0)
+    np.testing.assert_allclose(got_a, want_a, rtol=2e-5, atol=2e-5)
+    small.offload_sequence(0)
+    got_b = np.asarray(small.put([1], [tok]))
+    np.testing.assert_allclose(got_b, want_b, rtol=2e-5, atol=2e-5)
+
+
+def test_decode_loop_after_restore(llama):
+    """Device-loop generation continues correctly from restored KV."""
+    cfg, params = llama
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, cfg.vocab_size, 33)
+
+    ref = _engine(params, cfg, num_blocks=64)
+    first = int(np.argmax(np.asarray(ref.put([0], [prompt]))[0]))
+    want = ref.decode_loop([0], [np.array([first])], 5)
+
+    eng = _engine(params, cfg, num_blocks=64)
+    first2 = int(np.argmax(np.asarray(eng.put([0], [prompt]))[0]))
+    assert first2 == first
+    eng.offload_sequence(0)
+    got = eng.decode_loop([0], [np.array([first])], 5)  # restores, then scans
+    np.testing.assert_array_equal(got, want)
+
+
+def test_flush_drops_offloaded_payload(tmp_path, llama):
+    cfg, params = llama
+    eng = _engine(params, cfg, num_blocks=8, offload_path=str(tmp_path))
+    eng.put([0], [np.arange(20) % cfg.vocab_size])
+    eng.offload_sequence(0)
+    files = list(tmp_path.glob("kv_offload_*.bin"))
+    assert files, "NVMe spill file must exist while offloaded"
+    eng.flush(0)
+    assert not list(tmp_path.glob("kv_offload_*.bin"))
+    assert eng.free_blocks == 8
+
+
+def test_admission_counts_restore_cost(llama):
+    """can_schedule must treat an offloaded sequence's blocks as needing
+    re-allocation: admission fails with a SchedulingError, never a raw
+    allocator crash mid-restore (regression)."""
+    from deepspeed_tpu.inference.v2.scheduling_utils import SchedulingResult
+
+    cfg, params = llama
+    eng = _engine(params, cfg, num_blocks=8)
+    rng = np.random.default_rng(5)
+    eng.put([0], [rng.integers(0, cfg.vocab_size, 40)])  # 3 blocks
+    eng.offload_sequence(0)
+    eng.put([1], [rng.integers(0, cfg.vocab_size, 100)])  # 7 blocks -> 1 free
+    # touching uid 0 needs 3 restored blocks but only 1 is free
+    assert eng.can_schedule([0], [1]) == SchedulingResult.KVCacheLimitExceeded
+    with pytest.raises(SchedulingError):
+        eng.put([0], [np.array([3])])
+    assert eng.is_offloaded(0)  # payload untouched by the rejected admission
+    eng.flush(1)
+    got = eng.put([0], [np.array([3])])  # now restores and runs
+    assert got.shape == (1, cfg.vocab_size)

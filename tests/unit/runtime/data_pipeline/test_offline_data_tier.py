@@ -1,0 +1,112 @@
+"""Offline data-efficiency tier (reference data_analyzer.py:417 +
+indexed_dataset.py:617): build a memory-mapped corpus, index it offline,
+train with a difficulty-from-index curriculum."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import DeepSpeedDataSampler
+from deepspeed_tpu.runtime.data_pipeline.data_sampling import (DataAnalyzer,
+                                                               MMapIndexedDataset,
+                                                               MMapIndexedDatasetBuilder)
+from deepspeed_tpu.utils import groups
+
+
+def _build_corpus(prefix, n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    b = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+    samples = []
+    for i in range(n):
+        s = rng.integers(0, 1000, size=rng.integers(4, 40))
+        samples.append(s.astype(np.int32))
+        b.add_item(s)
+    b.finalize()
+    return samples
+
+
+def test_indexed_dataset_roundtrip(tmp_path):
+    prefix = str(tmp_path / "corpus")
+    samples = _build_corpus(prefix)
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == len(samples)
+    for i in (0, 7, 63, -1):
+        np.testing.assert_array_equal(np.asarray(ds[i]), samples[i])
+    assert ds.num_tokens(3) == samples[3].size
+    assert MMapIndexedDataset.exists(prefix)
+    # zero-copy: reading all samples must not materialize the corpus
+    got = ds[10:13]
+    assert all(isinstance(g, np.memmap) or g.base is not None for g in got)
+
+
+def test_indexed_dataset_merge(tmp_path):
+    a = str(tmp_path / "a")
+    b = str(tmp_path / "b")
+    sa = _build_corpus(a, n=5, seed=1)
+    sb = _build_corpus(b, n=3, seed=2)
+    m = MMapIndexedDatasetBuilder(str(tmp_path / "m"), dtype=np.int32)
+    m.merge_file(a)
+    m.merge_file(b)
+    m.finalize()
+    ds = MMapIndexedDataset(str(tmp_path / "m"))
+    assert len(ds) == 8
+    np.testing.assert_array_equal(np.asarray(ds[6]), sb[1])
+
+
+def test_analyzer_multiworker_matches_direct(tmp_path):
+    """2-worker × 2-thread map/reduce produces the same sample_to_metric as a
+    direct computation, in sample order."""
+    prefix = str(tmp_path / "corpus")
+    samples = _build_corpus(prefix)
+    ds = MMapIndexedDataset(prefix)
+    an = DataAnalyzer(ds, metric_names=["seqlen", "vocabsum"],
+                      metric_functions=[len, lambda s: int(np.sum(s) % 97)],
+                      save_path=str(tmp_path / "idx"), num_workers=2, num_threads=2)
+    out = an.run_map_reduce()
+    want = np.asarray([len(s) for s in samples])
+    np.testing.assert_array_equal(out["seqlen"], want)
+    np.testing.assert_array_equal(
+        DataAnalyzer.load_difficulties(str(tmp_path / "idx"), "seqlen"), want)
+    # metric_to_sample inverts sample_to_metric
+    import numpy.lib.npyio
+    m2s = np.load(str(tmp_path / "idx") + "/seqlen_metric_to_sample.npz")
+    for v in m2s.files:
+        assert all(want[i] == int(v) for i in m2s[v])
+    pct = DataAnalyzer.get_metric_value_percentiles(str(tmp_path / "idx"), "seqlen")
+    assert pct[0] == want.min() and pct[100] == want.max()
+
+
+def test_analyzer_accumulate_metric(tmp_path):
+    prefix = str(tmp_path / "corpus")
+    samples = _build_corpus(prefix, n=16)
+    ds = MMapIndexedDataset(prefix)
+    an = DataAnalyzer(ds, metric_names=["hist"],
+                      metric_functions=[lambda s: np.bincount(np.asarray(s) % 8, minlength=8)],
+                      metric_types=["accumulate_value_over_samples"],
+                      save_path=str(tmp_path / "idx"), num_workers=1, num_threads=3)
+    out = an.run_map_reduce()
+    want = np.sum([np.bincount(s % 8, minlength=8) for s in samples], axis=0)
+    np.testing.assert_array_equal(out["hist"], want)
+
+
+def test_curriculum_follows_offline_index(tmp_path):
+    """Train-time batch composition follows the OFFLINE index: while the
+    curriculum threshold is below max difficulty, every drawn sample's indexed
+    difficulty is within the threshold."""
+    prefix = str(tmp_path / "corpus")
+    _build_corpus(prefix)
+    ds = MMapIndexedDataset(prefix)
+    an = DataAnalyzer(ds, metric_names=["seqlen"], metric_functions=[len],
+                      save_path=str(tmp_path / "idx"))
+    an.run_map_reduce()
+    diffs = DataAnalyzer.load_difficulties(str(tmp_path / "idx"), "seqlen")
+
+    sched = CurriculumScheduler({"curriculum_type": "seqlen", "min_difficulty": 8,
+                                 "max_difficulty": 40, "schedule_type": "fixed_linear",
+                                 "schedule_config": {"total_curriculum_step": 10,
+                                                     "difficulty_step": 1}})
+    sampler = DeepSpeedDataSampler(diffs, batch_size=4, curriculum_scheduler=sched)
+    for step, idx in zip(range(8), sampler):
+        limit = sched.update_difficulty(step)
+        assert np.all(diffs[idx] <= limit), (step, limit, diffs[idx])
