@@ -1,3 +1,4 @@
 from deepspeed_tpu.compression.compress import (get_compression_config, init_compression,
-                                                redundancy_clean)
+                                                redundancy_clean, student_initialization)
 from deepspeed_tpu.compression.basic_layer import fake_quantize, head_prune_mask, row_prune_mask
+from deepspeed_tpu.compression.scheduler import CompressionScheduler

@@ -105,6 +105,69 @@ def init_compression(params, deepspeed_config: dict, mpu=None):
     return new
 
 
+def student_initialization(student_params, teacher_params, deepspeed_config: dict):
+    """Layer-reduction distillation init (reference compress.py:192):
+    re-initialize the student's layers from selected TEACHER layers per the
+    ``layer_reduction`` block:
+
+        {"layer_reduction": {"enabled": true, "module_name_prefix": "layers",
+                             "teacher_layer": [1, 3], "other_module_name": [...]}}
+
+    TPU formulation: a pytree edit. Layer i of the student takes teacher layer
+    ``teacher_layer[i]`` (tree keys ``{prefix}_{n}`` — the flax naming the
+    in-repo models use, vs the reference's dotted ``prefix.n``);
+    ``other_module_name`` entries copy whole subtrees verbatim. Returns the
+    new student tree; shapes must already agree (same hidden size)."""
+    cfg = get_compression_config(deepspeed_config if isinstance(deepspeed_config, dict) else {})
+    lr = cfg.get("layer_reduction", {})
+    if not lr.get("enabled", False):
+        return student_params
+    prefix = lr.get("module_name_prefix", "layers")
+    if "teacher_layer" not in lr:
+        raise KeyError("layer_reduction: 'teacher_layer' (the teacher layer ids the "
+                       "student re-initializes from) is required when enabled")
+    teacher_layer = lr["teacher_layer"]
+    other = lr.get("other_module_name", [])
+
+    def walk(tree, dotted, who):
+        node = tree
+        for p in dotted.split("."):
+            try:
+                node = node[p]
+            except KeyError as e:
+                raise KeyError(f"layer_reduction: {dotted!r} not found in the "
+                               f"{who} tree (missing {p!r})") from e
+        return node
+
+    out = jax.tree.map(lambda x: x, student_params)  # shallow-copy dicts
+    *layer_parents, layer_base = prefix.split(".")
+    layers_parent = ".".join(layer_parents)
+    for s_idx, t_idx in enumerate(teacher_layer):
+        s_key, t_key = f"{layer_base}_{s_idx}", f"{layer_base}_{t_idx}"
+        try:
+            s_parent = walk(out, layers_parent, "student") if layers_parent else out
+            t_parent = walk(teacher_params, layers_parent, "teacher") if layers_parent \
+                else teacher_params
+            t_layer = t_parent[t_key]
+            s_parent[s_key]  # student must have the slot
+        except KeyError as e:
+            raise KeyError(f"layer_reduction: missing {s_key!r} in student or "
+                           f"{t_key!r} in teacher (prefix {prefix!r})") from e
+        s_parent[s_key] = t_layer
+    for name in other:
+        *parents, leafname = name.split(".")
+        parent = ".".join(parents)
+        node_t = walk(teacher_params, parent, "teacher") if parent else teacher_params
+        node_s = walk(out, parent, "student") if parent else out
+        if leafname not in node_t or leafname not in node_s:
+            raise KeyError(f"layer_reduction: other_module_name {name!r} not present "
+                           f"in both trees")
+        node_s[leafname] = node_t[leafname]
+    logger.info(f"layer_reduction: student layers <- teacher {teacher_layer}, "
+                f"copied modules {other}")
+    return out
+
+
 def redundancy_clean(params, deepspeed_config: dict, mpu=None):
     """Materialize structured pruning: physically drop zeroed rows (reference
     redundancy_clean:148 shrinks the swapped layers). Only row pruning changes

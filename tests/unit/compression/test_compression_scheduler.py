@@ -135,3 +135,43 @@ def test_scheduler_state_roundtrip():
     assert b.training_steps == 6
     assert b.techniques["weight_quantization"]["active"]
     assert b.techniques["weight_quantization"]["last_applied"] == 5
+
+
+def test_student_initialization_layer_reduction():
+    """Layer-reduction distillation init (reference compress.py:192): student
+    layer i takes teacher layer teacher_layer[i]; listed modules copy whole."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.compression import student_initialization
+    from deepspeed_tpu.models.llama import LlamaConfig, init_params
+
+    t_cfg = LlamaConfig.tiny(num_hidden_layers=4, dtype=jnp.float32)
+    s_cfg = LlamaConfig.tiny(num_hidden_layers=2, dtype=jnp.float32)
+    _, teacher = init_params(t_cfg, rng=jax.random.PRNGKey(0))
+    s_model, student = init_params(s_cfg, rng=jax.random.PRNGKey(1))
+
+    cfg = {"compression_training": {"layer_reduction": {
+        "enabled": True, "module_name_prefix": "model.layers",
+        "teacher_layer": [1, 3],
+        "other_module_name": ["model.embed_tokens", "model.norm", "model.lm_head"]}}}
+    out = student_initialization(student, teacher, cfg)
+
+    for s_i, t_i in ((0, 1), (1, 3)):
+        a = jax.tree.leaves(out["model"][f"layers_{s_i}"])
+        b = jax.tree.leaves(teacher["model"][f"layers_{t_i}"])
+        assert all(np.array_equal(x, y) for x, y in zip(a, b))
+    assert np.array_equal(out["model"]["embed_tokens"]["embedding"],
+                          teacher["model"]["embed_tokens"]["embedding"])
+    # untouched student leaves stay the student's (nothing silently replaced)
+    ids = np.zeros((1, 8), np.int32)
+    s_model.apply({"params": out}, (ids, ids))  # still a valid 2-layer model
+
+    # disabled block is the identity
+    same = student_initialization(student, teacher, {})
+    assert all(np.array_equal(a, b) for a, b in
+               zip(jax.tree.leaves(same), jax.tree.leaves(student)))
+
+    with pytest.raises(KeyError, match="layer_reduction"):
+        student_initialization(student, teacher, {"compression_training": {
+            "layer_reduction": {"enabled": True, "module_name_prefix": "model.layers",
+                                "teacher_layer": [0, 1, 2]}}})
