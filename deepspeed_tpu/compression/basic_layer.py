@@ -18,9 +18,24 @@ def fake_quantize(w, bits: int = 8, symmetric: bool = True, per_channel: bool = 
     ``deepspeed/compression/utils.py`` Quantizer): keeps dtype, snaps values to
     the 2^bits grid so downstream training sees quantization error."""
     w = jnp.asarray(w)
-    qmax = 2.0**(bits - 1) - 1 if symmetric else 2.0**bits - 1
     axes = tuple(i for i in range(w.ndim) if i != (channel_axis % w.ndim)) \
         if per_channel and w.ndim > 1 else None
+    if bits == 1:
+        # XTC binarization (reference compression/utils.py BinaryQuantizer):
+        # sign(w) scaled by the mean magnitude
+        scale = jnp.mean(jnp.abs(w), axis=axes, keepdims=True)
+        # sign(), not where(>=0): exact zeros (pruned weights) must STAY zero
+        return (jnp.sign(w) * scale).astype(w.dtype)
+    if bits == 2:
+        # XTC ternarization (reference TernaryQuantizer): threshold at
+        # 0.7·mean|w|, scale by the mean magnitude of the surviving entries
+        mag = jnp.abs(w)
+        thresh = 0.7 * jnp.mean(mag, axis=axes, keepdims=True)
+        mask = mag > thresh
+        denom = jnp.maximum(jnp.sum(mask, axis=axes, keepdims=True), 1)
+        scale = jnp.sum(jnp.where(mask, mag, 0.0), axis=axes, keepdims=True) / denom
+        return (jnp.sign(w) * scale * mask).astype(w.dtype)
+    qmax = 2.0**(bits - 1) - 1 if symmetric else 2.0**bits - 1
     if symmetric:
         scale = jnp.max(jnp.abs(w), axis=axes, keepdims=True) / qmax
         scale = jnp.maximum(scale, 1e-12)

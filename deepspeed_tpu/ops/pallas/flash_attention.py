@@ -409,6 +409,33 @@ def _flash_bwd_pallas(q, k, v, out, g, lse, scale, causal, block_q=512, block_k=
 
 # test/debug escape hatch: the blockwise-JAX backward stays as the oracle
 _FORCE_MANUAL_BWD = False
+_PALLAS_BWD_OK = {}  # (dtype, head_dim, causal) -> bool
+
+
+def _pallas_bwd_available(q, causal) -> bool:
+    """Per-(dtype, head_dim, causal) compile probe of the backward kernels on
+    tiny shapes: Mosaic lowering rejections are shape/dtype-dependent and
+    differ across compiler versions — they must degrade THAT config to the
+    blockwise-JAX oracle, not kill the training step (and must not pin other
+    configs to the slow path)."""
+    D = q.shape[-1]
+    key = (jnp.dtype(q.dtype).name, D, bool(causal))
+    ok = _PALLAS_BWD_OK.get(key)
+    if ok is None:
+        try:
+            S = 256
+            z = jnp.zeros((1, S, 1, D), q.dtype)
+            lse = jnp.zeros((1, S, 1), jnp.float32)
+            jax.jit(functools.partial(_flash_bwd_pallas, scale=1.0, causal=bool(causal))) \
+                .lower(z, z, z, z, z, lse).compile()
+            ok = True
+        except Exception as e:  # pragma: no cover - compiler-version dependent
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(f"Pallas flash backward unavailable for {key} on this "
+                           f"compiler ({str(e)[:120]}); using the blockwise-JAX backward")
+            ok = False
+        _PALLAS_BWD_OK[key] = ok
+    return ok
 
 
 def _fa_fwd(q, k, v, scale, causal):
@@ -423,7 +450,7 @@ def _fa_bwd(scale, causal, res, g):
     q, k, v, out, lse = res
     kvh = k.shape[2]
     ke, ve = _expand_gqa(q, k, v)
-    if _FORCE_MANUAL_BWD:
+    if _FORCE_MANUAL_BWD or not _pallas_bwd_available(q, causal):
         dq, dke, dve = _flash_bwd_manual(q, ke, ve, out, g, scale, causal)
     else:
         dq, dke, dve = _flash_bwd_pallas(q, ke, ve, out, g, lse, scale, causal)

@@ -175,3 +175,33 @@ def test_student_initialization_layer_reduction():
         student_initialization(student, teacher, {"compression_training": {
             "layer_reduction": {"enabled": True, "module_name_prefix": "model.layers",
                                 "teacher_layer": [0, 1, 2]}}})
+
+
+def test_xtc_binary_ternary_quantization():
+    """XTC tier (reference compression/utils.py Binary/TernaryQuantizer):
+    1-bit snaps to ±(mean magnitude) per channel; 2-bit to {-a, 0, +a} with a
+    0.7·mean|w| threshold."""
+    from deepspeed_tpu.compression import fake_quantize
+
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(32, 8)).astype(np.float32)
+
+    b = np.asarray(fake_quantize(w, bits=1))
+    for c in range(8):
+        col = b[:, c]
+        assert len(np.unique(np.abs(col))) == 1          # one magnitude
+        np.testing.assert_allclose(np.unique(np.abs(col))[0],
+                                   np.abs(w[:, c]).mean(), rtol=1e-5)
+        assert np.array_equal(np.sign(col), np.sign(w[:, c]))
+
+    wz = w.copy()
+    wz[0, :] = 0.0  # pruned weights must STAY zero under binarization
+    bz = np.asarray(fake_quantize(wz, bits=1))
+    assert not np.any(bz[0, :])
+
+    t = np.asarray(fake_quantize(w, bits=2))
+    for c in range(8):
+        vals = np.unique(t[:, c])
+        assert len(vals) <= 3 and (0.0 in vals)          # {-a, 0, +a}
+        thresh = 0.7 * np.abs(w[:, c]).mean()
+        np.testing.assert_array_equal(t[:, c] == 0, np.abs(w[:, c]) <= thresh)
