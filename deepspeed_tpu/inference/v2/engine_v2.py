@@ -152,12 +152,14 @@ class InferenceEngineV2:
 
     # ------------------------------------------------------------ decode_loop --
     def decode_loop(self, batch_uids: Iterable[int], batch_tokens: Iterable,
-                    n_steps: int, do_checks: bool = True) -> np.ndarray:
-        """Greedy-generate ``n_steps`` tokens per sequence in ONE device
-        program (no host round-trip per token — see
-        DSTransformerModelBase.decode_loop). ``batch_tokens`` holds each
-        sequence's next input token (e.g. the argmax of its prefill logits);
-        returns generated tokens ``[n_seqs, n_steps]``.
+                    n_steps: int, do_checks: bool = True, temperature: float = 0.0,
+                    rng=None) -> np.ndarray:
+        """Generate ``n_steps`` tokens per sequence in ONE device program (no
+        host round-trip per token — see DSTransformerModelBase.decode_loop).
+        ``batch_tokens`` holds each sequence's next input token (e.g. the
+        argmax of its prefill logits); returns generated tokens
+        ``[n_seqs, n_steps]``. ``temperature`` 0 = greedy; > 0 samples
+        categorically with the (per-step folded) ``rng``.
 
         EOS is not monitored on device: the loop always runs ``n_steps``; the
         caller trims at the first EOS (the fixed-shape scan is what makes the
@@ -200,7 +202,8 @@ class InferenceEngineV2:
             self._batch.insert_sequence(seq_desc, tokens, do_checks=do_checks)
 
         self._batch.finalize()
-        tokens = self._model.decode_loop(self._batch, n_steps)  # [n_steps, S_bucket]
+        tokens = self._model.decode_loop(self._batch, n_steps, temperature=temperature,
+                                         rng=rng)  # [n_steps, S_bucket]
         for uid in batch_uids:
             seq_desc = self._state_manager.get_sequence(uid)
             seq_desc.post_forward()           # the token passed in

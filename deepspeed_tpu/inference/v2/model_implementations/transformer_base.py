@@ -187,8 +187,12 @@ class DSTransformerModelBase:
         return self._compiled[bucket]
 
     # ------------------------------------------------------------ decode loop --
-    def decode_loop(self, ragged_batch, n_steps: int):
-        """Greedy-decode ``n_steps`` tokens per sequence in ONE device program.
+    def decode_loop(self, ragged_batch, n_steps: int, temperature: float = 0.0,
+                    rng=None):
+        """Decode ``n_steps`` tokens per sequence in ONE device program —
+        greedy argmax at ``temperature`` 0, categorical sampling otherwise
+        (``rng`` folded per step; REQUIRED when sampling — a silent fixed
+        default would make "sampling" deterministic across calls).
 
         The host-loop decode (one ``put`` per generated token) pays a full
         host→device dispatch round-trip per token — through a tunneled or
@@ -208,17 +212,26 @@ class DSTransformerModelBase:
         batch = ragged_batch.device_batch if hasattr(ragged_batch, "device_batch") else ragged_batch
         bucket = (batch["tok_meta"].shape[1], batch["seq_meta"].shape[0],
                   batch["seq_meta"].shape[1] - 4)
-        key = (bucket, int(n_steps))
+        temperature = float(temperature)
+        key = (bucket, int(n_steps), temperature > 0)
         if key not in self._compiled:
-            self._compiled[key] = jax.jit(partial(self._decode_loop_impl, n_steps=int(n_steps)),
-                                          donate_argnums=(1, ))
+            self._compiled[key] = jax.jit(
+                partial(self._decode_loop_impl, n_steps=int(n_steps),
+                        sampled=temperature > 0),
+                donate_argnums=(1, ))
         cache = self._state_manager.kv_cache.cache
+        if temperature > 0 and rng is None:
+            raise ValueError("decode_loop(temperature>0) requires an rng key — a fixed "
+                             "default would return identical 'samples' every call")
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
         tokens, new_cache = self._compiled[key](
-            self._params, cache, {"tok_meta": batch["tok_meta"], "seq_meta": batch["seq_meta"]})
+            self._params, cache, {"tok_meta": batch["tok_meta"], "seq_meta": batch["seq_meta"]},
+            jax.numpy.float32(temperature), rng)
         self._state_manager.kv_cache.set_cache(new_cache)
         return np.asarray(tokens)
 
-    def _decode_loop_impl(self, params, cache, batch, *, n_steps):
+    def _decode_loop_impl(self, params, cache, batch, temperature, rng, *, n_steps,
+                          sampled=False):
         import jax
         import jax.numpy as jnp
 
@@ -226,20 +239,26 @@ class DSTransformerModelBase:
         seq_meta = jnp.asarray(batch["seq_meta"])
 
         def step(carry, _):
-            cache, tok_meta, seq_meta = carry
+            cache, tok_meta, seq_meta, r = carry
             logits, cache = self._forward_impl(params, cache,
                                                {"tok_meta": tok_meta, "seq_meta": seq_meta})
-            next_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S]
+            if sampled:
+                r, sub = jax.random.split(r)
+                next_ids = jax.random.categorical(
+                    sub, logits / jnp.maximum(temperature, 1e-6), axis=-1).astype(jnp.int32)
+            else:  # greedy: the key is carried untouched (no dead per-step split)
+                next_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S]
             tv = tok_meta[3] > 0
             # decode batches carry one token per sequence: slot i ↔ sequence i
             new_ids = jnp.where(tv, next_ids[tok_meta[1]], tok_meta[0])
             tok_meta = tok_meta.at[0].set(new_ids).at[2].add(tv.astype(tok_meta.dtype))
             sv = (seq_meta[:, 3] > 0).astype(seq_meta.dtype)
             seq_meta = seq_meta.at[:, 0].add(sv)
-            return (cache, tok_meta, seq_meta), next_ids
+            return (cache, tok_meta, seq_meta, r), next_ids
 
-        (cache, _, _), tokens = jax.lax.scan(
-            step, (cache, tok_meta, seq_meta), None, length=n_steps)
+        # static per-compile sampling flag rides on the jit-cache key
+        (cache, _, _, _), tokens = jax.lax.scan(
+            step, (cache, tok_meta, seq_meta, rng), None, length=n_steps)
         return tokens, cache
 
     @staticmethod

@@ -224,3 +224,27 @@ def test_decode_loop_rejects_past_max_context(llama_setup):
     with pytest.raises(SchedulingError):
         engine.decode_loop([0], [np.array([1])], 500)  # 530 > 512 cap
     assert engine.free_blocks == free_before  # nothing leaked
+
+
+def test_decode_loop_sampling(llama_setup):
+    """temperature>0 samples with the provided rng: reproducible for a fixed
+    key, different for different keys, and greedy (0.0) is unchanged."""
+    import jax as _jax
+
+    cfg, model, params = llama_setup
+    prompt = np.arange(21) % cfg.vocab_size
+
+    def gen(temp, seed):
+        eng = build_engine(params, cfg, _engine_config())
+        first = int(np.argmax(np.asarray(eng.put([0], [prompt]))[0]))
+        return eng.decode_loop([0], [np.array([first])], 6, temperature=temp,
+                               rng=_jax.random.PRNGKey(seed))
+
+    a = gen(1.5, 0)
+    b = gen(1.5, 0)
+    c = gen(1.5, 123)
+    g1 = gen(0.0, 0)
+    g2 = gen(0.0, 7)
+    np.testing.assert_array_equal(a, b)           # reproducible
+    assert not np.array_equal(a, c)               # rng really used
+    np.testing.assert_array_equal(g1, g2)         # greedy ignores the rng
