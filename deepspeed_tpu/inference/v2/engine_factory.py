@@ -42,10 +42,18 @@ def generate(engine: InferenceEngineV2,
              max_new_tokens: int = 16,
              temperature: float = 0.0,
              eos_token_id: Optional[int] = None,
-             seed: int = 0) -> List[List[int]]:
+             seed: int = 0,
+             decode_chunk: int = 1) -> List[List[int]]:
     """Continuous-batching decode: prefill all prompts (token budget permitting),
     then decode step-by-step; finished sequences are flushed and their KV blocks
-    recycled. Greedy when ``temperature == 0``."""
+    recycled. Greedy when ``temperature == 0``.
+
+    ``decode_chunk`` > 1 runs decode in chunks of K steps through the engine's
+    on-device ``decode_loop`` (one dispatch per chunk instead of one per
+    token); eos is checked between chunks, so a finished sequence over-
+    generates up to K-1 discarded tokens before its KV blocks recycle — the
+    standard chunked-serving tradeoff of host-RTT against speculative compute.
+    """
     rng = np.random.default_rng(seed)
     uids = list(range(len(prompts)))
     outputs: Dict[int, List[int]] = {u: [] for u in uids}
@@ -62,7 +70,7 @@ def generate(engine: InferenceEngineV2,
         p /= p.sum()
         return int(rng.choice(row.shape[0], p=p))
 
-    from deepspeed_tpu.inference.v2.scheduling_utils import SchedulingResult
+    from deepspeed_tpu.inference.v2.scheduling_utils import SchedulingError, SchedulingResult
 
     def admits(uids_l, lens_l):
         """Full admission check — sequence count and KV blocks, not just the
@@ -110,11 +118,7 @@ def generate(engine: InferenceEngineV2,
                     f"{engine.free_blocks} free KV blocks) — raise the engine's "
                     f"KV/sequence budgets or lower concurrency")
             break
-        logits = np.asarray(engine.put(batch_uids, batch_tokens))
-        for i, u in enumerate(batch_uids):
-            if u in pending:  # mid-prefill: ignore logits until prompt is consumed
-                continue
-            nxt = sample(logits[i])
+        def finish_or_continue(u, nxt):
             outputs[u].append(nxt)
             if (eos_token_id is not None and nxt == eos_token_id) or len(outputs[u]) >= max_new_tokens:
                 done.add(u)
@@ -122,4 +126,34 @@ def generate(engine: InferenceEngineV2,
                 engine.flush(u)
             else:
                 live[u] = nxt
+
+        decoding_only = (decode_chunk > 1 and not pending
+                         and all(t.size == 1 for t in batch_tokens))
+        if decoding_only:
+            # chunked device loop: always K steps per dispatch — one compiled
+            # program per bucket; the stop/discard pass below drops any tokens
+            # past eos or max_new_tokens (the documented up-to-K-1 overshoot)
+            try:
+                import jax as _jax
+                toks = engine.decode_loop(
+                    batch_uids, batch_tokens, decode_chunk,
+                    temperature=float(temperature),
+                    rng=_jax.random.PRNGKey(seed + sum(len(o) for o in outputs.values()))
+                    if temperature > 0 else None)
+            except SchedulingError:
+                toks = None  # KV too tight for K steps — single-step fallback
+            if toks is not None:
+                for i, u in enumerate(batch_uids):
+                    stop = False
+                    for t in toks[i]:
+                        if stop:
+                            break  # discard over-generated tokens past eos
+                        finish_or_continue(u, int(t))
+                        stop = u in done
+                continue
+        logits = np.asarray(engine.put(batch_uids, batch_tokens))
+        for i, u in enumerate(batch_uids):
+            if u in pending:  # mid-prefill: ignore logits until prompt is consumed
+                continue
+            finish_or_continue(u, sample(logits[i]))
     return [outputs[u] for u in uids]
