@@ -53,6 +53,9 @@ def generate(engine: InferenceEngineV2,
     token); eos is checked between chunks, so a finished sequence over-
     generates up to K-1 discarded tokens before its KV blocks recycle — the
     standard chunked-serving tradeoff of host-RTT against speculative compute.
+    NOTE: with ``temperature > 0`` the chunked path samples on device from a
+    jax PRNG stream, so sampled outputs differ from ``decode_chunk=1`` (host
+    numpy stream) for the same seed; greedy output is identical either way.
     """
     rng = np.random.default_rng(seed)
     uids = list(range(len(prompts)))

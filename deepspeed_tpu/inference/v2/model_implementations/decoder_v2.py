@@ -34,26 +34,38 @@ def _linear(h, p):
     return out
 
 
-def _rotary_at_partial(x, pos, cos_tab, sin_tab, pct):
+def _rotary_at_partial(x, pos, cos_tab, sin_tab, pct, interleaved=False):
     if pct <= 0.0:
         return x
     D = x.shape[-1]
-    rot = int(D * pct) // 2 * 2
+    rot = int(round(D * pct)) // 2 * 2
     cos = cos_tab[pos][:, None, :]
     sin = sin_tab[pos][:, None, :]
     xr = x[..., :rot]
-    x1, x2 = jnp.split(xr, 2, axis=-1)
-    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    if interleaved:  # gptj: adjacent (even, odd) pairs rotate together
+        x1 = xr[..., 0::2]
+        x2 = xr[..., 1::2]
+        rotated = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1) \
+            .reshape(xr.shape)
+    else:            # llama/neox half-split
+        x1, x2 = jnp.split(xr, 2, axis=-1)
+        rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return jnp.concatenate([rotated.astype(x.dtype), x[..., rot:]], axis=-1)
 
 
 class DecoderV2Model(DSTransformerModelBase):
 
     def __init__(self, params, config: DecoderConfig, engine_config, state_manager=None):
+        if config.pos_embed == "alibi" or config.embed_layernorm:
+            # BEFORE super(): the base may quantize the whole tree first
+            raise NotImplementedError(
+                f"inference-v2 DecoderV2Model does not serve {config.model_type!r}: "
+                "ALiBi biases are not implemented in the paged attention paths — "
+                "use the v1 engine (init_inference over the converted checkpoint)")
         super().__init__(params, config, engine_config, state_manager)
         if config.pos_embed == "rotary":
             D = config.hidden_size // config.num_attention_heads
-            rot = int(D * config.rotary_pct) // 2 * 2
+            rot = int(round(D * config.rotary_pct)) // 2 * 2
             self._cos, self._sin = rotary_embedding(engine_config.state_manager.max_context,
                                                     rot, config.rope_theta, jnp.float32)
 
@@ -94,7 +106,10 @@ class DecoderV2Model(DSTransformerModelBase):
     def unembed(self, params, x):
         r = _root(params)
         x = _ln(x, r["final_layer_norm"], self._config.layer_norm_eps)
-        return x @ r["lm_head"]["kernel"].astype(x.dtype)
+        logits = x @ r["lm_head"]["kernel"].astype(x.dtype)
+        if "bias" in r["lm_head"]:  # gptj's biased head
+            logits = logits + r["lm_head"]["bias"].astype(x.dtype)
+        return logits
 
     def _attn(self, params, li, h, cache, attn_fn, batch):
         cfg = self._config
@@ -105,16 +120,18 @@ class DecoderV2Model(DSTransformerModelBase):
         v = _linear(h, ap["v_proj"]).reshape(-1, KVH, D)
         if cfg.pos_embed == "rotary":
             pos = batch["token_pos"]
-            q = _rotary_at_partial(q, pos, self._cos, self._sin, cfg.rotary_pct)
-            k = _rotary_at_partial(k, pos, self._cos, self._sin, cfg.rotary_pct)
+            q = _rotary_at_partial(q, pos, self._cos, self._sin, cfg.rotary_pct,
+                                   cfg.rotary_interleaved)
+            k = _rotary_at_partial(k, pos, self._cos, self._sin, cfg.rotary_pct,
+                                   cfg.rotary_interleaved)
         out, cache = attn_fn(q, k, v, cache, li)
         return _linear(out.reshape(h.shape[0], H * D), ap["out_proj"]), cache
 
     def _mlp(self, params, li, h):
         cfg = self._config
         mp = _root(params)[f"layers_{li}"]["mlp"]
-        act = jax.nn.relu if cfg.activation == "relu" else \
-            (lambda x: jax.nn.gelu(x, approximate=True))
+        from deepspeed_tpu.models.decoder import _act
+        act = _act(cfg)  # shared table: unknown activations fail loudly
         return _linear(act(_linear(h, mp["fc1"])), mp["fc2"])
 
     def layer_forward(self, params, li, x, cache, attn_fn, batch):
@@ -124,8 +141,10 @@ class DecoderV2Model(DSTransformerModelBase):
             x = self._add_positions(params, x, batch)
         if cfg.parallel_residual:
             h = _ln(x, lp["input_layernorm"], cfg.layer_norm_eps)
+            hm = _ln(x, lp["post_attention_layernorm"], cfg.layer_norm_eps) \
+                if cfg.parallel_mlp_norm else h
             attn_out, cache = self._attn(params, li, h, cache, attn_fn, batch)
-            return x + attn_out + self._mlp(params, li, h), cache
+            return x + attn_out + self._mlp(params, li, hm), cache
         h = _ln(x, lp["input_layernorm"], cfg.layer_norm_eps)
         attn_out, cache = self._attn(params, li, h, cache, attn_fn, batch)
         x = x + attn_out

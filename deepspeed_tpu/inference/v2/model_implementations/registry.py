@@ -52,6 +52,10 @@ def _register_builtin():
     register_policy("opt", DecoderConfig, DecoderV2Model)
     register_policy("falcon", DecoderConfig, DecoderV2Model)
     register_policy("phi", DecoderConfig, DecoderV2Model)
+    register_policy("gptj", DecoderConfig, DecoderV2Model)
+    register_policy("gpt_neox", DecoderConfig, DecoderV2Model)
+    # bloom (alibi) deliberately unregistered: DecoderV2Model raises with a
+    # pointer at the v1 path rather than serving wrong logits
 
 
 _register_builtin()

@@ -50,6 +50,8 @@ class DecoderConfig:
     mlp_bias: bool = True
     embed_layernorm: bool = False      # bloom's word_embeddings_layernorm
     parallel_mlp_norm: bool = False    # neox: separate norm for the parallel MLP
+    rotary_interleaved: bool = False   # gptj: adjacent-pair rotation
+    lm_head_bias: bool = False         # gptj's biased lm_head
     model_type: str = "decoder"
     dtype: any = jnp.float32
 
@@ -85,6 +87,16 @@ class DecoderConfig:
         base = dict(pos_embed="rotary", rotary_pct=0.25, parallel_residual=True,
                     parallel_mlp_norm=True, activation="gelu_exact",
                     attention_bias=True, mlp_bias=True, model_type="gpt_neox")
+        base.update(kw)
+        return cls(**base)
+
+    @classmethod
+    def gptj(cls, **kw):
+        # HF GPT-J: interleaved partial rotary, parallel attn+mlp off ONE
+        # norm, unbiased attention linears, biased MLP and lm_head
+        base = dict(pos_embed="rotary", rotary_interleaved=True, parallel_residual=True,
+                    activation="gelu", attention_bias=False, mlp_bias=True,
+                    lm_head_bias=True, model_type="gptj")
         base.update(kw)
         return cls(**base)
 
@@ -128,13 +140,28 @@ def alibi_slopes(num_heads: int) -> np.ndarray:
     return slopes.astype(np.float32)
 
 
-def partial_rotary(x, cos, sin, pct):
-    """Rotate only the first ``pct`` of head_dim (phi); pass-through the rest."""
+def apply_rotary_interleaved(x, cos, sin):
+    """GPT-J rotary convention: adjacent (even, odd) element PAIRS rotate
+    together (HF ``rotate_every_two``), vs the llama/neox half-split."""
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def partial_rotary(x, cos, sin, pct, interleaved=False):
+    """Rotate only the first ``pct`` of head_dim (phi/neox/gptj); pass-through
+    the rest."""
+    rot_fn = apply_rotary_interleaved if interleaved else apply_rotary
     if pct >= 1.0:
-        return apply_rotary(x, cos, sin)
+        return rot_fn(x, cos, sin)
     D = x.shape[-1]
-    rot = int(D * pct) // 2 * 2
-    return jnp.concatenate([apply_rotary(x[..., :rot], cos, sin), x[..., rot:]], axis=-1)
+    # round(): pct often arrives as rotary_dim/head_dim — truncation would
+    # silently shrink the rotated width below the checkpoint's integer dim
+    rot = int(round(D * pct)) // 2 * 2
+    return jnp.concatenate([rot_fn(x[..., :rot], cos, sin), x[..., rot:]], axis=-1)
 
 
 class DecoderAttention(nn.Module):
@@ -150,8 +177,8 @@ class DecoderAttention(nn.Module):
         k = dense(KVH * D, name="k_proj")(x).reshape(*x.shape[:-1], KVH, D)
         v = dense(KVH * D, name="v_proj")(x).reshape(*x.shape[:-1], KVH, D)
         if cfg.pos_embed == "rotary":
-            q = partial_rotary(q, cos, sin, cfg.rotary_pct)
-            k = partial_rotary(k, cos, sin, cfg.rotary_pct)
+            q = partial_rotary(q, cos, sin, cfg.rotary_pct, cfg.rotary_interleaved)
+            k = partial_rotary(k, cos, sin, cfg.rotary_pct, cfg.rotary_interleaved)
         if KVH != H:
             k = jnp.repeat(k, H // KVH, axis=2)
             v = jnp.repeat(v, H // KVH, axis=2)
@@ -219,12 +246,13 @@ class DecoderModel(nn.Module):
             x = x + wpe(pos_ids + cfg.learned_pos_offset)
         else:
             D = cfg.hidden_size // cfg.num_attention_heads
-            rot = int(D * cfg.rotary_pct) // 2 * 2
+            rot = int(round(D * cfg.rotary_pct)) // 2 * 2
             cos, sin = rotary_embedding(S, rot, cfg.rope_theta, jnp.float32)
         for i in range(cfg.num_hidden_layers):
             x = DecoderBlock(cfg, name=f"layers_{i}")(x, cos, sin, pos_ids)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="final_layer_norm")(x)
-        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head")(x)
+        return nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias, dtype=cfg.dtype,
+                        name="lm_head")(x)
 
 
 class DecoderForCausalLM(nn.Module):

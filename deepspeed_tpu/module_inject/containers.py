@@ -215,6 +215,42 @@ class GPTNeoXPolicy(_DecoderPolicy):
         return p
 
 
+@register_policy("gptj")
+class GPTJPolicy(_DecoderPolicy):
+    model_type = "gptj"
+
+    def build(self, hf_cfg):
+        from deepspeed_tpu.models.decoder import DecoderConfig, DecoderModel
+        n_embd = hf_cfg["n_embd"]
+        head_dim = n_embd // hf_cfg["n_head"]
+        cfg = DecoderConfig.gptj(
+            vocab_size=hf_cfg["vocab_size"], hidden_size=n_embd,
+            intermediate_size=hf_cfg.get("n_inner") or 4 * n_embd,
+            num_hidden_layers=hf_cfg["n_layer"], num_attention_heads=hf_cfg["n_head"],
+            num_key_value_heads=hf_cfg["n_head"],
+            max_position_embeddings=hf_cfg["n_positions"],
+            # HF default rotary_dim is 64; an explicit null means full-head
+            rotary_pct=1.0 if hf_cfg.get("rotary_dim", 64) is None
+            else hf_cfg.get("rotary_dim", 64) / head_dim,
+            layer_norm_eps=hf_cfg.get("layer_norm_epsilon", 1e-5), dtype=np.float32)
+        return DecoderModel(cfg), cfg
+
+    def convert(self, sd, hf_cfg):
+        p = {"embed_tokens": {"embedding": np.asarray(sd["transformer.wte.weight"])},
+             "final_layer_norm": _ln(sd, "transformer.ln_f"),
+             "lm_head": _dense(sd, "lm_head")}  # separate, biased
+        for i in range(hf_cfg["n_layer"]):
+            l = f"transformer.h.{i}"
+            p[f"layers_{i}"] = {
+                "input_layernorm": _ln(sd, f"{l}.ln_1"),
+                "self_attn": {f"{nm}_proj": _dense(sd, f"{l}.attn.{nm}_proj")
+                              for nm in ("q", "k", "v", "out")},
+                "mlp": {"fc1": _dense(sd, f"{l}.mlp.fc_in"),
+                        "fc2": _dense(sd, f"{l}.mlp.fc_out")},
+            }
+        return p
+
+
 @register_policy("bloom")
 class BloomPolicy(_DecoderPolicy):
     model_type = "bloom"

@@ -85,7 +85,7 @@ def test_qwen2_biases():
     _roundtrip(cfg, lambda c: init_params(c), LlamaModel)
 
 
-@pytest.mark.parametrize("variant", ["opt", "falcon", "phi"])
+@pytest.mark.parametrize("variant", ["opt", "falcon", "phi", "gptj", "gpt_neox"])
 def test_decoder_family(variant):
     from deepspeed_tpu.models.decoder import DecoderConfig, DecoderModel, init_params
     from deepspeed_tpu.inference.v2.model_implementations.decoder_v2 import DecoderV2Model
@@ -103,5 +103,19 @@ def test_registry_lists_reference_breadth():
         supported_model_types
 
     # the reference factory's model_type table (engine_factory.py:66-120)
-    for mt in ("llama", "mistral", "mixtral", "opt", "falcon", "phi", "qwen2"):
+    for mt in ("llama", "mistral", "mixtral", "opt", "falcon", "phi", "qwen2",
+               "gptj", "gpt_neox"):
         assert mt in supported_model_types()
+
+
+def test_bloom_v2_rejected_with_pointer():
+    """ALiBi is not implemented in the paged attention paths: serving a bloom
+    config through v2 must fail loudly with a pointer at the v1 engine, not
+    emit wrong logits through the isinstance fallback."""
+    from deepspeed_tpu.models.decoder import DecoderConfig, init_params
+
+    cfg = DecoderConfig.tiny("bloom")
+    groups.initialize_mesh(force=True)
+    _, params = init_params(cfg)
+    with pytest.raises(NotImplementedError, match="v1 engine"):
+        build_engine(params, cfg, _ecfg())

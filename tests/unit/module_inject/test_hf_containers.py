@@ -34,6 +34,10 @@ def _hf_tiny(model_type):
                                max_position_embeddings=32, rotary_pct=0.25,
                                use_parallel_residual=True)
         return tf.GPTNeoXForCausalLM(cfg)
+    if model_type == "gptj":
+        cfg = tf.GPTJConfig(vocab_size=128, n_positions=32, n_embd=32, n_layer=2,
+                            n_head=2, rotary_dim=8)
+        return tf.GPTJForCausalLM(cfg)
     if model_type == "bloom":
         cfg = tf.BloomConfig(vocab_size=128, hidden_size=32, n_layer=2, n_head=2)
         return tf.BloomForCausalLM(cfg)
@@ -60,7 +64,7 @@ def _torch_logits(m, ids):
     return out.last_hidden_state.float().numpy()
 
 
-CAUSAL = ["gpt2", "opt", "gpt_neox", "bloom"]
+CAUSAL = ["gpt2", "opt", "gpt_neox", "gptj", "bloom"]
 
 
 @pytest.mark.parametrize("model_type", CAUSAL + ["bert"])
@@ -153,4 +157,4 @@ def test_unknown_model_type_raises(tmp_path):
     (p / "config.json").write_text(json.dumps({"model_type": "mystery"}))
     with pytest.raises(NotImplementedError, match="mystery"):
         load_hf_checkpoint(str(p))
-    assert {"gpt2", "opt", "gpt_neox", "bloom", "bert", "llama"} <= set(supported_model_types())
+    assert {"gpt2", "opt", "gpt_neox", "gptj", "bloom", "bert", "llama"} <= set(supported_model_types())
