@@ -16,7 +16,7 @@ from deepspeed_tpu.inference.v2.model_implementations.llama_v2 import _root, rot
 from deepspeed_tpu.inference.v2.model_implementations.transformer_base import \
     DSTransformerModelBase
 from deepspeed_tpu.inference.v2.tracer import record
-from deepspeed_tpu.models.decoder import DecoderConfig
+from deepspeed_tpu.models.decoder import DecoderConfig, _act
 
 
 def _ln(x, p, eps):
@@ -130,7 +130,6 @@ class DecoderV2Model(DSTransformerModelBase):
     def _mlp(self, params, li, h):
         cfg = self._config
         mp = _root(params)[f"layers_{li}"]["mlp"]
-        from deepspeed_tpu.models.decoder import _act
         act = _act(cfg)  # shared table: unknown activations fail loudly
         return _linear(act(_linear(h, mp["fc1"])), mp["fc2"])
 

@@ -223,7 +223,14 @@ class GPTJPolicy(_DecoderPolicy):
         from deepspeed_tpu.models.decoder import DecoderConfig, DecoderModel
         n_embd = hf_cfg["n_embd"]
         head_dim = n_embd // hf_cfg["n_head"]
+        act = {"gelu_new": "gelu", "gelu": "gelu_exact", "relu": "relu"}.get(
+            hf_cfg.get("activation_function", "gelu_new"))
+        if act is None:
+            raise NotImplementedError(
+                f"gptj activation_function={hf_cfg.get('activation_function')!r} has no "
+                "mapped implementation — refusing to serve wrong logits")
         cfg = DecoderConfig.gptj(
+            activation=act,
             vocab_size=hf_cfg["vocab_size"], hidden_size=n_embd,
             intermediate_size=hf_cfg.get("n_inner") or 4 * n_embd,
             num_hidden_layers=hf_cfg["n_layer"], num_attention_heads=hf_cfg["n_head"],

@@ -103,12 +103,23 @@ def test_init_inference_loads_checkpoint_end_to_end(tmp_path):
     ids = np.arange(8, dtype=np.int32)[None] % 128
     logits = np.asarray(eng(jnp.asarray(ids)))
     np.testing.assert_allclose(logits, _torch_logits(m, ids), rtol=RTOL, atol=ATOL)
-    out = eng.generate(jnp.asarray(ids), max_new_tokens=4)
+    out = np.asarray(eng.generate(jnp.asarray(ids), max_new_tokens=4))
     assert out.shape == (1, 12)
-    # greedy continuation matches torch's
-    hf_out = m.generate(torch.asarray(ids), max_new_tokens=4, do_sample=False,
-                        pad_token_id=0)
-    np.testing.assert_array_equal(np.asarray(out), hf_out.numpy())
+    # greedy continuation matches torch's — token-by-token, stopping at the
+    # first near-tie (random tiny-model weights put top-2 logit gaps inside
+    # the cross-framework noise floor, where argmax legitimately flips)
+    ctx = ids.copy()
+    for step in range(4):
+        with torch.no_grad():
+            row = m(torch.asarray(ctx)).logits[0, -1].float().numpy()
+        want = int(np.argmax(row))
+        got = int(out[0, ids.shape[1] + step])
+        if got != want:
+            top2 = np.sort(row)[-2:]
+            assert top2[1] - top2[0] < 1e-3, \
+                f"step {step}: got {got}, torch {want}, gap {top2[1]-top2[0]}"
+            break  # sequences legitimately diverge after a tie
+        ctx = np.concatenate([ctx, [[want]]], axis=1)
 
 
 def test_init_inference_with_tp2(tmp_path):
