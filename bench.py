@@ -1,33 +1,152 @@
-"""Benchmark harness (driver contract: print ONE JSON line).
+"""Benchmark harness (driver contract: print ONE JSON line, exit 0 ALWAYS).
 
-Measures single-chip Llama training-step throughput (tokens/sec) and MFU against
-the chip's bf16 peak. ``vs_baseline`` = MFU / 0.45 — the BASELINE.json north-star
-is ZeRO-3 Llama SFT at >=45% MFU, so 1.0 means parity with the target.
+Structure (crash/hang-proof — VERDICT r4 weak #1):
+- The top-level process is an ORCHESTRATOR that never imports jax. A dead
+  axon tunnel does not merely raise — it can HANG ``jax.devices()`` forever —
+  so the backend probe and the measurement body both run in subprocesses
+  with timeouts.
+- The measurement body (``--worker <backend> <result.json>``) checkpoints its
+  results to ``result.json`` after every leg; if the worker dies or hangs
+  mid-leg, the orchestrator still harvests the completed legs and reports
+  them with ``"partial": true``.
+- If the TPU is unreachable the orchestrator emits a structured
+  ``{"skipped": "tpu_unavailable", ...}`` line with CPU smoke numbers and
+  exits 0 — the driver must never record a stack trace as the round's perf
+  artifact.
 
-Config (chosen by sweep on a real v5e chip, 2026-07):
-- 530M-param Llama (hidden 2048, 8 layers, heads 16/128) — the largest
-  Llama-class model that fits one 16 GB chip with fp32 master + Adam moments
-  (ZeRO-3 semantics; on one chip the sharding is trivial but the config matches
-  BASELINE.md milestone #2/#3 shape).
-- seq 1024, micro-batch 8, GAS 8: gradient accumulation amortizes the
-  optimizer/master-weight HBM traffic (~25 GB/step) over 8 micro-steps — the
-  same reason the reference overlaps its optimizer with comm.
-- remat with the dots-saveable policy (recompute elementwise only); plain XLA
-  attention — measured faster than the Pallas flash path at S<=2048 (flash wins
-  at long sequence where the S^2 buffers stop fitting; see
-  ops/pallas/flash_attention.py).
+Measurement targets (single chip, v5e):
+- Headline: 530M-param Llama training step, ZeRO-3 semantics, bf16 + fp32
+  master, B=8 GAS=8 S=1024, remat=dots — ``vs_baseline`` = MFU / 0.45 (the
+  BASELINE.json north star is ZeRO-3 Llama SFT at >=45% MFU).
+- Long-seq flash leg: S=4096 Pallas flash fwd+bwd vs dense.
+- Inference: prefill + on-device decode_loop, Pallas paged kernel vs XLA
+  gather (two-point differenced; the tunnel has ~100ms dispatch RTT and
+  memoizes identical dispatches, so per-call timing of repeated identical
+  programs is garbage — chain data, difference two N's, barrier via a host
+  float() fetch).
+- Block-sparse attention at 8k seq; evoformer at AF2 MSA shapes.
 
 FLOPs model: 6*(N - N_embed) dense (fwd+bwd) + 12*L*S*H attention per token
-(PaLM-appendix MFU convention, causal not discounted; embedding lookup excluded).
+(PaLM-appendix MFU convention, causal not discounted; embedding lookup
+excluded).
 """
 
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
 
-import numpy as np
+PROBE_TIMEOUT_S = 150          # dead tunnel: jax.devices() hangs, not raises
+TPU_WORKER_TIMEOUT_S = 55 * 60  # full TPU bench historically ~25-35 min
+CPU_WORKER_TIMEOUT_S = 15 * 60
 
+
+# --------------------------------------------------------------------------
+# orchestrator (no jax imports at this level)
+# --------------------------------------------------------------------------
+
+def _probe_tpu():
+    """Ask a subprocess whether the TPU backend answers. Returns (ok, why)."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return False, "JAX_PLATFORMS=cpu in environment"
+    code = "import jax; jax.devices(); print(jax.default_backend())"
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=PROBE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        return False, f"backend probe hung >{PROBE_TIMEOUT_S}s (tunnel dead?)"
+    if r.returncode != 0:
+        return False, f"backend probe rc={r.returncode}: {(r.stderr or '').strip()[-300:]}"
+    backend = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+    if backend != "tpu":
+        return False, f"default backend is {backend!r}, not tpu"
+    return True, ""
+
+
+def _run_worker(backend, timeout):
+    """Run the measurement body in a subprocess; harvest its checkpoint file.
+
+    Returns (result_dict, rc, err_tail). rc -1 = timeout. The checkpoint file
+    is written after every completed leg, so a mid-leg death still yields the
+    finished legs.
+    """
+    fd, path = tempfile.mkstemp(suffix=".json", prefix="bench_")
+    os.close(fd)
+    env = dict(os.environ)
+    if backend == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    rc, err = 0, ""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker", backend, path],
+            capture_output=True, text=True, timeout=timeout, env=env)
+        rc = proc.returncode
+        err = (proc.stderr or "").strip()[-400:]
+    except subprocess.TimeoutExpired:
+        rc, err = -1, f"worker timed out after {timeout}s"
+    except Exception as e:  # noqa: BLE001 — never let the orchestrator die
+        rc, err = -2, repr(e)[:400]
+    result = {}
+    try:
+        with open(path) as f:
+            result = json.load(f)
+    except Exception:
+        pass
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+    return result, rc, err
+
+
+def _emit(payload):
+    print(json.dumps(payload))
+    sys.stdout.flush()
+
+
+def main():
+    tpu_ok, why = _probe_tpu()
+
+    if tpu_ok:
+        res, rc, err = _run_worker("tpu", TPU_WORKER_TIMEOUT_S)
+        if res.get("tokens_per_sec"):
+            extra = res.get("extra", {})
+            extra.update({k: v for k, v in res.items()
+                          if k not in ("tokens_per_sec", "mfu", "extra", "done")})
+            out = {
+                "metric": "llama_train_tokens_per_sec_per_chip",
+                "value": round(res["tokens_per_sec"], 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(res["mfu"] / 0.45, 4),
+                "extra": extra,
+            }
+            if not res.get("done"):
+                out["partial"] = True
+                out["partial_reason"] = f"worker rc={rc}: {err}"
+            _emit(out)
+            return
+        why = f"tpu worker produced no headline number (rc={rc}): {err}"
+
+    # TPU unreachable or its worker died before the headline leg: structured
+    # skip + CPU smoke numbers so the artifact is still machine-readable.
+    res, rc, err = _run_worker("cpu", CPU_WORKER_TIMEOUT_S)
+    out = {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(res.get("tokens_per_sec", 0.0), 1),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "skipped": "tpu_unavailable",
+        "skip_reason": why,
+        "extra": {"cpu_smoke": res} if res else {"cpu_smoke_error": f"rc={rc}: {err}"},
+    }
+    _emit(out)
+
+
+# --------------------------------------------------------------------------
+# worker (imports jax; checkpoints to the result file after every leg)
+# --------------------------------------------------------------------------
 
 def _peak_flops():
     """bf16 peak per chip."""
@@ -63,8 +182,8 @@ def _flops_per_token(cfg, n_params, S):
 def _bench_long_seq(llama, groups, jnp, peak):
     """Long-sequence training leg (VERDICT r3 #10): S=4096, Pallas flash
     attention vs dense — flash must win (dense OOMs outright at 8k on 16 GB)."""
-    import time
     import jax
+    import numpy as np
     import deepspeed_tpu
 
     B, S, GAS = 1, 4096, 4
@@ -115,8 +234,8 @@ def _bench_inference(llama, groups, jnp):
       greedy steps as a lax.scan), two-point differenced between N1 and N2
       steps — device-bound, elision-proof (metadata advances every call).
     """
-    import time
     import jax
+    import numpy as np
     from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
     from deepspeed_tpu.inference.v2.engine_factory import build_engine
     from deepspeed_tpu.inference.v2.ragged.manager_configs import (AllocationMode,
@@ -197,12 +316,63 @@ def _bench_inference(llama, groups, jnp):
     return out
 
 
+def _bench_int4_weights(llama, groups, jnp):
+    """ZeRO-Inference weight-quantization leg (VERDICT r5 ask #5): decode
+    throughput with bf16 vs int8 vs int4 weights — weight-only quantization
+    pays off when decode is weight-bandwidth-bound."""
+    import numpy as np
+    from deepspeed_tpu.inference.v2.config_v2 import (QuantizationConfig,
+                                                      RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.engine_factory import build_engine
+    from deepspeed_tpu.inference.v2.ragged.manager_configs import (AllocationMode,
+                                                                   DSStateManagerConfig,
+                                                                   MemoryConfig)
+
+    groups.initialize_mesh(force=True)
+    MAXCTX, CTX = 2048, 512
+    N1, N2 = 16, 112
+    cfg = _llama_530m(llama, jnp, MAXCTX)
+    _, params = llama.init_params(cfg, seq_len=16)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, CTX)
+
+    out = {"context": CTX}
+    for bits, key in ((None, "bf16"), (8, "int8"), (4, "int4")):
+        mgr = DSStateManagerConfig(memory_config=MemoryConfig(mode=AllocationMode.ALLOCATE,
+                                                              size=512),
+                                   max_context=MAXCTX, max_ragged_batch_size=2048,
+                                   max_ragged_sequence_count=8)
+        eng = build_engine(params, cfg,
+                           RaggedInferenceEngineConfig(
+                               state_manager=mgr, kv_block_size=16,
+                               quantization=QuantizationConfig(enabled=bits is not None,
+                                                               bits=bits or 8)))
+        pre = eng.put([0], [prompt])
+        first = np.asarray([int(np.argmax(np.asarray(pre)[0]))], np.int32)
+        nxt = eng.decode_loop([0], [first], N1)[:, -1]   # compile N1
+        nxt = eng.decode_loop([0], [nxt], N2)[:, -1]     # compile N2
+        t0 = time.perf_counter()
+        nxt = eng.decode_loop([0], [nxt], N1)[:, -1]
+        t_n1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        toks = eng.decode_loop([0], [nxt], N2)
+        t_n2 = time.perf_counter() - t0
+        if t_n2 > t_n1:
+            tps = (N2 - N1) / (t_n2 - t_n1)
+        else:
+            tps = N2 / t_n2
+        out[key] = {"decode_tokens_per_sec": round(tps, 1)}
+        del eng
+    out["int4_vs_bf16"] = round(out["int4"]["decode_tokens_per_sec"] /
+                                max(out["bf16"]["decode_tokens_per_sec"], 1e-9), 2)
+    return out
+
+
 def _bench_sparse_attention(jnp):
     """Block-sparse attention leg (VERDICT r4 #4): 8k sequence — where dense
     S² scores OOM on 16 GB — BigBird layouts at two densities; fwd+bwd time
     must scale with layout density. Timing: chained on-device scans, two-point
     differenced, with a host value fetch as the barrier."""
-    import time
     import jax
     from deepspeed_tpu.ops.pallas.block_sparse_attention import block_sparse_attention
     from deepspeed_tpu.ops.sparse_attention.sparsity_config import BigBirdSparsityConfig
@@ -262,7 +432,6 @@ def _bench_evoformer(jnp, peak):
     without remat — the measured justification for not hand-writing the
     reference's 15k-LoC CUTLASS tier. Two-point differenced scans, host-fetch
     barrier."""
-    import time
     import jax
     from deepspeed_tpu.ops.evoformer import DS4Sci_EvoformerAttention
 
@@ -323,13 +492,31 @@ def _bench_evoformer(jnp, peak):
             "remat_time_ratio": round(remat / max(plain, 1e-12), 2)}
 
 
-def main():
+def _worker(backend, result_path):
+    """Measurement body. Writes the accumulating result dict to result_path
+    after every leg so a mid-leg crash/hang still leaves evidence."""
+    if backend == "cpu":
+        # site hooks (the axon TPU shim) override JAX_PLATFORMS at startup;
+        # re-assert cpu before any backend touch or the smoke worker hangs
+        # on a dead tunnel
+        from deepspeed_tpu.utils.jax_platform import honor_platform_env
+        honor_platform_env(default="cpu")
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     import deepspeed_tpu
     from deepspeed_tpu.models import llama
     from deepspeed_tpu.utils import groups
+
+    acc = {}
+
+    def save():
+        # atomic: a timeout kill mid-write must not truncate the finished legs
+        tmp = result_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(acc, f)
+        os.replace(tmp, result_path)
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
@@ -390,45 +577,52 @@ def main():
     tokens_per_sec = B * GAS * S / step_time
     mfu = tokens_per_sec * _flops_per_token(cfg, n_params, S) / _peak_flops()
 
-    extra = {
+    acc.update({
+        "tokens_per_sec": tokens_per_sec,
         "mfu": round(mfu, 4),
-        "n_params": n_params,
-        "batch": B,
-        "gas": GAS,
-        "seq": S,
-        "zero_stage": STAGE,
-        "backend": jax.default_backend(),
-        "device": str(jax.devices()[0]),
-        "loss_final": float(loss),
-    }
+        "extra": {
+            "mfu": round(mfu, 4),
+            "n_params": n_params,
+            "batch": B,
+            "gas": GAS,
+            "seq": S,
+            "zero_stage": STAGE,
+            "backend": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+            "loss_final": float(loss),
+        },
+    })
+    save()
+
     if on_tpu:
         # free the training engine's HBM before the other legs
         del engine, params
-        try:
-            extra["long_seq_train"] = _bench_long_seq(llama, groups, jnp, _peak_flops())
-        except Exception as e:
-            extra["long_seq_train"] = {"error": str(e)[:200]}
-        try:
-            extra["inference"] = _bench_inference(llama, groups, jnp)
-        except Exception as e:
-            extra["inference"] = {"error": str(e)[:200]}
-        try:
-            extra["sparse_attention"] = _bench_sparse_attention(jnp)
-        except Exception as e:
-            extra["sparse_attention"] = {"error": str(e)[:200]}
-        try:
-            extra["evoformer"] = _bench_evoformer(jnp, _peak_flops())
-        except Exception as e:
-            extra["evoformer"] = {"error": str(e)[:200]}
+        legs = (
+            ("long_seq_train", lambda: _bench_long_seq(llama, groups, jnp, _peak_flops())),
+            ("inference", lambda: _bench_inference(llama, groups, jnp)),
+            ("int4_weights", lambda: _bench_int4_weights(llama, groups, jnp)),
+            ("sparse_attention", lambda: _bench_sparse_attention(jnp)),
+            ("evoformer", lambda: _bench_evoformer(jnp, _peak_flops())),
+        )
+        for name, fn in legs:
+            try:
+                acc["extra"][name] = fn()
+            except Exception as e:  # noqa: BLE001 — a leg must not kill the bench
+                acc["extra"][name] = {"error": str(e)[:200]}
+            save()
 
-    print(json.dumps({
-        "metric": "llama_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.45, 4),
-        "extra": extra,
-    }))
+    acc["done"] = True
+    save()
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 4 and sys.argv[1] == "--worker":
+        _worker(sys.argv[2], sys.argv[3])
+    else:
+        try:
+            main()
+        except Exception as e:  # noqa: BLE001 — the driver contract is rc=0 + one JSON line
+            _emit({"metric": "llama_train_tokens_per_sec_per_chip", "value": 0.0,
+                   "unit": "tokens/s", "vs_baseline": 0.0,
+                   "skipped": "bench_orchestrator_error", "skip_reason": repr(e)[:400]})
+        sys.exit(0)

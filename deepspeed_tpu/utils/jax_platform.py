@@ -1,0 +1,22 @@
+"""Platform-selection helper shared by every subprocess entry point.
+
+Site hooks (the axon TPU shim registers via sitecustomize) may force their
+platform into ``jax.config`` at interpreter startup, OVERRIDING the
+``JAX_PLATFORMS`` environment variable. Any process that must honor an
+explicit platform choice (cpu-pinned autotuning experiments, the bench
+smoke worker, CLI tools under test) has to re-assert it through
+``jax.config.update`` before the first backend touch — otherwise a
+cpu-pinned child hangs forever initializing a dead TPU tunnel.
+"""
+
+import os
+
+
+def honor_platform_env(default: str = "") -> None:
+    """Re-assert ``JAX_PLATFORMS`` (or ``default``) over any site-hook
+    override. No-op when neither is set. Must run before jax touches a
+    backend."""
+    plat = os.environ.get("JAX_PLATFORMS", "").strip() or default
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)

@@ -14,6 +14,10 @@ platform here overrides it for tests).
 import os
 
 os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+# env (not just jax.config) so test-SPAWNED subprocesses — launcher e2e,
+# autotuning experiments — inherit the cpu platform instead of hanging on a
+# dead/absent TPU tunnel
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
 
