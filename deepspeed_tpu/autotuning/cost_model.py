@@ -51,7 +51,10 @@ class AnalyticCostModel:
         remat = bool(cfg.get("remat", True))
         Z = self.zero_degree
         p = self.n_params
-        master = 4 * p / (Z if stage >= 3 else 1)
+        # the fp32 master copy is optimizer state: ZeRO shards it from stage 1
+        # (charging it unsharded at stages 1/2 over-estimates by ~4P(1-1/Z)
+        # and prunes viable candidates as predicted-OOM)
+        master = 4 * p / (Z if stage >= 1 else 1)
         compute = 2 * p  # bf16 copy is materialized per step regardless of stage
         grads = 4 * p / (Z if stage >= 2 else 1)
         opt = 8 * p / (Z if stage >= 1 else 1)

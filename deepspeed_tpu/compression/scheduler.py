@@ -42,10 +42,16 @@ class CompressionScheduler:
                 "frequency": int(shared.get("frequency", 0)),  # 0 = apply once
                 "eigenvalue_gated": bool(shared.get("eigenvalue_gated", False)),
                 "eigenvalue_threshold": float(shared.get("eigenvalue_threshold", 1.0)),
+                "eigenvalue_frequency": int(shared.get("eigenvalue_frequency", 100)),
                 "active": False,
                 "last_applied": -1,
             }
         self.training_steps = 0
+        # curvature probes are expensive (a power iteration of HVPs costs a
+        # large multiple of a train step) — probe on the gated techniques'
+        # interval and reuse the cached value between probes
+        self._last_probe_step = -1
+        self._last_curvature: Optional[float] = None
 
     @property
     def enabled(self) -> bool:
@@ -81,7 +87,14 @@ class CompressionScheduler:
         self.training_steps = engine.global_steps
         curvature = None
         if self.needs_curvature(self.training_steps):
-            curvature = engine.loss_curvature()
+            interval = min(t["eigenvalue_frequency"] for t in self.techniques.values()
+                           if t["eigenvalue_gated"] and not t["active"]
+                           and self.training_steps >= t["offset"])
+            if (self._last_probe_step < 0
+                    or self.training_steps - self._last_probe_step >= max(interval, 1)):
+                self._last_curvature = engine.loss_curvature()
+                self._last_probe_step = self.training_steps
+            curvature = self._last_curvature
         due = self.techniques_due(self.training_steps, curvature)
         if not due:
             return
@@ -100,11 +113,15 @@ class CompressionScheduler:
     # ---------------------------------------------------------- checkpointing --
     def state_dict(self):
         return {"training_steps": self.training_steps,
+                "last_probe_step": self._last_probe_step,
+                "last_curvature": self._last_curvature,
                 "techniques": {k: {kk: v[kk] for kk in ("active", "last_applied")}
                                for k, v in self.techniques.items()}}
 
     def load_state_dict(self, sd):
         self.training_steps = sd["training_steps"]
+        self._last_probe_step = sd.get("last_probe_step", -1)
+        self._last_curvature = sd.get("last_curvature")
         for k, st in sd.get("techniques", {}).items():
             if k in self.techniques:
                 self.techniques[k].update(st)

@@ -146,6 +146,21 @@ class OPTPolicy(_DecoderPolicy):
 
     def build(self, hf_cfg):
         from deepspeed_tpu.models.decoder import DecoderConfig, DecoderModel
+        # Reject variants whose tensor names/shapes match but whose math does
+        # not (silent-wrong-logits hazard): post-layernorm OPT (opt-350m style
+        # do_layer_norm_before=False) and projected embeddings
+        # (word_embed_proj_dim != hidden_size, e.g. opt-350m's 512→1024).
+        if not hf_cfg.get("do_layer_norm_before", True):
+            raise NotImplementedError(
+                "OPT with do_layer_norm_before=False (post-layernorm, opt-350m "
+                "style) is not supported: DecoderConfig.opt builds a "
+                "pre-layernorm block, so conversion would succeed and serve "
+                "silently wrong logits.")
+        if hf_cfg.get("word_embed_proj_dim", hf_cfg["hidden_size"]) != hf_cfg["hidden_size"]:
+            raise NotImplementedError(
+                "OPT with word_embed_proj_dim != hidden_size (projected "
+                "embeddings, opt-350m style) is not supported by this "
+                "container.")
         cfg = DecoderConfig.opt(
             vocab_size=hf_cfg["vocab_size"], hidden_size=hf_cfg["hidden_size"],
             intermediate_size=hf_cfg["ffn_dim"], num_hidden_layers=hf_cfg["num_hidden_layers"],
@@ -380,6 +395,224 @@ class LlamaPolicy(HFPolicy):
         return p
 
 
+@register_policy("gpt_neo")
+class GPTNeoPolicy(_DecoderPolicy):
+    """Reference containers/gptneo.py (HFGPTNEOLayerPolicy). Quirks mapped:
+    UNSCALED attention scores, unbiased q/k/v with biased out_proj, and the
+    alternating global/local (sliding-window) attention layer pattern."""
+
+    model_type = "gpt_neo"
+
+    @staticmethod
+    def _expand_attention_types(hf_cfg):
+        out = []
+        for kinds, repeat in hf_cfg.get("attention_types", [[["global"], hf_cfg["num_layers"]]]):
+            for _ in range(repeat):
+                out.extend(kinds)
+        if len(out) != hf_cfg["num_layers"]:
+            raise ValueError(f"attention_types expands to {len(out)} entries "
+                             f"for {hf_cfg['num_layers']} layers")
+        return tuple(out[:hf_cfg["num_layers"]])
+
+    def build(self, hf_cfg):
+        from deepspeed_tpu.models.decoder import DecoderConfig, DecoderModel
+        act = {"gelu_new": "gelu", "gelu": "gelu_exact", "relu": "relu"}.get(
+            hf_cfg.get("activation_function", "gelu_new"))
+        if act is None:
+            raise NotImplementedError(
+                f"gpt_neo activation_function={hf_cfg.get('activation_function')!r} has "
+                "no mapped implementation — refusing to serve wrong logits")
+        hidden = hf_cfg["hidden_size"]
+        cfg = DecoderConfig.gpt_neo(
+            activation=act,
+            vocab_size=hf_cfg["vocab_size"], hidden_size=hidden,
+            intermediate_size=hf_cfg.get("intermediate_size") or 4 * hidden,
+            num_hidden_layers=hf_cfg["num_layers"],
+            num_attention_heads=hf_cfg["num_heads"],
+            num_key_value_heads=hf_cfg["num_heads"],
+            max_position_embeddings=hf_cfg["max_position_embeddings"],
+            layer_norm_eps=hf_cfg.get("layer_norm_epsilon", 1e-5),
+            attention_layers=self._expand_attention_types(hf_cfg),
+            window_size=hf_cfg.get("window_size", 256), dtype=np.float32)
+        return DecoderModel(cfg), cfg
+
+    def convert(self, sd, hf_cfg):
+        wte = np.asarray(sd["transformer.wte.weight"])
+        p = {"embed_tokens": {"embedding": wte},
+             "embed_positions": {"embedding": np.asarray(sd["transformer.wpe.weight"])},
+             "final_layer_norm": _ln(sd, "transformer.ln_f"),
+             "lm_head": {"kernel": _t(wte)}}  # tied
+        for i in range(hf_cfg["num_layers"]):
+            l = f"transformer.h.{i}"
+            p[f"layers_{i}"] = {
+                "input_layernorm": _ln(sd, f"{l}.ln_1"),
+                "self_attn": {k: _dense(sd, f"{l}.attn.attention.{k}")
+                              for k in ("q_proj", "k_proj", "v_proj", "out_proj")},
+                "post_attention_layernorm": _ln(sd, f"{l}.ln_2"),
+                "mlp": {"fc1": _dense(sd, f"{l}.mlp.c_fc"),  # Linear: transpose
+                        "fc2": _dense(sd, f"{l}.mlp.c_proj")},
+            }
+        return p
+
+
+@register_policy("internlm")
+class InternLMPolicy(HFPolicy):
+    """Reference containers/internlm.py. InternLM-1 is the llama architecture
+    with biases on all four attention projections (``bias: true``); the MLP
+    stays unbiased gated-SiLU."""
+
+    model_type = "internlm"
+
+    def build(self, hf_cfg):
+        from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+        import jax.numpy as jnp
+        bias = bool(hf_cfg.get("bias", True))
+        cfg = LlamaConfig(vocab_size=hf_cfg["vocab_size"], hidden_size=hf_cfg["hidden_size"],
+                          intermediate_size=hf_cfg["intermediate_size"],
+                          num_hidden_layers=hf_cfg["num_hidden_layers"],
+                          num_attention_heads=hf_cfg["num_attention_heads"],
+                          num_key_value_heads=hf_cfg.get("num_key_value_heads",
+                                                         hf_cfg["num_attention_heads"]),
+                          max_position_embeddings=hf_cfg["max_position_embeddings"],
+                          rope_theta=hf_cfg.get("rope_theta", 1e4),
+                          rms_norm_eps=hf_cfg.get("rms_norm_eps", 1e-6),
+                          attention_bias=bias, attention_out_bias=bias,
+                          dtype=jnp.float32)
+        return LlamaModel(cfg), cfg
+
+    def convert(self, sd, hf_cfg):
+        # same tensor names as llama; _dense picks up the biases when present
+        return _POLICIES["llama"].convert(sd, hf_cfg)
+
+
+@register_policy("megatron_gpt")
+@register_policy("megatron-gpt")
+class MegatronGPTPolicy(HFPolicy):
+    """Reference containers/megatron_gpt.py (MEGATRONLayerPolicy). Converts a
+    Megatron-LM GPT checkpoint (``language_model.*`` naming, fused QKV whose
+    layout depends on ``checkpoint_version`` — see
+    runtime/state_dict_factory.py:16) onto the native GPT-2 module: the
+    megatron-gpt2 architecture IS gpt2 (learned positions, tanh-gelu, scaled
+    attention), only the storage differs (Linear [out,in] vs Conv1D
+    [in,out]; sectioned vs per-head-interleaved QKV)."""
+
+    model_type = "megatron_gpt"
+
+    def build(self, hf_cfg):
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+        cfg = GPT2Config(vocab_size=hf_cfg["padded_vocab_size"],
+                         n_positions=hf_cfg["max_position_embeddings"],
+                         n_embd=hf_cfg["hidden_size"], n_layer=hf_cfg["num_layers"],
+                         n_head=hf_cfg["num_attention_heads"],
+                         layer_norm_epsilon=hf_cfg.get("layernorm_epsilon", 1e-5),
+                         dtype=np.float32)
+        return GPT2Model(cfg), cfg
+
+    @staticmethod
+    def _qkv_to_sections(w, b, num_heads, ckpt_ver):
+        """Fused QKV → gpt2 c_attn layout ([in, 3h], q|k|v sections).
+
+        ver 0:   [(3*np*hn), h] — sections are already contiguous.
+        ver 1.0: [(np*hn*3), h] — per head, q/k/v vary FASTEST ([np, hn, 3]).
+        ver 2.0: [(np*3*hn), h] — per-head q|k|v blocks ([np, 3, hn]).
+        (state_dict_factory.py:137 documents the same three layouts; silently
+        applying the wrong one scrambles heads, so unknown versions raise.)"""
+        w = np.asarray(w)
+        three_h, hidden = w.shape
+        D = three_h // (3 * num_heads)
+        ver = float(ckpt_ver)
+        if ver == 0:
+            kernel = _t(w)
+            bias = None if b is None else np.asarray(b)
+        elif ver in (1.0, 2.0):
+            if ver == 1.0:
+                wr = np.moveaxis(w.reshape(num_heads, D, 3, hidden), 2, 1)
+                br = None if b is None else np.moveaxis(
+                    np.asarray(b).reshape(num_heads, D, 3), 2, 1)
+            else:
+                wr = w.reshape(num_heads, 3, D, hidden)
+                br = None if b is None else np.asarray(b).reshape(num_heads, 3, D)
+            kernel = _t(np.concatenate([wr[:, j].reshape(num_heads * D, hidden)
+                                        for j in range(3)], axis=0))
+            bias = None if br is None else np.concatenate(
+                [br[:, j].reshape(num_heads * D) for j in range(3)])
+        else:
+            raise NotImplementedError(
+                f"megatron checkpoint_version {ckpt_ver} fused-QKV layout unknown "
+                "(supported: 0, 1.0, 2.0) — refusing to scramble heads")
+        out = {"kernel": kernel}
+        if bias is not None:
+            out["bias"] = bias
+        return out
+
+    def convert(self, sd, hf_cfg):
+        H = hf_cfg["num_attention_heads"]
+        ver = hf_cfg.get("checkpoint_version", 0)
+        lm = "language_model"
+        # newer megatron nests layers under .encoder, older under .transformer
+        enc = f"{lm}.encoder" if any(k.startswith(f"{lm}.encoder.") for k in sd) \
+            else f"{lm}.transformer"
+        p = {"wte": {"embedding": np.asarray(sd[f"{lm}.embedding.word_embeddings.weight"])},
+             "wpe": {"embedding": np.asarray(sd[f"{lm}.embedding.position_embeddings.weight"])},
+             "ln_f": _ln(sd, f"{enc}.final_layernorm")}
+        for i in range(hf_cfg["num_layers"]):
+            l = f"{enc}.layers.{i}"
+            p[f"h_{i}"] = {
+                "ln_1": _ln(sd, f"{l}.input_layernorm"),
+                "c_attn": self._qkv_to_sections(
+                    sd[f"{l}.attention.query_key_value.weight"],
+                    sd.get(f"{l}.attention.query_key_value.bias"), H, ver),
+                "c_proj": _dense(sd, f"{l}.attention.dense"),  # Linear: transpose
+                "ln_2": _ln(sd, f"{l}.post_attention_layernorm"),
+                "c_fc": _dense(sd, f"{l}.mlp.dense_h_to_4h"),
+                "mlp_c_proj": _dense(sd, f"{l}.mlp.dense_4h_to_h"),
+            }
+        return p
+
+
+@register_policy("distilbert")
+class DistilBertPolicy(HFPolicy):
+    """Reference containers/distil_bert.py (HFDistilBertLayerPolicy).
+    DistilBERT = BERT minus token-type embeddings and pooler, with its own
+    tensor naming (q_lin/k_lin/v_lin/out_lin, sa_layer_norm)."""
+
+    model_type = "distilbert"
+
+    def build(self, hf_cfg):
+        from deepspeed_tpu.models.bert import BertConfig, BertModel
+        cfg = BertConfig(vocab_size=hf_cfg["vocab_size"], hidden_size=hf_cfg["dim"],
+                         num_hidden_layers=hf_cfg["n_layers"],
+                         num_attention_heads=hf_cfg["n_heads"],
+                         intermediate_size=hf_cfg["hidden_dim"],
+                         max_position_embeddings=hf_cfg["max_position_embeddings"],
+                         layer_norm_eps=1e-12,
+                         use_token_type=False, use_pooler=False, dtype=np.float32)
+        return BertModel(cfg), cfg
+
+    def convert(self, sd, hf_cfg):
+        pfx = "" if "embeddings.word_embeddings.weight" in sd else "distilbert."
+
+        def k(name):
+            return pfx + name
+
+        p = {"word_embeddings": {"embedding": np.asarray(sd[k("embeddings.word_embeddings.weight")])},
+             "position_embeddings": {"embedding": np.asarray(sd[k("embeddings.position_embeddings.weight")])},
+             "embeddings_layernorm": _ln(sd, k("embeddings.LayerNorm"))}
+        for i in range(hf_cfg["n_layers"]):
+            l = k(f"transformer.layer.{i}")
+            p[f"layer_{i}"] = {
+                "attention": {"query": _dense(sd, f"{l}.attention.q_lin"),
+                              "key": _dense(sd, f"{l}.attention.k_lin"),
+                              "value": _dense(sd, f"{l}.attention.v_lin")},
+                "attention_output": _dense(sd, f"{l}.attention.out_lin"),
+                "attention_layernorm": _ln(sd, f"{l}.sa_layer_norm"),
+                "intermediate": _dense(sd, f"{l}.ffn.lin1"),
+                "output": _dense(sd, f"{l}.ffn.lin2"),
+                "output_layernorm": _ln(sd, f"{l}.output_layer_norm"),
+            }
+        return p
+
+
 # ------------------------------------------------------------------ loading --
 def _load_hf_state_dict(path: str) -> Dict[str, np.ndarray]:
     """Read a HF checkpoint dir's tensors as numpy (safetensors or torch bin)."""
@@ -387,9 +620,20 @@ def _load_hf_state_dict(path: str) -> Dict[str, np.ndarray]:
     if os.path.exists(st):
         from safetensors.numpy import load_file
         return dict(load_file(st))
+    idx = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(idx):  # sharded safetensors (HF default over ~5 GB)
+        from safetensors.numpy import load_file
+        with open(idx) as f:
+            shards = sorted(set(json.load(f)["weight_map"].values()))
+        sd = {}
+        for shard in shards:
+            sd.update(load_file(os.path.join(path, shard)))
+        return sd
     bins = [f for f in os.listdir(path) if f.startswith("pytorch_model") and f.endswith(".bin")]
     if not bins:
-        raise FileNotFoundError(f"no model.safetensors or pytorch_model*.bin under {path}")
+        raise FileNotFoundError(
+            f"no model.safetensors, model.safetensors.index.json (sharded "
+            f"safetensors), or pytorch_model*.bin under {path}")
     import torch
     sd = {}
     for b in sorted(bins):

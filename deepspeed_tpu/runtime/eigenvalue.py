@@ -55,9 +55,16 @@ class Eigenvalue:
         import jax
         return jax.tree.map(lambda x: x * s, a)
 
-    def compute_eigenvalue(self, loss_fn: Callable, params, batch, rng=None) -> Dict[str, float]:
+    def compute_eigenvalue(self, loss_fn: Callable, params, batch, rng=None,
+                           jit_cache: Optional[dict] = None) -> Dict[str, float]:
         """Power-iterate ``H_block v = λ v`` for each top-level block of
         ``params``. ``loss_fn(params, batch)`` must be differentiable.
+
+        ``jit_cache``: caller-owned dict mapping block name → compiled HVP.
+        The HVP takes (params, batch, v) as jit arguments, so a persistent
+        cache makes repeated probes (the compression scheduler's eigenvalue
+        gate polls every interval) reuse the compiled program instead of
+        re-tracing 8 power iterations' worth of HVPs each call.
 
         Returns {block_name: λ_max} with the reference's post-processing: any
         non-converged/invalid block gets 1.0, then all values are scaled so the
@@ -69,17 +76,20 @@ class Eigenvalue:
         rng = rng if rng is not None else jax.random.PRNGKey(0)
 
         def block_hvp(name):
-            def loss_of_block(block):
-                p2 = dict(params)
-                p2[name] = block
-                return loss_fn(p2, batch)
-
-            grad_fn = jax.grad(loss_of_block)
+            if jit_cache is not None and name in jit_cache:
+                return jit_cache[name]
 
             @jax.jit
-            def hvp(v):
-                return jax.jvp(grad_fn, (params[name], ), (v, ))[1]
+            def hvp(params, batch, v):
+                def loss_of_block(block):
+                    p2 = dict(params)
+                    p2[name] = block
+                    return loss_fn(p2, batch)
 
+                return jax.jvp(jax.grad(loss_of_block), (params[name], ), (v, ))[1]
+
+            if jit_cache is not None:
+                jit_cache[name] = hvp
             return hvp
 
         results = {}
@@ -89,7 +99,7 @@ class Eigenvalue:
             v = self._scale(v, 1.0 / (self._norm(v) + self.stability))
             eig, prev = 0.0, 0.0
             for it in range(self.max_iter):
-                hv = hvp(v)
+                hv = hvp(params, batch, v)
                 eig = float(self._dot(v, hv))
                 nrm = float(self._norm(hv))
                 if nrm < self.stability:

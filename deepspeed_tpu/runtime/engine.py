@@ -1231,18 +1231,25 @@ class DeepSpeedEngine:
             return None
         import jax
         from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
-        eig = Eigenvalue(max_iter=8, tol=1e-2)
-        takes_rng = self._loss_fn_takes_rng
-        cast = self._cast_params
-        # fixed key, not None: rng-taking loss fns (dropout) must not crash
-        # inside the power iteration (same reason as the eval fallback)
-        key = jax.random.PRNGKey(0)
+        # the Eigenvalue + loss closure + per-block compiled HVPs persist
+        # across probes — the scheduler's gate polls on an interval, and a
+        # fresh 8-iteration re-jit per poll costs a large multiple of a step
+        if getattr(self, "_eig_state", None) is None:
+            eig = Eigenvalue(max_iter=8, tol=1e-2)
+            takes_rng = self._loss_fn_takes_rng
+            cast = self._cast_params
+            # fixed key, not None: rng-taking loss fns (dropout) must not crash
+            # inside the power iteration (same reason as the eval fallback)
+            key = jax.random.PRNGKey(0)
 
-        def loss_fn(p, b):
-            out = self.loss_fn(cast(p), b, key) if takes_rng else self.loss_fn(cast(p), b)
-            return out[0] if isinstance(out, tuple) else out
+            def loss_fn(p, b):
+                out = self.loss_fn(cast(p), b, key) if takes_rng else self.loss_fn(cast(p), b)
+                return out[0] if isinstance(out, tuple) else out
 
-        vals = eig.compute_eigenvalue(loss_fn, self.params, self._last_batch)
+            self._eig_state = (eig, loss_fn, {})
+        eig, loss_fn, jit_cache = self._eig_state
+        vals = eig.compute_eigenvalue(loss_fn, self.params, self._last_batch,
+                                      jit_cache=jit_cache)
         return max(vals.values()) if vals else None
 
     # -- flops profiler / autotuning accessors ------------------------------------
@@ -1482,8 +1489,18 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime.checkpoint_engine.engine import save_engine_state
         tag = str(tag) if tag is not None else f"global_step{self.global_steps}"
         self._checkpoint_tag_validation(tag)
-        save_engine_state(self, save_dir, tag, client_state or {}, save_latest)
+        # nebula.enabled → async (Nebula-class) save: commit overlaps the next
+        # train steps; durable-marker ordering preserved (checkpoint_engine)
+        async_save = bool(self._config.nebula_config.get("enabled", False))
+        save_engine_state(self, save_dir, tag, client_state or {}, save_latest,
+                          async_save=async_save)
         return True
+
+    def checkpoint_wait(self):
+        """Barrier on any in-flight async (nebula) checkpoint save — call at
+        end of training or before reading the checkpoint externally."""
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import checkpoint_barrier
+        checkpoint_barrier(self)
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False, custom_load_fn=None):
