@@ -5,12 +5,24 @@ model, generates experiment configs from templates over ZeRO stage /
 micro-batch / other knobs, schedules them through the launcher, picks the
 fastest) with grid/random/model-based tuners under ``autotuning/tuner/``.
 
-TPU formulation: experiments run in-process — each candidate config builds an
-engine, times a few ``train_batch`` steps on the real backend, and is torn
-down; XLA's compile cache keeps repeat shapes cheap. The search space follows
-the reference's config schema (``autotuning`` block: ``tuner_type``
-grid|random, ``max_experiments``, user-overridable space); results are
-written to ``results.json`` like the reference's autotuning_metric_path.
+TPU formulation: two execution modes.
+
+- ``exec_mode: "subprocess"`` (default when a ``model_factory`` is given —
+  reference parity): every candidate runs as its own ``dstpu``-launched
+  process via ``autotuning/scheduler.py``, so an OOM-killed or XLA-aborted
+  candidate fails alone, world size can vary per candidate, and no XLA
+  state leaks between trials. ``model_factory`` is an importable
+  ``"pkg.mod:fn"`` (see ``exp_runner``) because live models don't cross
+  process boundaries — the same reason the reference passes a user script.
+- ``exec_mode: "in_process"``: each candidate builds an engine in this
+  process and times a few ``train_batch`` steps; XLA's compile cache keeps
+  repeat shapes cheap. Faster for small searches, but a hard OOM kills the
+  tuner too.
+
+The search space follows the reference's config schema (``autotuning``
+block: ``tuner_type`` grid|random|model_based, ``max_experiments``,
+user-overridable space); results are written to ``results.json`` like the
+reference's autotuning_metric_path.
 """
 
 import itertools
@@ -47,22 +59,41 @@ def _set_nested(cfg: dict, dotted: str, value):
 
 class Autotuner:
 
-    def __init__(self, model, base_config: dict, batch_fn, model_parameters=None,
-                 space: Optional[Dict[str, List[Any]]] = None, steps: int = 3,
-                 warmup: int = 1, results_dir: Optional[str] = None):
+    def __init__(self, model=None, base_config: dict = None, batch_fn=None,
+                 model_parameters=None, space: Optional[Dict[str, List[Any]]] = None,
+                 steps: int = 3, warmup: int = 1, results_dir: Optional[str] = None,
+                 model_factory: Optional[str] = None):
         """``batch_fn(micro_batch_size) -> batch`` supplies a global batch for
-        a candidate micro size (the reference reads it off the dataloader)."""
+        a candidate micro size (the reference reads it off the dataloader).
+        ``model_factory`` ("pkg.mod:fn", see exp_runner) enables the
+        launcher-scheduled subprocess mode; ``model``/``batch_fn`` then only
+        serve the profile pass and may be omitted."""
         self.model = model
         self.model_parameters = model_parameters
-        self.base_config = base_config
+        self.base_config = base_config or {}
         self.batch_fn = batch_fn
-        at = base_config.get("autotuning", {})
+        at = self.base_config.get("autotuning", {})
         self.space = space or at.get("space", DEFAULT_SPACE)
         self.tuner_type = at.get("tuner_type", "gridsearch")
         self.max_experiments = at.get("max_experiments", 32)
         self.steps = steps
         self.warmup = warmup
         self.results_dir = results_dir or at.get("results_dir", "autotuning_results")
+        self.model_factory = model_factory or at.get("model_factory")
+        self.exec_mode = at.get("exec_mode",
+                                "subprocess" if self.model_factory else "in_process")
+        if self.exec_mode == "subprocess" and not self.model_factory:
+            raise ValueError("autotuning exec_mode 'subprocess' needs a model_factory "
+                             "('pkg.mod:fn'; live models don't cross process boundaries)")
+        self._resource_manager = None
+        if self.exec_mode == "subprocess":
+            from deepspeed_tpu.autotuning.scheduler import (DEFAULT_EXPERIMENT_TIMEOUT_S,
+                                                            ResourceManager)
+            self._resource_manager = ResourceManager(
+                self.results_dir, self.model_factory, steps=steps, warmup=warmup,
+                timeout_s=int(at.get("experiment_timeout", DEFAULT_EXPERIMENT_TIMEOUT_S)),
+                num_chips=int(at.get("num_chips", 1)))
+        self._exp_seq = 0
         self.results: List[dict] = []
 
     def _candidates(self):
@@ -73,16 +104,38 @@ class Autotuner:
             rng.shuffle(combos)
         return [dict(zip(keys, c)) for c in combos[:self.max_experiments]]
 
-    def _run_experiment(self, overrides: dict) -> Optional[float]:
+    def _candidate_config(self, overrides: dict) -> dict:
         import copy
-        import jax
-        import deepspeed_tpu
-        from deepspeed_tpu.utils import groups
-
         cfg = copy.deepcopy(self.base_config)
         cfg.pop("autotuning", None)
         for k, v in overrides.items():
             _set_nested(cfg, k, v)
+        return cfg
+
+    def _run_experiment(self, overrides: dict) -> Optional[float]:
+        if self.exec_mode == "subprocess":
+            return self._run_experiment_subprocess(overrides)
+        return self._run_experiment_in_process(overrides)
+
+    def _run_experiment_subprocess(self, overrides: dict) -> Optional[float]:
+        """Reference scheduler.run_experiment:375 — the candidate runs as its
+        own launcher job; a dead process is a failed candidate, not a dead
+        tuner."""
+        self._exp_seq += 1
+        result = self._resource_manager.run_experiment(self._exp_seq,
+                                                       self._candidate_config(overrides))
+        tput = result.get("throughput_samples_per_sec")
+        if tput is None:
+            logger.warning(f"autotuning experiment {overrides} failed: "
+                           f"{result.get('error', 'unknown')[:160]}")
+            return None
+        return float(tput)
+
+    def _run_experiment_in_process(self, overrides: dict) -> Optional[float]:
+        import deepspeed_tpu
+        from deepspeed_tpu.utils import groups
+
+        cfg = self._candidate_config(overrides)
         micro = cfg.get("train_micro_batch_size_per_gpu", 1)
         try:
             groups.initialize_mesh(force=True)
@@ -139,8 +192,14 @@ class Autotuner:
         from deepspeed_tpu.autotuning.cost_model import device_memory_bytes
         from deepspeed_tpu.utils import groups
 
-        if self.model_parameters is not None:
-            n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(self.model_parameters))
+        params = self.model_parameters
+        if params is not None:
+            n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+        elif self.model_factory:
+            # subprocess mode may not hand us live params — profile in a
+            # subprocess too: a model too big for this process (the very case
+            # subprocess mode exists for) must not OOM the tuner
+            n_params = self._profile_n_params_subprocess()
         else:
             n_params = 0
         zero_degree = 1
@@ -150,6 +209,34 @@ class Autotuner:
                                        if ax in mesh.shape]))
         return {"n_params": n_params, "zero_degree": max(1, zero_degree),
                 "hbm_bytes": device_memory_bytes()}
+
+    def _profile_n_params_subprocess(self) -> int:
+        """Parameter count via ``exp_runner --profile`` in its own process;
+        0 (prune nothing) when the profile itself fails."""
+        import json as _json
+        import subprocess
+        import sys
+        import tempfile
+
+        fd, cfg_path = tempfile.mkstemp(suffix=".json", prefix="tune_profile_")
+        with os.fdopen(fd, "w") as f:
+            _json.dump(self._candidate_config({}), f)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-m", "deepspeed_tpu.autotuning.exp_runner",
+                 "--profile", self.model_factory, cfg_path],
+                capture_output=True, text=True,
+                timeout=self._resource_manager.timeout_s if self._resource_manager else 900)
+            for line in reversed(r.stdout.strip().splitlines()):
+                if line.startswith("{"):
+                    return int(_json.loads(line)["n_params"])
+            logger.warning(f"autotuning profile produced no count (rc={r.returncode}): "
+                           f"{(r.stderr or '').strip()[-160:]}")
+        except Exception as e:  # noqa: BLE001 — degraded profile, not a dead tuner
+            logger.warning(f"autotuning profile subprocess failed: {e}")
+        finally:
+            os.unlink(cfg_path)
+        return 0
 
     def tune_model_based(self) -> dict:
         """Cost-model-guided search (reference tuner/model_based_tuner.py +

@@ -1,0 +1,46 @@
+"""Built-in model factories for the subprocess autotuner.
+
+A ``model_factory`` is the subprocess-mode replacement for live model
+objects (reference analog: the user training script the reference launcher
+re-runs per experiment). Signature::
+
+    fn(config: dict) -> (model, params, batch_fn)
+
+where ``batch_fn(micro_batch_size) -> batch``. Point ``autotuning.
+model_factory`` at any importable "pkg.mod:fn"; the ones here serve tests,
+examples, and quick starts.
+"""
+
+import numpy as np
+
+
+def tiny_llama(config: dict):
+    """A tiny Llama for smoke-scale tuning runs (and the e2e tests)."""
+    from deepspeed_tpu.models import llama
+
+    S = 32
+    cfg = llama.LlamaConfig.tiny(max_position_embeddings=S)
+    model, params = llama.init_params(cfg, batch_size=1, seq_len=S)
+
+    def batch_fn(micro):
+        gas = int(config.get("gradient_accumulation_steps", 1))
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, size=(micro * gas, S + 1), dtype=np.int64)
+        return (ids[:, :-1].astype(np.int32), ids[:, 1:].astype(np.int32))
+
+    return model, params, batch_fn
+
+
+def failing(config: dict):
+    """Deliberately dies — exercises the scheduler's crash isolation."""
+    raise RuntimeError("model_factories.failing: intentional experiment failure")
+
+
+def tiny_llama_fragile(config: dict):
+    """tiny_llama, but hard-dies (no results.json, like an OOM kill) when the
+    micro batch is 4 — exercises the scheduler surviving a dead experiment
+    process, the failure mode in-process measurement cannot."""
+    import os
+    if int(config.get("train_micro_batch_size_per_gpu", 1)) == 4:
+        os._exit(137)
+    return tiny_llama(config)

@@ -1,0 +1,82 @@
+"""Launcher-scheduled autotuning experiments (VERDICT r5 ask #3).
+
+Reference: ``deepspeed/autotuning/scheduler.py`` (ResourceManager /
+run_experiment) + ``autotuner.py:404`` — every candidate runs as its own
+launcher job; the tuner harvests results.json and survives dead children.
+These tests spawn REAL experiment processes through
+``deepspeed_tpu.launcher.runner`` (local mode).
+"""
+
+import json
+
+import pytest
+
+
+def test_subprocess_experiments_pick_measured_winner(tmp_path):
+    """Two real experiment processes run; the tuner picks the measured best."""
+    from deepspeed_tpu.autotuning import Autotuner
+
+    base = {"train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 0.01}},
+            "zero_optimization": {"stage": 0},
+            "autotuning": {
+                "tuner_type": "gridsearch", "max_experiments": 4,
+                "model_factory": "deepspeed_tpu.autotuning.model_factories:tiny_llama",
+                "experiment_timeout": 600}}
+    tuner = Autotuner(base_config=base,
+                      space={"train_micro_batch_size_per_gpu": [2, 4]},
+                      steps=2, warmup=1, results_dir=str(tmp_path))
+    assert tuner.exec_mode == "subprocess"
+    best = tuner.tune()
+    assert best["throughput_samples_per_sec"] > 0
+
+    # both candidates ran as separate processes with their own exp dir,
+    # exp.json (the materialized candidate config) and harvested results.json
+    for i in (1, 2):
+        exp = json.loads((tmp_path / f"exp_{i}" / "exp.json").read_text())
+        assert "autotuning" not in exp["config"]
+        res = json.loads((tmp_path / f"exp_{i}" / "results.json").read_text())
+        assert res["throughput_samples_per_sec"] > 0
+        assert (tmp_path / f"exp_{i}" / "stderr.log").exists()
+
+    # the winner is the measured max, recorded in the summary results.json
+    summary = json.loads((tmp_path / "results.json").read_text())
+    tputs = [r["throughput_samples_per_sec"] for r in summary["experiments"]]
+    assert len(tputs) == 2
+    assert best["throughput_samples_per_sec"] == max(tputs)
+    micros = {r["config"]["train_micro_batch_size_per_gpu"] for r in summary["experiments"]}
+    assert micros == {2, 4}
+
+
+def test_subprocess_survives_hard_killed_experiment(tmp_path):
+    """A candidate whose process dies WITHOUT writing results.json (the OOM
+    kill the in-process tuner could never survive) fails alone; the search
+    continues and still picks a winner from the survivors."""
+    from deepspeed_tpu.autotuning import Autotuner
+
+    base = {"train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 0.01}},
+            "zero_optimization": {"stage": 0},
+            "autotuning": {
+                "tuner_type": "gridsearch", "max_experiments": 4,
+                "model_factory":
+                    "deepspeed_tpu.autotuning.model_factories:tiny_llama_fragile",
+                "experiment_timeout": 600}}
+    tuner = Autotuner(base_config=base,
+                      space={"train_micro_batch_size_per_gpu": [2, 4]},
+                      steps=2, warmup=1, results_dir=str(tmp_path))
+    best = tuner.tune()
+    # micro=4 hard-died (os._exit(137), no results.json); micro=2 won
+    assert best["config"]["train_micro_batch_size_per_gpu"] == 2
+    summary = json.loads((tmp_path / "results.json").read_text())
+    by_micro = {r["config"]["train_micro_batch_size_per_gpu"]: r
+                for r in summary["experiments"]}
+    assert by_micro[4]["throughput_samples_per_sec"] is None
+    assert by_micro[2]["throughput_samples_per_sec"] > 0
+
+
+def test_subprocess_mode_requires_model_factory():
+    from deepspeed_tpu.autotuning import Autotuner
+
+    with pytest.raises(ValueError, match="model_factory"):
+        Autotuner(base_config={"autotuning": {"exec_mode": "subprocess"}})
