@@ -2,15 +2,19 @@
 
 Reference: the ZeRO-Inference release (reference README.md:17 — "20x faster
 inference" via weight quantization + KV-cache offload) and
-``deepspeed/inference/quantization`` (per-channel symmetric int8 of the
-matmul weights, dequantized on use).
+``deepspeed/inference/quantization`` (per-channel symmetric int8/int4 of the
+matmul weights, dequantized on use; int4 kernels in
+``csrc/quantization/quantize_intX.cu``).
 
-TPU formulation: quantized leaves are stored int8 in HBM with per-output-
-channel fp scales; ``dequantize_tree`` runs *inside* the jitted forward, so
-XLA fuses the int8→bf16 convert+scale into each weight's consumer — weights
-stream from HBM at 1 byte/element (the decode-path win; matmuls stay MXU
-bf16). Pytree-native: a quantized leaf becomes a ``{QKEY, SKEY, DKEY}`` dict
-subtree, invisible to checkpointing and sharding machinery.
+TPU formulation: quantized leaves are stored int8 — or int4, packed 8
+nibbles to an int32 word along the contraction axis (int32-backed because
+Mosaic/XLA-TPU handle sub-byte minor-dim reshapes poorly) — in HBM with
+per-output-channel fp scales; ``dequantize_tree`` runs *inside* the jitted
+forward, so XLA fuses the unpack/convert/scale into each weight's consumer —
+weights stream from HBM at 1 (or 0.5) byte/element (the decode-path win;
+matmuls stay MXU bf16). Pytree-native: a quantized leaf becomes a
+``{QKEY|Q4KEY, SKEY, DKEY}`` dict subtree, invisible to checkpointing and
+sharding machinery.
 """
 
 from typing import Any
@@ -18,6 +22,7 @@ from typing import Any
 import numpy as np
 
 QKEY = "__wq_int8__"
+Q4KEY = "__wq_int4x8__"  # [..., K//8, N] int32, 8 consecutive-K nibbles/word
 SKEY = "__wq_scale__"
 DKEY = "__wq_dtype__"
 
@@ -33,17 +38,46 @@ def _quantize_leaf(w):
     return {QKEY: q, SKEY: scale, DKEY: jnp.zeros((), w.dtype)}
 
 
+def _quantize_leaf_int4(w):
+    """Per-output-channel symmetric int4 ([-7, 7]); 8 consecutive contraction-
+    axis nibbles packed into one int32 word (0.5 bytes/element at rest)."""
+    import jax.numpy as jnp
+    scale = jnp.max(jnp.abs(w), axis=-2, keepdims=True) / 7.0
+    scale = jnp.maximum(scale, 1e-12).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / scale), -7, 7).astype(jnp.int32)
+    K, N = w.shape[-2], w.shape[-1]
+    q = q.reshape(*w.shape[:-2], K // 8, 8, N)
+    shifts = (jnp.arange(8, dtype=jnp.int32) * 4)[:, None]
+    # nibble fields are disjoint, so sum == bitwise-or of the shifted nibbles
+    packed = ((q & 0xF) << shifts).sum(axis=-2).astype(jnp.int32)
+    return {Q4KEY: packed, SKEY: scale, DKEY: jnp.zeros((), w.dtype)}
+
+
+def _dequantize_leaf_int4(node):
+    import jax.numpy as jnp
+    p = node[Q4KEY]
+    shifts = (jnp.arange(8, dtype=jnp.int32) * 4)[:, None]
+    v = (p[..., :, None, :] >> shifts) & 0xF          # [..., K//8, 8, N]
+    v = v - 16 * (v >= 8)                             # sign-extend 4-bit 2's-comp
+    q = v.reshape(*p.shape[:-2], p.shape[-2] * 8, p.shape[-1])
+    return (q.astype(jnp.float32) * node[SKEY]).astype(node[DKEY].dtype)
+
+
 def is_quantized_leaf(node) -> bool:
-    return isinstance(node, dict) and QKEY in node
+    return isinstance(node, dict) and (QKEY in node or Q4KEY in node)
 
 
 def quantize_tree(params, min_size: int = 4096, bits: int = 8):
     """Quantize every floating leaf with ndim >= 2 and >= ``min_size`` elements
     (norm scales, biases and small tensors stay full precision — the
-    reference's exclusion list)."""
+    reference's exclusion list). ``bits`` = 8 or 4; at 4, leaves whose
+    contraction axis isn't a multiple of 8 (never true of transformer matmul
+    weights) stay int8 rather than pay a padded unpack."""
     import jax.numpy as jnp
-    if bits != 8:
-        raise NotImplementedError(f"only int8 weight quantization is implemented (got {bits})")
+    if bits not in (8, 4):
+        raise NotImplementedError(
+            f"weight quantization supports bits=8 (int8) and bits=4 "
+            f"(packed int4), got {bits}")
 
     def rec(node):
         if isinstance(node, dict):
@@ -51,6 +85,8 @@ def quantize_tree(params, min_size: int = 4096, bits: int = 8):
         if (hasattr(node, "ndim") and node.ndim >= 2
                 and jnp.issubdtype(node.dtype, jnp.floating)
                 and int(np.prod(node.shape)) >= min_size):
+            if bits == 4 and node.shape[-2] % 8 == 0:
+                return _quantize_leaf_int4(node)
             return _quantize_leaf(node)
         return node
 
@@ -59,11 +95,13 @@ def quantize_tree(params, min_size: int = 4096, bits: int = 8):
 
 def dequantize_tree(params):
     """Collapse quantized subtrees back to full-precision arrays. Called inside
-    jit: the convert+scale fuses into each weight's consumer, so the at-rest
-    representation stays int8."""
+    jit: the unpack/convert/scale fuses into each weight's consumer, so the
+    at-rest representation stays int8 / packed int4."""
     import jax.numpy as jnp
 
     def rec(node):
+        if isinstance(node, dict) and Q4KEY in node:
+            return _dequantize_leaf_int4(node)
         if is_quantized_leaf(node):
             return (node[QKEY].astype(jnp.float32) * node[SKEY]).astype(node[DKEY].dtype)
         if isinstance(node, dict):
