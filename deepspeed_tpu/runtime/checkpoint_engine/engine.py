@@ -55,12 +55,17 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             return self._ckptr.restore(path, target=target)
         return self._ckptr.restore(path)
 
+    def finish(self):
+        """Join the in-flight commit WITHOUT closing (the async engine is
+        reused across saves)."""
+        if hasattr(self._ckptr, "wait_until_finished"):
+            self._ckptr.wait_until_finished()
+
     def wait(self):
         # orbax finalizes array commits on background threads even for the
         # "synchronous" checkpointer; a caller (or interpreter exit) racing
         # them sees a missing/partial state dir. close() joins them.
-        if hasattr(self._ckptr, "wait_until_finished"):
-            self._ckptr.wait_until_finished()
+        self.finish()
         self._ckptr.close()
 
 
@@ -68,20 +73,52 @@ def _ckpt_path(save_dir, tag):
     return os.path.join(os.path.abspath(save_dir), str(tag))
 
 
-def save_engine_state(engine, save_dir, tag, client_state, save_latest):
+def checkpoint_barrier(engine):
+    """Join any in-flight async save (Nebula-class): the barrier the next
+    save/load takes, so at most one commit is ever outstanding. A commit
+    that FAILED in the background re-raises here — save_checkpoint already
+    returned, so the barrier is the first point the failure can surface."""
+    st = getattr(engine, "_async_ckpt", None)
+    if st and st.get("thread") is not None:
+        st["thread"].join()
+        st["thread"] = None
+        err = st.pop("error", None)
+        if err is not None:
+            raise RuntimeError(f"async checkpoint commit failed: {err[1]}") from err[1]
+
+
+def _write_host_state(path, save_dir, tag, host_state, save_latest):
     import jax
+    # host-side metadata is identical on every process; only rank 0 writes it
+    # (shared-filesystem checkpoints must not see N concurrent writers)
+    if jax.process_index() == 0:
+        with open(os.path.join(path, "host_state.pkl"), "wb") as f:
+            pickle.dump(host_state, f)
+        if save_latest:
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(str(tag))
+
+
+def save_engine_state(engine, save_dir, tag, client_state, save_latest,
+                      async_save=False):
+    """``async_save`` (reference nebula_checkpoint_engine.py role): the array
+    commit proceeds on background threads while training continues; the
+    host-state + ``latest`` marker are written only AFTER the commit is
+    durable, so a crash mid-commit leaves the previous checkpoint current
+    (the reference's tier-commit semantics). ``checkpoint_barrier`` (taken by
+    the next save/load) bounds in-flight saves to one."""
+    import threading
+
     path = _ckpt_path(save_dir, tag)
     os.makedirs(save_dir, exist_ok=True)
 
-    ck = OrbaxCheckpointEngine()
+    checkpoint_barrier(engine)  # previous in-flight save must land first
+
     arrays = {
         "params": engine.params,
         "opt_state": _named_opt_state(engine._offload.checkpoint_view(engine.opt_state)),
         "scale_state": engine.scale_state._asdict(),
     }
-    ck.save(arrays, os.path.join(path, "state"))
-    ck.wait()  # checkpoint must be durable before save_checkpoint returns
-
     host_state = {
         "global_steps": engine.global_steps,
         "global_samples": engine.global_samples,
@@ -92,21 +129,47 @@ def save_engine_state(engine, save_dir, tag, client_state, save_latest):
         "ds_config": engine._config._param_dict,
         "client_state": client_state,
     }
-    # host-side metadata is identical on every process; only rank 0 writes it
-    # (shared-filesystem checkpoints must not see N concurrent writers)
-    if jax.process_index() == 0:
-        with open(os.path.join(path, "host_state.pkl"), "wb") as f:
-            pickle.dump(host_state, f)
-        if save_latest:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(str(tag))
-    logger.info(f"Saved checkpoint to {path}")
+
+    if not async_save:
+        ck = OrbaxCheckpointEngine()
+        ck.save(arrays, os.path.join(path, "state"))
+        ck.wait()  # checkpoint must be durable before save_checkpoint returns
+        _write_host_state(path, save_dir, tag, host_state, save_latest)
+        logger.info(f"Saved checkpoint to {path}")
+        return True
+
+    st = getattr(engine, "_async_ckpt", None)
+    if st is None:
+        st = engine._async_ckpt = {"thread": None, "ckptr": None}
+    if st["ckptr"] is None:
+        st["ckptr"] = OrbaxCheckpointEngine(use_async=True)
+    ck = st["ckptr"]
+    # the async save stages a device→host snapshot synchronously (so later
+    # donated train steps can't corrupt it) and commits on background threads
+    ck.save(arrays, os.path.join(path, "state"))
+
+    def finalize():
+        try:
+            ck.finish()
+            _write_host_state(path, save_dir, tag, host_state, save_latest)
+            logger.info(f"Async checkpoint committed to {path}")
+        except BaseException as e:  # surfaced at the next checkpoint_barrier
+            st["error"] = (tag, e)
+            logger.error(f"Async checkpoint commit for {path} FAILED: {e}")
+
+    # non-daemon: the interpreter joins it at exit, so a short-lived trainer
+    # can't lose its last checkpoint
+    t = threading.Thread(target=finalize, name=f"ckpt-commit-{tag}")
+    t.start()
+    st["thread"] = t
+    logger.info(f"Async checkpoint save dispatched for {path}")
     return True
 
 
 def load_engine_state(engine, load_dir, tag, load_optimizer_states=True, load_lr_scheduler_states=True,
                       load_module_only=False):
     import jax
+    checkpoint_barrier(engine)  # an in-flight async save must land first
     if tag is None:
         latest = os.path.join(load_dir, LATEST_FILE)
         if not os.path.isfile(latest):

@@ -1,0 +1,147 @@
+"""Async (Nebula-class) checkpoint engine (VERDICT r5 ask #8).
+
+Reference: ``deepspeed/runtime/checkpoint_engine/nebula_checkpoint_engine.py``
+— saves commit in the background while training continues; the durable
+marker appears only after the commit completes, and the next save/load
+takes a barrier on the in-flight commit.
+"""
+
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.checkpoint_engine import engine as ckpt_engine_mod
+from deepspeed_tpu.utils import groups
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from simple_model import make_simple_model, random_batches  # noqa: E402
+
+
+def _engine(nebula=True):
+    groups.initialize_mesh(force=True)
+    model, params = make_simple_model(hidden_dim=16, batch_size=8)
+    cfg = {"train_micro_batch_size_per_gpu": 8, "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 2}}
+    if nebula:
+        cfg["nebula"] = {"enabled": True}
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                            config=cfg)
+    return eng
+
+
+def test_async_save_overlaps_training_and_is_loadable(tmp_path, monkeypatch):
+    """Train steps proceed WHILE the commit is provably in flight (the
+    finalizer is gated on an event the test controls); the durable marker
+    appears only after the commit; the loaded state equals the state at
+    save time, not the post-save steps."""
+    gate = threading.Event()
+    real_finish = ckpt_engine_mod.OrbaxCheckpointEngine.finish
+
+    def gated_finish(self):
+        gate.wait(timeout=60)
+        real_finish(self)
+
+    monkeypatch.setattr(ckpt_engine_mod.OrbaxCheckpointEngine, "finish", gated_finish)
+
+    eng = _engine()
+    batches = random_batches(4, 8, 16)
+    for b in batches[:2]:
+        float(eng.train_batch(batch=b))
+    want = jax.device_get(eng.params)
+    steps_at_save = eng.global_steps
+
+    assert eng.save_checkpoint(str(tmp_path), tag="async")
+    st = eng._async_ckpt
+    assert st["thread"].is_alive()
+
+    # training continues while the commit is gated open
+    for b in batches[2:]:
+        float(eng.train_batch(batch=b))
+    assert eng.global_steps == steps_at_save + 2
+    # durable-commit ordering: no latest marker / host state until the commit
+    assert not os.path.exists(tmp_path / "latest")
+    assert not os.path.exists(tmp_path / "async" / "host_state.pkl")
+    assert st["thread"].is_alive()
+
+    gate.set()
+    eng.checkpoint_wait()
+    assert st["thread"] is None  # the barrier joined and cleared it
+    assert (tmp_path / "latest").read_text() == "async"
+    assert os.path.exists(tmp_path / "async" / "host_state.pkl")
+
+    # the checkpoint is the SNAPSHOT AT SAVE TIME (staged before the extra
+    # steps), and load_checkpoint works on a fresh engine
+    eng2 = _engine()
+    path, _ = eng2.load_checkpoint(str(tmp_path), tag="async")
+    assert path is not None
+    assert eng2.global_steps == steps_at_save
+    for a, b in zip(jax.tree.leaves(jax.device_get(eng2.params)),
+                    jax.tree.leaves(want)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_next_save_barriers_on_inflight_commit(tmp_path, monkeypatch):
+    """A second save must wait for the first commit (at most one in flight)."""
+    order = []
+    real_finish = ckpt_engine_mod.OrbaxCheckpointEngine.finish
+
+    def logged_finish(self):
+        order.append("finish")
+        real_finish(self)
+
+    monkeypatch.setattr(ckpt_engine_mod.OrbaxCheckpointEngine, "finish", logged_finish)
+
+    eng = _engine()
+    b = random_batches(1, 8, 16)[0]
+    float(eng.train_batch(batch=b))
+    eng.save_checkpoint(str(tmp_path), tag="first")
+    first_thread = eng._async_ckpt["thread"]
+    eng.save_checkpoint(str(tmp_path), tag="second")
+    # the first commit's thread was joined before the second save dispatched
+    assert not first_thread.is_alive()
+    assert order and order[0] == "finish"
+    eng.checkpoint_wait()
+    assert (tmp_path / "latest").read_text() == "second"
+    # both checkpoints are complete on disk
+    assert os.path.exists(tmp_path / "first" / "host_state.pkl")
+    assert os.path.exists(tmp_path / "second" / "host_state.pkl")
+
+
+def test_sync_save_unaffected(tmp_path):
+    """Without the nebula block the save path stays synchronous-durable."""
+    eng = _engine(nebula=False)
+    b = random_batches(1, 8, 16)[0]
+    float(eng.train_batch(batch=b))
+    eng.save_checkpoint(str(tmp_path), tag="sync")
+    # durable immediately — no barrier needed
+    assert (tmp_path / "latest").read_text() == "sync"
+    assert getattr(eng, "_async_ckpt", None) is None
+
+
+def test_failed_commit_surfaces_at_barrier(tmp_path, monkeypatch):
+    """A commit that dies in the background must raise at the next barrier —
+    silent loss of a checkpoint is the one unacceptable outcome."""
+    def broken_finish(self):
+        raise OSError("disk full (simulated)")
+
+    monkeypatch.setattr(ckpt_engine_mod.OrbaxCheckpointEngine, "finish", broken_finish)
+    eng = _engine()
+    b = random_batches(1, 8, 16)[0]
+    float(eng.train_batch(batch=b))
+    eng.save_checkpoint(str(tmp_path), tag="doomed")  # returns; commit dies
+    with pytest.raises(RuntimeError, match="async checkpoint commit failed"):
+        eng.checkpoint_wait()
+    # no durable marker was written for the failed save
+    assert not os.path.exists(tmp_path / "latest")
+    # the engine recovers: the next (sync-path barrier already taken) save works
+    monkeypatch.undo()
+    eng.save_checkpoint(str(tmp_path), tag="retry")
+    eng.checkpoint_wait()
+    assert (tmp_path / "latest").read_text() == "retry"
