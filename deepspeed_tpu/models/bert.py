@@ -29,6 +29,9 @@ class BertConfig:
     max_position_embeddings: int = 512
     type_vocab_size: int = 2
     layer_norm_eps: float = 1e-12
+    # distilbert deltas: no token-type embeddings, no [CLS] pooler
+    use_token_type: bool = True
+    use_pooler: bool = True
     dtype: any = jnp.float32
 
     @classmethod
@@ -90,12 +93,15 @@ class BertModel(nn.Module):
                      name="word_embeddings")(input_ids)
         x = x + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, dtype=cfg.dtype,
                          name="position_embeddings")(jnp.arange(S)[None])
-        x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
-                         name="token_type_embeddings")(token_type_ids)
+        if cfg.use_token_type:
+            x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                             name="token_type_embeddings")(token_type_ids)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="embeddings_layernorm")(x)
         for i in range(cfg.num_hidden_layers):
             x = BertLayer(cfg, name=f"layer_{i}")(x, attention_mask)
+        if not cfg.use_pooler:
+            return x, None
         pooled = nn.tanh(nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="pooler")(x[:, 0]))
         return x, pooled
 

@@ -52,6 +52,13 @@ class DecoderConfig:
     parallel_mlp_norm: bool = False    # neox: separate norm for the parallel MLP
     rotary_interleaved: bool = False   # gptj: adjacent-pair rotation
     lm_head_bias: bool = False         # gptj's biased lm_head
+    # gpt-neo deltas: unbiased q/k/v but biased out_proj (None = follow
+    # attention_bias); UNSCALED attention scores; alternating global/local
+    # (sliding-window) layers
+    attention_out_bias: any = None     # Optional[bool]
+    attention_scaled: bool = True      # False: gpt-neo's scale-less scores
+    attention_layers: any = None       # Optional[tuple of "global"|"local"]
+    window_size: int = 256             # local-attention window
     model_type: str = "decoder"
     dtype: any = jnp.float32
 
@@ -97,6 +104,17 @@ class DecoderConfig:
         base = dict(pos_embed="rotary", rotary_interleaved=True, parallel_residual=True,
                     activation="gelu", attention_bias=False, mlp_bias=True,
                     lm_head_bias=True, model_type="gptj")
+        base.update(kw)
+        return cls(**base)
+
+    @classmethod
+    def gpt_neo(cls, **kw):
+        # HF GPT-Neo: learned positions (no offset), tanh-gelu, UNSCALED
+        # attention scores, unbiased q/k/v with a biased out_proj, and
+        # alternating global/local (window 256) layers
+        base = dict(pos_embed="learned", learned_pos_offset=0, parallel_residual=False,
+                    activation="gelu", attention_bias=False, attention_out_bias=True,
+                    attention_scaled=False, model_type="gpt_neo")
         base.update(kw)
         return cls(**base)
 
@@ -166,6 +184,7 @@ def partial_rotary(x, cos, sin, pct, interleaved=False):
 
 class DecoderAttention(nn.Module):
     cfg: DecoderConfig
+    attn_type: str = "global"  # "global" | "local" (gpt-neo sliding window)
 
     @nn.compact
     def __call__(self, x, cos, sin, pos_ids):
@@ -173,6 +192,8 @@ class DecoderAttention(nn.Module):
         H, KVH = cfg.num_attention_heads, cfg.num_key_value_heads
         D = cfg.hidden_size // H
         dense = partial(nn.Dense, use_bias=cfg.attention_bias, dtype=cfg.dtype)
+        out_bias = cfg.attention_bias if cfg.attention_out_bias is None \
+            else cfg.attention_out_bias
         q = dense(H * D, name="q_proj")(x).reshape(*x.shape[:-1], H, D)
         k = dense(KVH * D, name="k_proj")(x).reshape(*x.shape[:-1], KVH, D)
         v = dense(KVH * D, name="v_proj")(x).reshape(*x.shape[:-1], KVH, D)
@@ -183,16 +204,23 @@ class DecoderAttention(nn.Module):
             k = jnp.repeat(k, H // KVH, axis=2)
             v = jnp.repeat(v, H // KVH, axis=2)
         S = x.shape[1]
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(D)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        if cfg.attention_scaled:
+            logits = logits / np.sqrt(D)
         if cfg.pos_embed == "alibi":
             slopes = jnp.asarray(alibi_slopes(H))
             rel = jnp.arange(S)[None, :] - jnp.arange(S)[:, None]  # k - q (<=0 causal)
             logits = logits + slopes[None, :, None, None] * rel[None, None].astype(jnp.float32)
         mask = jnp.tril(jnp.ones((S, S), bool))
+        if self.attn_type == "local":
+            # gpt-neo sliding window: i-window < j <= i (HF GPTNeo bias xor)
+            rel = jnp.arange(S)[:, None] - jnp.arange(S)[None, :]  # q - k
+            mask = mask & (rel < cfg.window_size)
         logits = jnp.where(mask[None, None], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(*x.shape[:-1], H * D)
-        return dense(cfg.hidden_size, name="out_proj")(out)
+        out_dense = partial(nn.Dense, use_bias=out_bias, dtype=cfg.dtype)
+        return out_dense(cfg.hidden_size, name="out_proj")(out)
 
 
 class DecoderMLP(nn.Module):
@@ -208,20 +236,22 @@ class DecoderMLP(nn.Module):
 
 class DecoderBlock(nn.Module):
     cfg: DecoderConfig
+    attn_type: str = "global"
 
     @nn.compact
     def __call__(self, x, cos, sin, pos_ids):
         cfg = self.cfg
         ln = partial(nn.LayerNorm, epsilon=cfg.layer_norm_eps, dtype=cfg.dtype)
+        attn = partial(DecoderAttention, cfg, self.attn_type, name="self_attn")
         if cfg.parallel_residual:
             h = ln(name="input_layernorm")(x)
             # gpt-neox norms attn and mlp separately even in the parallel
             # topology; falcon/phi share one norm
             hm = ln(name="post_attention_layernorm")(x) if cfg.parallel_mlp_norm else h
-            return x + DecoderAttention(cfg, name="self_attn")(h, cos, sin, pos_ids) \
+            return x + attn()(h, cos, sin, pos_ids) \
                 + DecoderMLP(cfg, name="mlp")(hm)
         h = ln(name="input_layernorm")(x)
-        x = x + DecoderAttention(cfg, name="self_attn")(h, cos, sin, pos_ids)
+        x = x + attn()(h, cos, sin, pos_ids)
         h = ln(name="post_attention_layernorm")(x)
         return x + DecoderMLP(cfg, name="mlp")(h)
 
@@ -249,7 +279,8 @@ class DecoderModel(nn.Module):
             rot = int(round(D * cfg.rotary_pct)) // 2 * 2
             cos, sin = rotary_embedding(S, rot, cfg.rope_theta, jnp.float32)
         for i in range(cfg.num_hidden_layers):
-            x = DecoderBlock(cfg, name=f"layers_{i}")(x, cos, sin, pos_ids)
+            atype = cfg.attention_layers[i] if cfg.attention_layers else "global"
+            x = DecoderBlock(cfg, atype, name=f"layers_{i}")(x, cos, sin, pos_ids)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="final_layer_norm")(x)
         return nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias, dtype=cfg.dtype,
                         name="lm_head")(x)

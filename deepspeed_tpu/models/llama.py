@@ -39,9 +39,10 @@ class LlamaConfig:
     remat_policy: str = "nothing"
     sequence_parallel: bool = False
     use_flash_attention: bool = False
-    # llama-family deltas: qwen2 adds q/k/v biases; mistral masks beyond a
-    # sliding attention window
+    # llama-family deltas: qwen2 adds q/k/v biases; internlm biases the output
+    # projection too; mistral masks beyond a sliding attention window
     attention_bias: bool = False
+    attention_out_bias: bool = False
     sliding_window: int = 0  # 0 = disabled
     model_type: str = "llama"
 
@@ -116,8 +117,6 @@ class LlamaAttention(nn.Module):
         cfg = self.cfg
         H, KVH = cfg.num_attention_heads, cfg.num_key_value_heads
         D = cfg.hidden_size // H
-        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype)
-
         qkv_dense = partial(nn.Dense, use_bias=cfg.attention_bias, dtype=cfg.dtype)
         q = qkv_dense(H * D, name="q_proj")(x).reshape(*x.shape[:-1], H, D)
         k = qkv_dense(KVH * D, name="k_proj")(x).reshape(*x.shape[:-1], KVH, D)
@@ -135,7 +134,8 @@ class LlamaAttention(nn.Module):
             attn = DistributedAttention(attn)
         out = attn(q, k, v)
         out = out.reshape(*x.shape[:-1], H * D)
-        return dense(cfg.hidden_size, name="o_proj")(out)
+        o_dense = partial(nn.Dense, use_bias=cfg.attention_out_bias, dtype=cfg.dtype)
+        return o_dense(cfg.hidden_size, name="o_proj")(out)
 
 
 class LlamaMLP(nn.Module):

@@ -38,9 +38,11 @@ class MixtralConfig:
 
     @staticmethod
     def tiny(**kw):
-        return MixtralConfig(vocab_size=256, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
-                             num_attention_heads=4, num_key_value_heads=2, num_local_experts=4,
-                             max_position_embeddings=128, remat=False, **kw)
+        base = dict(vocab_size=256, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                    num_attention_heads=4, num_key_value_heads=2, num_local_experts=4,
+                    max_position_embeddings=128, remat=False)
+        base.update(kw)
+        return MixtralConfig(**base)
 
     def as_llama(self) -> LlamaConfig:
         return LlamaConfig(vocab_size=self.vocab_size, hidden_size=self.hidden_size,

@@ -46,6 +46,18 @@ def _hf_tiny(model_type):
                             num_attention_heads=2, intermediate_size=64,
                             max_position_embeddings=32)
         return tf.BertModel(cfg)
+    if model_type == "gpt_neo":
+        # window < seq so the local layer's sliding mask actually bites
+        cfg = tf.GPTNeoConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                              num_heads=2, intermediate_size=64,
+                              max_position_embeddings=32,
+                              attention_types=[[["global", "local"], 1]],
+                              window_size=4)
+        return tf.GPTNeoForCausalLM(cfg)
+    if model_type == "distilbert":
+        cfg = tf.DistilBertConfig(vocab_size=128, dim=32, n_layers=2, n_heads=2,
+                                  hidden_dim=64, max_position_embeddings=32)
+        return tf.DistilBertModel(cfg)
     raise ValueError(model_type)
 
 
@@ -64,10 +76,10 @@ def _torch_logits(m, ids):
     return out.last_hidden_state.float().numpy()
 
 
-CAUSAL = ["gpt2", "opt", "gpt_neox", "gptj", "bloom"]
+CAUSAL = ["gpt2", "opt", "gpt_neox", "gptj", "bloom", "gpt_neo"]
 
 
-@pytest.mark.parametrize("model_type", CAUSAL + ["bert"])
+@pytest.mark.parametrize("model_type", CAUSAL + ["bert", "distilbert"])
 def test_checkpoint_matches_torch_forward(tmp_path, model_type):
     """End-to-end: transformers writes the checkpoint; our policy loads it; the
     flax forward reproduces the torch forward."""
@@ -169,3 +181,134 @@ def test_unknown_model_type_raises(tmp_path):
     with pytest.raises(NotImplementedError, match="mystery"):
         load_hf_checkpoint(str(p))
     assert {"gpt2", "opt", "gpt_neox", "gptj", "bloom", "bert", "llama"} <= set(supported_model_types())
+
+
+def test_opt_variant_rejections():
+    """OPT variants whose tensor names match but whose math differs must be
+    rejected loudly (ADVICE r4): post-layernorm (do_layer_norm_before=False)
+    and projected embeddings (word_embed_proj_dim != hidden_size) would
+    otherwise convert successfully and serve wrong logits."""
+    from deepspeed_tpu.module_inject.containers import _POLICIES
+
+    pol = _POLICIES["opt"]
+    base = {"vocab_size": 128, "hidden_size": 32, "ffn_dim": 64,
+            "num_hidden_layers": 2, "num_attention_heads": 2,
+            "max_position_embeddings": 32}
+    with pytest.raises(NotImplementedError, match="do_layer_norm_before"):
+        pol.build(base | {"do_layer_norm_before": False})
+    with pytest.raises(NotImplementedError, match="word_embed_proj_dim"):
+        pol.build(base | {"word_embed_proj_dim": 16})
+    pol.build(base)  # the supported variant still builds
+
+
+def test_sharded_safetensors_checkpoint_loads(tmp_path):
+    """Sharded safetensors (model.safetensors.index.json + shards — the HF
+    default for models over ~5 GB) must load, not fall through to a misleading
+    'no model.safetensors' error (ADVICE r4)."""
+    import os
+    m = _hf_tiny("gpt2").eval()
+    path = str(tmp_path / "gpt2_sharded")
+    # a tiny max_shard_size forces the index + multi-shard form
+    m.save_pretrained(path, max_shard_size="20KB")
+    assert os.path.exists(os.path.join(path, "model.safetensors.index.json"))
+    assert not os.path.exists(os.path.join(path, "model.safetensors"))
+    module, params, cfg = load_hf_checkpoint(path)
+    ids = np.arange(8, dtype=np.int32)[None, :]
+    got = np.asarray(module.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, _torch_logits(m, ids), rtol=RTOL, atol=ATOL)
+
+
+def test_internlm_checkpoint_matches_torch(tmp_path):
+    """InternLM-1 is the llama architecture with biases on all four attention
+    projections; transformers' Llama with attention_bias=True has identical
+    tensor names/shapes, so it writes the fixture and is the torch oracle."""
+    import json
+    import os
+    cfg = transformers.LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                                   num_hidden_layers=2, num_attention_heads=2,
+                                   num_key_value_heads=2, max_position_embeddings=32,
+                                   attention_bias=True)
+    m = transformers.LlamaForCausalLM(cfg).eval()
+    path = str(tmp_path / "internlm")
+    m.save_pretrained(path)
+    with open(os.path.join(path, "config.json")) as f:
+        c = json.load(f)
+    c["model_type"] = "internlm"
+    c["bias"] = True
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(c, f)
+
+    module, params, _ = load_hf_checkpoint(path)
+    # the biases really landed (a bias-dropping regression would still pass
+    # a biasless forward comparison on a biasless fixture)
+    assert "bias" in params["layers_0"]["self_attn"]["o_proj"]
+    assert "bias" in params["layers_0"]["self_attn"]["q_proj"]
+    ids = np.arange(32).reshape(2, 16).astype(np.int32) % 128
+    got = np.asarray(module.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, _torch_logits(m, ids), rtol=RTOL, atol=ATOL)
+
+
+def _hf_gpt2_to_megatron(m, ver, path):
+    """Rewrite an HF GPT-2 checkpoint in Megatron-LM form: language_model.*
+    naming, Linear [out,in] storage, fused QKV in the requested
+    checkpoint_version layout (0 = contiguous q|k|v sections; >=1.0 =
+    per-head interleaved)."""
+    import json
+    import os
+    from safetensors.numpy import save_file
+
+    sd = {k: v.detach().float().numpy() for k, v in m.state_dict().items()}
+    H, E = m.config.n_head, m.config.n_embd
+    D = E // H
+    enc = "language_model.encoder" if ver else "language_model.transformer"
+    out = {
+        "language_model.embedding.word_embeddings.weight": sd["transformer.wte.weight"],
+        "language_model.embedding.position_embeddings.weight": sd["transformer.wpe.weight"],
+        f"{enc}.final_layernorm.weight": sd["transformer.ln_f.weight"],
+        f"{enc}.final_layernorm.bias": sd["transformer.ln_f.bias"],
+    }
+    for i in range(m.config.n_layer):
+        src, dst = f"transformer.h.{i}", f"{enc}.layers.{i}"
+        fused_w = sd[f"{src}.attn.c_attn.weight"].T.copy()  # Conv1D [in,3h] -> [3h,in]
+        fused_b = sd[f"{src}.attn.c_attn.bias"].copy()
+        if ver:
+            # sections -> per-head layouts: ver 2.0 = [np, 3, hn] blocks,
+            # ver 1.0 = [np, hn, 3] (q/k/v vary fastest within each head)
+            axis = 1 if ver == 2.0 else 2
+            qkv_w = np.stack([w.reshape(H, D, E) for w in np.split(fused_w, 3)], axis=axis)
+            fused_w = qkv_w.reshape(3 * H * D, E)
+            qkv_b = np.stack([b.reshape(H, D) for b in np.split(fused_b, 3)], axis=axis)
+            fused_b = qkv_b.reshape(3 * H * D)
+        out[f"{dst}.attention.query_key_value.weight"] = fused_w
+        out[f"{dst}.attention.query_key_value.bias"] = fused_b
+        for mine, theirs in (("attn.c_proj", "attention.dense"),
+                             ("mlp.c_fc", "mlp.dense_h_to_4h"),
+                             ("mlp.c_proj", "mlp.dense_4h_to_h")):
+            out[f"{dst}.{theirs}.weight"] = sd[f"{src}.{mine}.weight"].T.copy()
+            out[f"{dst}.{theirs}.bias"] = sd[f"{src}.{mine}.bias"].copy()
+        for ln in ("ln_1", "ln_2"):
+            theirs = "input_layernorm" if ln == "ln_1" else "post_attention_layernorm"
+            out[f"{dst}.{theirs}.weight"] = sd[f"{src}.{ln}.weight"]
+            out[f"{dst}.{theirs}.bias"] = sd[f"{src}.{ln}.bias"]
+    os.makedirs(path, exist_ok=True)
+    save_file(out, os.path.join(path, "model.safetensors"))
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump({"model_type": "megatron_gpt", "num_layers": m.config.n_layer,
+                   "hidden_size": E, "num_attention_heads": H,
+                   "max_position_embeddings": m.config.n_positions,
+                   "padded_vocab_size": m.config.vocab_size,
+                   "checkpoint_version": ver}, f)
+
+
+@pytest.mark.parametrize("ver", [0, 1.0, 2.0])
+def test_megatron_gpt_checkpoint_matches_torch(tmp_path, ver):
+    """Megatron-GPT container: both fused-QKV checkpoint versions must
+    reproduce the torch GPT-2 forward (the megatron-gpt2 architecture is
+    gpt2; only the storage differs)."""
+    m = _hf_tiny("gpt2").eval()
+    path = str(tmp_path / f"megatron_v{ver}")
+    _hf_gpt2_to_megatron(m, ver, path)
+    module, params, _ = load_hf_checkpoint(path)
+    ids = np.arange(32).reshape(2, 16).astype(np.int32) % 128
+    got = np.asarray(module.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, _torch_logits(m, ids), rtol=RTOL, atol=ATOL)
