@@ -1,0 +1,159 @@
+"""Generate the committed reference-interop fixtures (VERDICT r5 ask #4).
+
+Run ONCE by hand (not at test time); the binary outputs under
+``tests/unit/fixtures/reference_interop/`` are committed so the interop
+tests exercise bytes the repo's own code did not produce.
+
+Two fixture families:
+
+1. Megatron fused-QKV TP shards for checkpoint versions 0 / 1.0 / 2.0.
+   The QKV tensors are split with the REFERENCE's own
+   ``MegatronSDLoader.split_query_key_value``
+   (/root/reference/deepspeed/runtime/state_dict_factory.py:258, loaded
+   surgically with its heavyweight imports stubbed — the method touches
+   neither ``self`` nor those imports). This is the code path whose
+   semantics were silently inverted through round 3 while self-round-trip
+   tests passed; pinning the reference's actual output bytes closes that
+   blind spot.
+2. A real ``transformers``-written SHARDED safetensors checkpoint
+   (model.safetensors.index.json + shards) of a tiny GPT-2, with its torch
+   forward logits, so the container tier is tested against an HF-written
+   multi-file layout end to end.
+
+Usage::
+
+    python tests/unit/fixtures/generate_reference_interop.py
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import types
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "reference_interop")
+
+H, NHEADS, D = 8, 2, 4  # hidden, heads, head_dim (H == NHEADS * D)
+MP = 2
+
+
+def load_reference_sd_factory():
+    """Import the reference state_dict_factory with its package deps stubbed
+    (logger, TorchCheckpointEngine, WeightQuantization are unused by the
+    QKV methods)."""
+    ref_runtime = "/root/reference/deepspeed/runtime"
+
+    pkg = types.ModuleType("refds")
+    pkg.__path__ = [ref_runtime]
+    sys.modules["refds"] = pkg
+
+    import logging
+    du = types.ModuleType("deepspeed.utils")
+    du.logger = logging.getLogger("refds")
+    dsm = types.ModuleType("deepspeed")
+    dsm.utils = du
+    tcem = types.ModuleType("deepspeed.runtime.checkpoint_engine.torch_checkpoint_engine")
+    tcem.TorchCheckpointEngine = type("TorchCheckpointEngine", (), {})
+    for name, mod in {
+            "deepspeed": dsm, "deepspeed.utils": du,
+            "deepspeed.runtime": types.ModuleType("deepspeed.runtime"),
+            "deepspeed.runtime.checkpoint_engine":
+                types.ModuleType("deepspeed.runtime.checkpoint_engine"),
+            "deepspeed.runtime.checkpoint_engine.torch_checkpoint_engine": tcem,
+    }.items():
+        # a real ModuleSpec so later importlib.util.find_spec(name) callers
+        # (transformers probes for deepspeed) don't crash on the stub
+        mod.__spec__ = importlib.machinery.ModuleSpec(name, None)
+        sys.modules.setdefault(name, mod)
+    wq = types.ModuleType("refds.weight_quantizer")
+    wq.WeightQuantization = type("WeightQuantization", (), {})
+    sys.modules["refds.weight_quantizer"] = wq
+
+    spec = importlib.util.spec_from_file_location(
+        "refds.state_dict_factory", os.path.join(ref_runtime, "state_dict_factory.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["refds.state_dict_factory"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def make_megatron_fixtures():
+    import torch
+
+    ref = load_reference_sd_factory()
+    loader = ref.MegatronSDLoader.__new__(ref.MegatronSDLoader)  # methods are self-free
+
+    rng = np.random.default_rng(7)
+    for ver in (0, 1.0, 2.0):
+        vdir = os.path.join(OUT, f"megatron_v{ver}")
+        os.makedirs(vdir, exist_ok=True)
+        qkv_w = rng.normal(size=(3 * H, H)).astype(np.float32)
+        qkv_b = rng.normal(size=(3 * H, )).astype(np.float32)
+        col_w = rng.normal(size=(4 * H, H)).astype(np.float32)   # dense_h_to_4h
+        col_b = rng.normal(size=(4 * H, )).astype(np.float32)
+        row_w = rng.normal(size=(H, 4 * H)).astype(np.float32)   # dense_4h_to_h
+        row_b = rng.normal(size=(H, )).astype(np.float32)
+        attn_dense_w = rng.normal(size=(H, H)).astype(np.float32)
+        norm_w = rng.normal(size=(H, )).astype(np.float32)
+
+        full = {
+            "transformer.layers.0.attention.query_key_value.weight": qkv_w,
+            "transformer.layers.0.attention.query_key_value.bias": qkv_b,
+            "transformer.layers.0.mlp.dense_h_to_4h.weight": col_w,
+            "transformer.layers.0.mlp.dense_h_to_4h.bias": col_b,
+            "transformer.layers.0.mlp.dense_4h_to_h.weight": row_w,
+            "transformer.layers.0.mlp.dense_4h_to_h.bias": row_b,
+            "transformer.layers.0.attention.dense.weight": attn_dense_w,
+            "transformer.layers.0.input_layernorm.weight": norm_w,
+        }
+        np.savez(os.path.join(vdir, "full.npz"), **full)
+
+        # per-rank shards; QKV split by the REFERENCE implementation
+        for rank in range(MP):
+            shard = {}
+            for k, v in full.items():
+                if "query_key_value" in k:
+                    out = loader.split_query_key_value(torch.from_numpy(v), MP, rank, ver)
+                    shard[k] = out.numpy()
+                elif "dense_h_to_4h" in k:  # column-parallel: weight AND bias split
+                    shard[k] = np.split(v, MP, axis=0)[rank]
+                elif k.endswith("dense_4h_to_h.weight") or k.endswith("attention.dense.weight"):
+                    shard[k] = np.split(v, MP, axis=1)[rank]  # row-parallel fan-in
+                else:
+                    shard[k] = v  # norms + row-parallel biases replicate
+            np.savez(os.path.join(vdir, f"mp_rank_{rank:02d}.npz"), **shard)
+
+        # the reference MERGE of those shards (merge oracle, independent of ours)
+        merged_qkv_w = loader.merge_query_key_value(
+            [torch.from_numpy(np.load(os.path.join(vdir, f"mp_rank_{r:02d}.npz"))
+                              ["transformer.layers.0.attention.query_key_value.weight"])
+             for r in range(MP)], ver).numpy()
+        np.savez(os.path.join(vdir, "reference_merged_qkv.npz"), weight=merged_qkv_w)
+        print(f"megatron v{ver}: full + {MP} reference-split shards written")
+
+
+def make_sharded_safetensors_fixture():
+    import torch
+    import transformers
+
+    path = os.path.join(OUT, "gpt2_sharded")
+    cfg = transformers.GPT2Config(vocab_size=96, n_positions=24, n_embd=16,
+                                  n_layer=2, n_head=2)
+    torch.manual_seed(11)
+    m = transformers.GPT2LMHeadModel(cfg).eval()
+    m.save_pretrained(path, max_shard_size="20KB")
+    assert os.path.exists(os.path.join(path, "model.safetensors.index.json"))
+    ids = np.arange(20, dtype=np.int64).reshape(2, 10) % 96
+    with torch.no_grad():
+        logits = m(torch.from_numpy(ids)).logits.float().numpy()
+    np.savez(os.path.join(path, "expected_logits.npz"), ids=ids.astype(np.int32),
+             logits=logits)
+    print(f"sharded safetensors gpt2 written to {path}")
+
+
+if __name__ == "__main__":
+    os.makedirs(OUT, exist_ok=True)
+    make_megatron_fixtures()
+    make_sharded_safetensors_fixture()

@@ -1,0 +1,91 @@
+"""Interop against committed fixtures the repo's code did NOT write
+(VERDICT r5 ask #4).
+
+- Megatron fused-QKV TP shards for checkpoint versions 0 / 1.0 / 2.0 whose
+  QKV split bytes were produced by the REFERENCE's own
+  ``MegatronSDLoader.split_query_key_value``
+  (/root/reference/deepspeed/runtime/state_dict_factory.py:258; see
+  tests/unit/fixtures/generate_reference_interop.py). The ver-0 semantics
+  were silently inverted through round 3 while self-round-trip tests
+  passed — these tests go red if either direction's format handling
+  regresses again.
+- A real transformers-written SHARDED safetensors GPT-2 checkpoint with
+  its torch logits.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+FIX = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "fixtures", "reference_interop")
+
+VERSIONS = [0, 1.0, 2.0]
+QKV_W = "transformer.layers.0.attention.query_key_value.weight"
+QKV_B = "transformer.layers.0.attention.query_key_value.bias"
+
+
+def _vdir(ver):
+    return os.path.join(FIX, f"megatron_v{ver}")
+
+
+@pytest.mark.parametrize("ver", VERSIONS)
+def test_merge_reference_shards_reconstructs_full(ver):
+    """Our loader must merge the REFERENCE-split shards back to the original
+    full state dict, byte-for-byte, for every checkpoint version."""
+    from deepspeed_tpu.runtime.state_dict_factory import SDLoaderFactory
+
+    shards = [os.path.join(_vdir(ver), f"mp_rank_{r:02d}.npz") for r in range(2)]
+    loader = SDLoaderFactory.get_sd_loader(shards, version=ver)
+    _, merged = loader.load(mp_world_size=1, mp_rank=0)
+    with np.load(os.path.join(_vdir(ver), "full.npz")) as full:
+        for k in full.files:
+            np.testing.assert_array_equal(
+                np.asarray(merged[k]), full[k],
+                err_msg=f"v{ver}: merged {k} != reference full tensor")
+    # and the reference's own merge oracle agrees on the fused QKV
+    with np.load(os.path.join(_vdir(ver), "reference_merged_qkv.npz")) as oracle:
+        np.testing.assert_array_equal(np.asarray(merged[QKV_W]), oracle["weight"])
+
+
+@pytest.mark.parametrize("ver", VERSIONS)
+def test_split_full_matches_reference_shards(ver):
+    """Our loader splitting the full dict to mp=2 must reproduce the shards
+    the REFERENCE split code wrote — the direction that hid the inverted
+    ver-0 bug."""
+    from deepspeed_tpu.runtime.state_dict_factory import SDLoaderFactory
+
+    loader = SDLoaderFactory.get_sd_loader(
+        [os.path.join(_vdir(ver), "full.npz")], version=ver)
+    for rank in range(2):
+        _, ours = loader.load(mp_world_size=2, mp_rank=rank)
+        with np.load(os.path.join(_vdir(ver), f"mp_rank_{rank:02d}.npz")) as want:
+            for k in (QKV_W, QKV_B):
+                np.testing.assert_array_equal(
+                    np.asarray(ours[k]), want[k],
+                    err_msg=f"v{ver} rank {rank}: split {k} != reference shard")
+
+
+def test_versions_zero_and_headwise_differ_on_shards():
+    """Sanity on the fixtures themselves: ver-0 (sectioned) and ver-2.0
+    (per-head) shards must NOT be interchangeable — if they were, these
+    tests couldn't catch a version-semantics regression."""
+    a = np.load(os.path.join(_vdir(0), "mp_rank_00.npz"))[QKV_W]
+    b = np.load(os.path.join(_vdir(2.0), "mp_rank_00.npz"))[QKV_W]
+    assert a.shape == b.shape
+    assert not np.array_equal(a, b)
+
+
+def test_transformers_sharded_safetensors_end_to_end():
+    """The committed HF-written sharded checkpoint loads through the
+    container tier and reproduces the recorded torch logits."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.module_inject.containers import load_hf_checkpoint
+
+    path = os.path.join(FIX, "gpt2_sharded")
+    module, params, _ = load_hf_checkpoint(path)
+    with np.load(os.path.join(path, "expected_logits.npz")) as z:
+        ids, want = z["ids"], z["logits"]
+    got = np.asarray(module.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
