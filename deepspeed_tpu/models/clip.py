@@ -1,0 +1,122 @@
+"""CLIP text encoder for the v1 injection-container family.
+
+Reference: ``deepspeed/module_inject/containers/clip.py`` (HFCLIPLayerPolicy
+over ``CLIPEncoderLayer``) — in Stable-Diffusion serving the injected piece
+is the pipeline's text encoder (a ``CLIPTextModel`` checkpoint,
+``model_type: clip_text_model``). Faithful to ``transformers.CLIPTextModel``:
+pre-LN residual blocks, CAUSAL self-attention (CLIP's text tower is causal),
+quick-gelu, learned absolute positions, final LayerNorm, and the
+highest-token-id pooling trick (HF pools the hidden state at
+``input_ids.argmax(-1)``, the EOS position for CLIP tokenizers).
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+@dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 512
+    intermediate_size: int = 2048
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 8
+    max_position_embeddings: int = 77
+    layer_norm_eps: float = 1e-5
+    hidden_act: str = "quick_gelu"
+    # legacy configs (eos_token_id == 2, pre transformers#24773) pool at the
+    # HIGHEST token id; updated configs pool at the FIRST eos position
+    eos_token_id: int = 49407
+    dtype: any = jnp.float32
+
+    @classmethod
+    def tiny(cls, **kw):
+        base = dict(vocab_size=99, hidden_size=32, intermediate_size=64,
+                    num_hidden_layers=2, num_attention_heads=2,
+                    max_position_embeddings=24)
+        base.update(kw)
+        return cls(**base)
+
+
+def _act(cfg):
+    if cfg.hidden_act == "quick_gelu":
+        return lambda x: x * jax.nn.sigmoid(1.702 * x)
+    if cfg.hidden_act in ("gelu", "gelu_new"):
+        return partial(nn.gelu, approximate=cfg.hidden_act == "gelu_new")
+    raise NotImplementedError(f"clip hidden_act {cfg.hidden_act!r}")
+
+
+class CLIPAttention(nn.Module):
+    cfg: CLIPTextConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        H = cfg.num_attention_heads
+        D = cfg.hidden_size // H
+        dense = partial(nn.Dense, dtype=cfg.dtype)
+        q = dense(cfg.hidden_size, name="q_proj")(x).reshape(*x.shape[:-1], H, D)
+        k = dense(cfg.hidden_size, name="k_proj")(x).reshape(*x.shape[:-1], H, D)
+        v = dense(cfg.hidden_size, name="v_proj")(x).reshape(*x.shape[:-1], H, D)
+        S = x.shape[1]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(D)
+        mask = jnp.tril(jnp.ones((S, S), bool))  # text tower is causal
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(*x.shape[:-1], H * D)
+        return dense(cfg.hidden_size, name="out_proj")(out)
+
+
+class CLIPEncoderLayer(nn.Module):
+    cfg: CLIPTextConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        ln = partial(nn.LayerNorm, epsilon=cfg.layer_norm_eps, dtype=cfg.dtype)
+        x = x + CLIPAttention(cfg, name="self_attn")(ln(name="layer_norm1")(x))
+        h = ln(name="layer_norm2")(x)
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="fc1")(h)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="fc2")(_act(cfg)(h))
+        return x + h
+
+
+class CLIPTextModel(nn.Module):
+    cfg: CLIPTextConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     name="token_embedding")(input_ids)
+        x = x + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, dtype=cfg.dtype,
+                         name="position_embedding")(jnp.arange(S)[None])
+        for i in range(cfg.num_hidden_layers):
+            x = CLIPEncoderLayer(cfg, name=f"layers_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="final_layer_norm")(x)
+        if cfg.eos_token_id == 2:
+            # legacy: EOT token is the highest id in each sequence
+            pos = jnp.argmax(input_ids, axis=-1)
+        else:
+            # first occurrence of the configured eos token
+            pos = jnp.argmax((input_ids == cfg.eos_token_id).astype(jnp.int32), axis=-1)
+        pooled = x[jnp.arange(B), pos]
+        return x, pooled
+
+
+def init_params(cfg: CLIPTextConfig, batch_size: int = 2, seq_len: Optional[int] = None,
+                rng=None):
+    model = CLIPTextModel(cfg)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    S = seq_len or min(cfg.max_position_embeddings, 16)
+    ids = jnp.zeros((batch_size, S), jnp.int32)
+    return model, model.init(rng, ids)["params"]

@@ -312,3 +312,107 @@ def test_megatron_gpt_checkpoint_matches_torch(tmp_path, ver):
     ids = np.arange(32).reshape(2, 16).astype(np.int32) % 128
     got = np.asarray(module.apply({"params": params}, jnp.asarray(ids)))
     np.testing.assert_allclose(got, _torch_logits(m, ids), rtol=RTOL, atol=ATOL)
+
+
+def test_clip_text_model_matches_torch(tmp_path):
+    """CLIP text encoder (reference containers/clip.py role — the injected
+    piece of a Stable-Diffusion pipeline): last hidden state AND the
+    argmax-token pooling must match transformers.CLIPTextModel."""
+    cfg = transformers.CLIPTextConfig(vocab_size=99, hidden_size=32,
+                                      intermediate_size=64, num_hidden_layers=2,
+                                      num_attention_heads=2,
+                                      max_position_embeddings=24,
+                                      eos_token_id=98)
+    m = transformers.CLIPTextModel(cfg).eval()
+    path = str(tmp_path / "clip_text")
+    m.save_pretrained(path)
+    module, params, _ = load_hf_checkpoint(path)
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, 97, size=(2, 12)).astype(np.int32)
+    ids[0, 7] = ids[1, 3] = 98  # an eos in each row, at different positions
+    with torch.no_grad():
+        out = m(torch.asarray(ids))
+    got_h, got_p = module.apply({"params": params}, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(got_h), out.last_hidden_state.float().numpy(),
+                               rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(np.asarray(got_p), out.pooler_output.float().numpy(),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_diffusers_checkpoints_rejected_loudly(tmp_path):
+    """The diffusion/spatial tier (reference csrc/spatial + unet/vae
+    containers) is explicitly rejected with rationale — never a silent
+    KeyError (VERDICT r5 ask #7)."""
+    import json
+    import os
+    pipe = tmp_path / "sd_pipeline"
+    os.makedirs(pipe)
+    (pipe / "model_index.json").write_text(json.dumps(
+        {"_class_name": "StableDiffusionPipeline"}))
+    with pytest.raises(NotImplementedError, match="text_encoder"):
+        load_hf_checkpoint(str(pipe))
+
+    unet = tmp_path / "unet"
+    os.makedirs(unet)
+    (unet / "config.json").write_text(json.dumps(
+        {"_class_name": "UNet2DConditionModel", "sample_size": 64}))
+    with pytest.raises(NotImplementedError, match="diffusion/spatial"):
+        load_hf_checkpoint(str(unet))
+    with pytest.raises(NotImplementedError, match="diffusion/spatial"):
+        deepspeed_tpu.init_inference(checkpoint=str(unet))
+
+
+def test_clip_legacy_eos2_pooling_matches_torch(tmp_path):
+    """SD 1.x text encoders ship configs with eos_token_id=2 — the LEGACY
+    pooling generation (hidden state at the HIGHEST token id), a different
+    branch than first-eos-position."""
+    cfg = transformers.CLIPTextConfig(vocab_size=99, hidden_size=32,
+                                      intermediate_size=64, num_hidden_layers=2,
+                                      num_attention_heads=2,
+                                      max_position_embeddings=24,
+                                      eos_token_id=2)
+    m = transformers.CLIPTextModel(cfg).eval()
+    path = str(tmp_path / "clip_legacy")
+    m.save_pretrained(path)
+    module, params, our_cfg = load_hf_checkpoint(path)
+    assert our_cfg.eos_token_id == 2
+    rng = np.random.default_rng(6)
+    ids = rng.integers(0, 99, size=(2, 12)).astype(np.int32)
+    with torch.no_grad():
+        out = m(torch.asarray(ids))
+    got_h, got_p = module.apply({"params": params}, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(got_h), out.last_hidden_state.float().numpy(),
+                               rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(np.asarray(got_p), out.pooler_output.float().numpy(),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_full_clip_checkpoint_serves_text_tower(tmp_path):
+    """A dual-tower 'clip' checkpoint (text_config nesting) loads its text
+    tower — matching torch's text_model — and never reads vision tensors."""
+    cfg = transformers.CLIPConfig(
+        text_config={"vocab_size": 99, "hidden_size": 32, "intermediate_size": 64,
+                     "num_hidden_layers": 2, "num_attention_heads": 2,
+                     "max_position_embeddings": 24, "eos_token_id": 98},
+        vision_config={"hidden_size": 32, "intermediate_size": 64,
+                       "num_hidden_layers": 2, "num_attention_heads": 2,
+                       "image_size": 32, "patch_size": 16},
+        projection_dim=32)
+    m = transformers.CLIPModel(cfg).eval()
+    path = str(tmp_path / "clip_full")
+    m.save_pretrained(path)
+    module, params, _ = load_hf_checkpoint(path)
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, 97, size=(2, 12)).astype(np.int32)
+    ids[0, 5] = ids[1, 9] = 98
+    with torch.no_grad():
+        out = m.text_model(torch.asarray(ids))
+    got_h, got_p = module.apply({"params": params}, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(got_h), out.last_hidden_state.float().numpy(),
+                               rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(np.asarray(got_p), out.pooler_output.float().numpy(),
+                               rtol=RTOL, atol=ATOL)
+    # the key filter kept the vision tower out of the loaded state dict
+    from deepspeed_tpu.module_inject.containers import _POLICIES, _load_hf_state_dict
+    sd = _load_hf_state_dict(path, key_filter=_POLICIES["clip"].key_filter({}))
+    assert sd and all(k.startswith("text_model.") for k in sd)
