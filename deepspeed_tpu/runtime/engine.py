@@ -311,7 +311,8 @@ class DeepSpeedEngine:
             from deepspeed_tpu.runtime.zero.qwz import make_qwz_cast
             self._cast_params = make_qwz_cast(self._param_shardings, self.mesh,
                                               self.compute_dtype,
-                                              zero_axes=self.zero_policy.zero_axes)
+                                              zero_axes=self.zero_policy.zero_axes,
+                                              bits=self._config.zero_config.zero_quantized_weights_bits)
         else:
             self._cast_params = functools.partial(cast_tree, dtype=self.compute_dtype)
 

@@ -99,6 +99,9 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     # ZeRO++ (reference: qwZ/qgZ/hpZ knobs)
     zero_hpz_partition_size: int = Field(1, ge=0)
     zero_quantized_weights: bool = False
+    # wire width for the qwZ all-gather payload: 8 (reference default) or 4
+    # (two nibbles per byte — halves gather bytes again at coarser levels)
+    zero_quantized_weights_bits: int = 8
     zero_quantized_nontrainable_weights: bool = False
     zero_quantized_gradients: bool = False
 

@@ -57,8 +57,56 @@ def _gathered_spec(spec, zero_axes):
     return P(*out)
 
 
-def _make_quantized_gather(dim, spec, gathered_spec, gather_axes, mesh, compute_dtype):
-    """fp32 shard -> compute-dtype full weight, moving int8 over the wire.
+def _pack_nibbles(q, axis):
+    """int8 values in [-7, 7] → two 4-bit nibbles per byte along ``axis``
+    (which must have even size)."""
+    import jax.numpy as jnp
+    q = jnp.moveaxis(q, axis, -1)
+    lo = q[..., 0::2] & 0xF
+    hi = q[..., 1::2] & 0xF
+    return jnp.moveaxis((lo | (hi << 4)).astype(jnp.int8), -1, axis)
+
+
+def _unpack_nibbles(p, axis):
+    import jax.numpy as jnp
+    p = jnp.moveaxis(p, axis, -1)
+    lo = p & 0xF
+    hi = (p >> 4) & 0xF
+    lo = lo - 16 * (lo >= 8)  # sign-extend 4-bit two's complement
+    hi = hi - 16 * (hi >= 8)
+    q = jnp.stack([lo, hi], axis=-1).reshape(*p.shape[:-1], p.shape[-1] * 2)
+    return jnp.moveaxis(q.astype(jnp.int8), -1, axis)
+
+
+def _nibble_pack_dim(shape, gather_dim, spec=None, mesh=None):
+    """A non-gather dim to pack nibble pairs along (packing a non-gather dim
+    keeps the all-gather untouched); None = int4 unavailable for this leaf.
+
+    The packed dim must stay divisible by any mesh axes sharding it (a TP
+    dim halved below its axis size breaks shard_map), so the requirement is
+    ``shape[d] % (2 * prod(axis sizes on d)) == 0``; unsharded dims are
+    preferred to avoid resharding the strided nibble slices."""
+    def axis_prod(d):
+        if spec is None or mesh is None or d >= len(tuple(spec)):
+            return 1
+        entry = tuple(spec)[d]
+        if entry is None:
+            return 1
+        axes = entry if isinstance(entry, tuple) else (entry, )
+        return int(np.prod([mesh.shape.get(ax, 1) for ax in axes]))
+
+    candidates = [d for d in range(len(shape) - 1, -1, -1)
+                  if d != gather_dim and shape[d] % (2 * axis_prod(d)) == 0]
+    unsharded = [d for d in candidates if axis_prod(d) == 1]
+    if unsharded:
+        return unsharded[0]
+    return candidates[0] if candidates else None
+
+
+def _make_quantized_gather(dim, spec, gathered_spec, gather_axes, mesh, compute_dtype,
+                           bits=8, shard_shape=None):
+    """fp32 shard -> compute-dtype full weight, moving int8 (or packed int4)
+    over the wire.
 
     The all-gather is an *explicit* ``jax.lax.all_gather`` on the s8 payload
     inside ``shard_map`` — a mere sharding constraint lets the partitioner
@@ -88,16 +136,27 @@ def _make_quantized_gather(dim, spec, gathered_spec, gather_axes, mesh, compute_
                               out_specs=(gathered_spec, scale_gathered),
                               check_vma=False)
 
+    pack_dim = _nibble_pack_dim(shard_shape, dim, spec, mesh) \
+        if (bits == 4 and shard_shape) else None
+    use_int4 = bits == 4 and pack_dim is not None
+
     @jax.custom_vjp
     def qgather(w):
-        # per-row symmetric int8 along the ZeRO-sharded dim: the scale reduces
-        # every OTHER dim, so it is elementwise w.r.t. the sharding — no
-        # communication before the gather
+        # per-row symmetric quantization along the ZeRO-sharded dim: the scale
+        # reduces every OTHER dim, so it is elementwise w.r.t. the sharding —
+        # no communication before the gather
+        levels = 7.0 if use_int4 else 127.0
         red = tuple(i for i in range(w.ndim) if i != dim)
-        scale = jnp.max(jnp.abs(w), axis=red, keepdims=True) / 127.0
+        scale = jnp.max(jnp.abs(w), axis=red, keepdims=True) / levels
         scale = jnp.maximum(scale, 1e-12)
-        q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+        q = jnp.clip(jnp.round(w / scale), -levels, levels).astype(jnp.int8)
+        if use_int4:
+            # two nibbles/byte along a non-gather dim: half the gather bytes,
+            # and the all-gather itself is untouched
+            q = _pack_nibbles(q, pack_dim)
         q, scale = gather_sm(q, scale)
+        if use_int4:
+            q = _unpack_nibbles(q, pack_dim)
         return (q.astype(jnp.float32) * scale).astype(compute_dtype)
 
     def fwd(w):
@@ -114,20 +173,24 @@ def _make_quantized_gather(dim, spec, gathered_spec, gather_axes, mesh, compute_
 
 
 def make_qwz_cast(param_shardings, mesh, compute_dtype, zero_axes=None,
-                  threshold: int = 2048):
+                  threshold: int = 2048, bits: int = 8):
     """Build the qwZ master→compute cast for the engine's parameter tree.
 
     Leaves that are floating, ndim>=2, >= ``threshold`` elements AND actually
     ZeRO-sharded take the quantized gather; everything else (norm scales,
-    biases, small or replicated params) casts exactly.
+    biases, small or replicated params) casts exactly. ``bits`` = 8 or 4
+    (4 = nibble-packed wire payload; leaves with no even-size non-gather dim
+    fall back to int8).
     """
     import jax
     import jax.numpy as jnp
 
+    if bits not in (8, 4):
+        raise ValueError(f"zero_quantized_weights_bits must be 8 or 4, got {bits}")
     zero_axes = tuple(zero_axes) if zero_axes is not None else groups.get_zero_partition_axes()
     zero_axes = tuple(ax for ax in zero_axes if mesh.shape.get(ax, 1) > 1)
 
-    def leaf_cast_factory(sharding):
+    def leaf_cast_factory(sharding, shape):
         spec = getattr(sharding, "spec", None)
         dim = _sharded_dim(spec, zero_axes) if spec is not None else None
         if dim is None:
@@ -136,7 +199,8 @@ def make_qwz_cast(param_shardings, mesh, compute_dtype, zero_axes=None,
         gather_axes = tuple(ax for ax in (entry if isinstance(entry, tuple) else (entry, ))
                             if ax in set(zero_axes))
         return _make_quantized_gather(dim, spec, _gathered_spec(spec, zero_axes),
-                                      gather_axes, mesh, compute_dtype)
+                                      gather_axes, mesh, compute_dtype,
+                                      bits=bits, shard_shape=shape)
 
     def cast(params):
         def one(w, sharding):
@@ -144,7 +208,7 @@ def make_qwz_cast(param_shardings, mesh, compute_dtype, zero_axes=None,
                 return w  # match cast_tree: non-floating leaves pass through
             if w.ndim < 2 or int(np.prod(w.shape)) < threshold:
                 return w.astype(compute_dtype)
-            fn = leaf_cast_factory(sharding)
+            fn = leaf_cast_factory(sharding, tuple(w.shape))
             if fn is None:
                 return w.astype(compute_dtype)
             return fn(w)

@@ -126,3 +126,92 @@ def test_qwz_bf16_grads_keep_master_dtype():
     eng.backward(loss)
     for g in jax.tree.leaves(eng.acc_grads):
         assert g.dtype == jnp.float32, g.dtype
+
+
+def test_qwz_int4_wire_halves_gather_payload():
+    """bits=4 packs two nibbles per byte along a non-gather dim: the compiled
+    all-gather payload must carry HALF the elements of the int8 path, and
+    training still tracks the exact run (coarser levels, looser bound)."""
+    import re
+    import jax
+    import jax.numpy as jnp
+
+    def gather_elems(bits):
+        groups.initialize_mesh(force=True)
+        model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+        cfg = _cfg(qwz=True)
+        cfg["zero_optimization"]["zero_quantized_weights_bits"] = bits
+        eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                                config=cfg)
+        batch = eng.shard_batch(random_batches(1, 16, HIDDEN)[0])
+        hlo = eng._grad_fn().lower(eng.params, batch, jax.random.PRNGKey(0),
+                                   jnp.float32(1.0)).compile().as_text()
+        shapes = re.findall(r"s8\[([\d,]+)\][^=]* all-gather\(", hlo)
+        assert shapes, f"no s8 all-gather in HLO (bits={bits})"
+        return max(int(np.prod([int(d) for d in s.split(",")])) for s in shapes)
+
+    assert gather_elems(4) * 2 == gather_elems(8)
+
+
+def test_qwz_int4_trains_close_to_exact():
+    import jax
+
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+    batches = random_batches(4, 16, HIDDEN)
+
+    results = {}
+    for bits in (None, 4):  # None = exact (qwz off)
+        groups.initialize_mesh(force=True)
+        cfg = _cfg(qwz=bits is not None)
+        if bits:
+            cfg["zero_optimization"]["zero_quantized_weights_bits"] = bits
+        eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
+                                                config=cfg)
+        results[bits] = ([float(eng.train_batch(batch=b)) for b in batches],
+                         jax.tree.leaves(jax.device_get(eng.params)))
+
+    # int4 levels are 16x coarser than int8's — same trajectory, looser bound
+    np.testing.assert_allclose(results[4][0], results[None][0], rtol=0.15)
+    for a, b in zip(results[4][1], results[None][1]):
+        np.testing.assert_allclose(a, b, atol=0.15)
+    assert any(not np.array_equal(a, b) for a, b in zip(results[4][1], results[None][1]))
+
+
+def test_qwz_bits_validated():
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=16)
+    cfg = _cfg(qwz=True)
+    cfg["zero_optimization"]["zero_quantized_weights_bits"] = 3
+    with pytest.raises(ValueError, match="bits"):
+        deepspeed_tpu.initialize(model=model, model_parameters=params0, config=cfg)
+
+
+def test_qwz_int4_pack_dim_respects_mesh_sharding():
+    """bits=4 must not pack a dim below its mesh-axis divisibility (a
+    TP-sharded dim halved under its axis size breaks shard_map): such leaves
+    fall back to int8, unsharded even dims are preferred, and the ZeRO+TP
+    case runs instead of crashing at trace time."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.runtime.zero.qwz import _nibble_pack_dim, make_qwz_cast
+
+    mesh = groups.initialize_mesh(model_parallel_size=2, force=True)  # data=4, model=2
+
+    # unit: TP-sharded dim of size 6 (even, but 6/2=3 not divisible by tp=2)
+    assert _nibble_pack_dim((8, 6), 0, P("data", "model"), mesh) is None
+    # divisible TP dim is allowed...
+    assert _nibble_pack_dim((8, 8), 0, P("data", "model"), mesh) == 1
+    # ...but an unsharded even dim is preferred over a sharded one
+    assert _nibble_pack_dim((4, 8, 8), 0, P("data", "model", None), mesh) == 2
+
+    # end-to-end: a ZeRO+TP-sharded leaf with a non-2*tp-divisible free dim
+    # takes the int8 fallback and the cast still runs under jit
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(8, 6)), jnp.float32)
+    shardings = {"w": NamedSharding(mesh, P("data", "model"))}
+    cast = make_qwz_cast(shardings, mesh, jnp.bfloat16, zero_axes=("data", ),
+                         threshold=0, bits=4)
+    out = jax.jit(cast)({"w": jax.device_put(w, shardings["w"])})
+    np.testing.assert_allclose(np.asarray(out["w"], np.float32), np.asarray(w),
+                               atol=float(np.abs(w).max()) / 127 + 1e-6)
