@@ -12,15 +12,44 @@ import sys
 from typing import Optional, Union
 
 from deepspeed_tpu import comm as comm
+from deepspeed_tpu import module_inject
+from deepspeed_tpu import ops
 from deepspeed_tpu.accelerator import get_accelerator
 from deepspeed_tpu.comm.comm import init_distributed
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.module_inject import replace_transformer_layer, revert_transformer_layer
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                           DeepSpeedTransformerLayer)
+from deepspeed_tpu.runtime import DeepSpeedOptimizer, ZeROOptimizer
 from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
 from deepspeed_tpu.runtime import zero
 from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+from deepspeed_tpu.runtime.lr_schedules import add_tuning_arguments
 from deepspeed_tpu.utils import groups, logger, log_dist
+from deepspeed_tpu.utils.init_on_device import OnDevice
 from deepspeed_tpu.version import __version__, git_branch, git_hash
 
 dist = comm
+
+
+def __getattr__(name):
+    # engine/pipe/inference classes re-exported LAZILY (reference
+    # deepspeed/__init__.py exports them eagerly; here an eager import would
+    # pull jax-heavy modules into every `import deepspeed_tpu`)
+    lazy = {
+        "DeepSpeedEngine": ("deepspeed_tpu.runtime.engine", "DeepSpeedEngine"),
+        "DeepSpeedHybridEngine": ("deepspeed_tpu.runtime.hybrid_engine",
+                                  "DeepSpeedHybridEngine"),
+        "PipelineEngine": ("deepspeed_tpu.runtime.pipe.engine", "PipelineEngine"),
+        "PipelineModule": ("deepspeed_tpu.runtime.pipe.module", "PipelineModule"),
+        "InferenceEngine": ("deepspeed_tpu.inference.engine", "InferenceEngine"),
+        "InferenceEngineV2": ("deepspeed_tpu.inference.v2.engine_v2", "InferenceEngineV2"),
+    }
+    if name in lazy:
+        import importlib
+        mod, attr = lazy[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module 'deepspeed_tpu' has no attribute {name!r}")
 
 
 def initialize(args=None,

@@ -1,3 +1,5 @@
 from deepspeed_tpu.module_inject.auto_tp import auto_tp_specs
 from deepspeed_tpu.module_inject.layers import (EmbeddingLayer, LinearAllreduce, LinearLayer,
                                                 Normalize)
+from deepspeed_tpu.module_inject.replace_module import (replace_transformer_layer,
+                                                        revert_transformer_layer)

@@ -1,0 +1,29 @@
+"""In-place module replacement entry points (reference
+``deepspeed/module_inject/replace_module.py``).
+
+The reference swaps live ``torch.nn.Module`` layers for fused kernels inside
+an already-constructed model. Flax modules are immutable descriptions, so
+in-process surgery has no TPU analog — injection happens at the CHECKPOINT
+boundary instead (``containers.load_hf_checkpoint`` /
+``init_inference(checkpoint=...)``), which covers the same architectures
+with torch-forward parity. These functions exist so reference call sites
+fail with a pointer at the equivalent path rather than an AttributeError.
+"""
+
+
+def replace_transformer_layer(orig_layer_impl=None, model=None, checkpoint_dict=None,
+                              config=None, model_config=None):
+    raise NotImplementedError(
+        "replace_transformer_layer: flax modules are immutable, so live-module "
+        "surgery has no TPU analog. Use deepspeed_tpu.init_inference("
+        "checkpoint=<hf_dir>) — the checkpoint-boundary injection path covering "
+        "the same architectures (module_inject/containers.py) — or serve through "
+        "the v2 ragged engine (inference.v2.engine_factory.build_engine).")
+
+
+def revert_transformer_layer(orig_layer_impl=None, model=None, config=None,
+                             preln=False):
+    raise NotImplementedError(
+        "revert_transformer_layer: nothing to revert — TPU injection happens at "
+        "the checkpoint boundary (see replace_transformer_layer), leaving no "
+        "live model to restore.")

@@ -16,9 +16,17 @@ from typing import Any, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.runtime import DeepSpeedOptimizer
 
-class TpuOptimizer:
-    """Functional optimizer protocol; subclasses implement init/update."""
+
+class TpuOptimizer(DeepSpeedOptimizer):
+    """Functional optimizer protocol; subclasses implement init/update.
+
+    Subclasses ``DeepSpeedOptimizer`` so reference-style
+    ``isinstance(engine.optimizer, deepspeed.DeepSpeedOptimizer)`` checks
+    hold; when the engine runs ZeRO it additionally mixes ``ZeROOptimizer``
+    into the instance (engine.py) so the sharded case is distinguishable the
+    way the reference's wrapped optimizers are."""
 
     name = "base"
 

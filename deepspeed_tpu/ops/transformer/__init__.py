@@ -1,0 +1,3 @@
+from deepspeed_tpu.ops.transformer.transformer import (DeepSpeedTransformerConfig,
+                                                       DeepSpeedTransformerLayer,
+                                                       init_params)

@@ -1,0 +1,11 @@
+"""Runtime package. ``DeepSpeedOptimizer``/``ZeROOptimizer`` are the
+reference's marker base classes (``deepspeed/runtime/__init__.py``) used by
+callers for isinstance checks on wrapped optimizers."""
+
+
+class DeepSpeedOptimizer:
+    pass
+
+
+class ZeROOptimizer(DeepSpeedOptimizer):
+    pass

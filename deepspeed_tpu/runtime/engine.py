@@ -282,6 +282,15 @@ class DeepSpeedEngine:
             self.optimizer = optimizer
         else:
             self.optimizer = _make_optimizer(self._config.optimizer_name, self._config.optimizer_params)
+        if self._config.zero_config.stage >= 1:
+            # mix ZeROOptimizer into the instance: reference callers use
+            # isinstance(engine.optimizer, ZeROOptimizer) to detect sharded
+            # state (their ZeRO stages WRAP the base optimizer; here the
+            # sharding lives in placement policies, so the marker is mixed in)
+            from deepspeed_tpu.runtime import ZeROOptimizer
+            cls = type(self.optimizer)
+            if not isinstance(self.optimizer, ZeROOptimizer):
+                self.optimizer.__class__ = type(cls.__name__, (cls, ZeROOptimizer), {})
         opt_shapes = jax.eval_shape(self.optimizer.init, self.params)
         opt_base = _broadcast_param_specs(opt_shapes, self.params, self.param_specs) \
             if self.param_specs is not None else None

@@ -1,0 +1,89 @@
+"""OnDevice — construct model parameters on a chosen device/dtype.
+
+Reference: ``deepspeed/utils/init_on_device.py`` (OnDevice patches the torch
+tensor constructors so ``MyModel()`` materializes on 'meta' or a specific
+device in the requested dtype). The flax world is functional — construction
+happens at ``module.init`` — so the TPU analog scopes ``jax.default_device``
+AND patches ``flax.linen.Module.init`` to cast floating parameter leaves to
+the requested dtype (the same constructor-interception spirit, at flax's one
+construction chokepoint).
+
+``device='meta'`` (allocation-free construction) maps to the framework's
+real deferred-init mechanisms instead of a fake: ``jax.eval_shape`` for
+shapes-only, or ``deepspeed_tpu.zero.Init`` for sharded-at-birth engine
+params — the error says so rather than pretending.
+"""
+
+from typing import Any
+
+_ACTIVE: list = []  # innermost-last stack of active OnDevice scopes
+_PATCH_DEPTH = 0
+_ORIG_INIT = None
+
+
+def _cast_tree(tree, dtype):
+    import jax
+    import jax.numpy as jnp
+    return jax.tree.map(
+        lambda l: l.astype(dtype)
+        if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating) else l, tree)
+
+
+def _patched_init(self, *args, **kwargs):
+    out = _ORIG_INIT(self, *args, **kwargs)
+    if _ACTIVE and _ACTIVE[-1].dtype is not None:
+        out = _cast_tree(out, _ACTIVE[-1].dtype)
+    return out
+
+
+class OnDevice:
+    """``with OnDevice(dtype=jnp.bfloat16, device=jax.devices()[0]): ...``
+
+    Inside the block, ``jax.default_device`` routes new arrays to ``device``
+    and ``module.init`` results have their floating leaves cast to ``dtype``
+    (innermost scope wins; ``OnDevice.current_dtype()`` exposes it to custom
+    init helpers). Reentrant: each ``__enter__`` pushes its own scope.
+    """
+
+    def __init__(self, dtype, device: Any = "meta", enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+        self._ctx_stack: list = []
+        if enabled and isinstance(device, str) and device == "meta":
+            raise NotImplementedError(
+                "OnDevice(device='meta'): flax has no imperative construction "
+                "to intercept — use jax.eval_shape for allocation-free shapes, "
+                "or deepspeed_tpu.zero.Init for sharded-at-birth engine "
+                "parameters (the ZeRO-3 deferred-init path).")
+
+    @staticmethod
+    def current_dtype(default=None):
+        return _ACTIVE[-1].dtype if _ACTIVE else default
+
+    def __enter__(self):
+        if self.enabled:
+            global _PATCH_DEPTH, _ORIG_INIT
+            import jax
+            import flax.linen as nn
+            ctx = jax.default_device(self.device)
+            ctx.__enter__()
+            self._ctx_stack.append(ctx)
+            _ACTIVE.append(self)
+            if _PATCH_DEPTH == 0:
+                _ORIG_INIT = nn.Module.init
+                nn.Module.init = _patched_init
+            _PATCH_DEPTH += 1
+        return self
+
+    def __exit__(self, *exc):
+        if self.enabled:
+            global _PATCH_DEPTH, _ORIG_INIT
+            import flax.linen as nn
+            _PATCH_DEPTH -= 1
+            if _PATCH_DEPTH == 0:
+                nn.Module.init = _ORIG_INIT
+                _ORIG_INIT = None
+            _ACTIVE.pop()
+            return self._ctx_stack.pop().__exit__(*exc)
+        return False

@@ -1,0 +1,138 @@
+"""DeepSpeedTransformerLayer (reference ops/transformer/transformer.py — the
+trainable BERT-style fused block)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                           DeepSpeedTransformerLayer, init_params)
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _cfg(**kw):
+    base = dict(hidden_size=32, intermediate_size=64, heads=2,
+                attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0,
+                num_hidden_layers=2, initializer_range=0.02, training=False)
+    base.update(kw)
+    return DeepSpeedTransformerConfig(**base)
+
+
+def test_post_ln_matches_transformers_bert_layer():
+    """pre_layer_norm=False is the reference's Post-LN mode — BertLayer math;
+    parity against the torch implementation with mapped weights."""
+    from transformers.models.bert.modeling_bert import BertLayer
+
+    hf = transformers.BertConfig(hidden_size=32, num_attention_heads=2,
+                                 intermediate_size=64, hidden_dropout_prob=0.0,
+                                 attention_probs_dropout_prob=0.0,
+                                 attn_implementation="eager")
+    torch.manual_seed(0)
+    tl = BertLayer(hf).eval()
+    sd = {k: v.detach().numpy() for k, v in tl.state_dict().items()}
+
+    def dense(pfx):
+        return {"kernel": np.ascontiguousarray(sd[f"{pfx}.weight"].T),
+                "bias": sd[f"{pfx}.bias"]}
+
+    def ln(pfx):
+        return {"scale": sd[f"{pfx}.weight"], "bias": sd[f"{pfx}.bias"]}
+
+    params = {"layer": {
+        "q_proj": dense("attention.self.query"),
+        "k_proj": dense("attention.self.key"),
+        "v_proj": dense("attention.self.value"),
+        "attn_out": dense("attention.output.dense"),
+        "attn_layernorm": ln("attention.output.LayerNorm"),
+        "intermediate": dense("intermediate.dense"),
+        "output": dense("output.dense"),
+        "out_layernorm": ln("output.LayerNorm"),
+    }}
+    layer = DeepSpeedTransformerLayer(_cfg(pre_layer_norm=False))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 8, 32)).astype(np.float32)
+    with torch.no_grad():
+        want = tl(torch.from_numpy(x))[0].numpy()
+    got = np.asarray(layer.apply({"params": params}, jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_pre_ln_differs_and_masks_apply():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 8, 32)), jnp.float32)
+    outs = {}
+    for pre in (True, False):
+        layer, params = init_params(_cfg(pre_layer_norm=pre))
+        outs[pre] = np.asarray(layer.apply({"params": params}, x))
+    assert not np.allclose(outs[True], outs[False])
+
+    # [B, S] keep-mask: masking the tail must change the kept positions' output
+    layer, params = init_params(_cfg(pre_layer_norm=True))
+    mask = np.ones((2, 8), np.int32)
+    mask[:, 5:] = 0
+    full = np.asarray(layer.apply({"params": params}, x))
+    masked = np.asarray(layer.apply({"params": params}, x, jnp.asarray(mask)))
+    assert not np.allclose(full[:, :5], masked[:, :5])
+
+
+def test_dropout_and_training_mode():
+    """training=True + nonzero dropout is stochastic across rng keys and
+    deterministic=True disables it."""
+    cfg = _cfg(attn_dropout_ratio=0.3, hidden_dropout_ratio=0.3, training=True)
+    layer, params = init_params(cfg)
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(2, 8, 32)), jnp.float32)
+    a = layer.apply({"params": params}, x, rngs={"dropout": jax.random.PRNGKey(1)})
+    b = layer.apply({"params": params}, x, rngs={"dropout": jax.random.PRNGKey(2)})
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+    c = layer.apply({"params": params}, x, None, True)  # deterministic=True
+    d = layer.apply({"params": params}, x, None, True)
+    np.testing.assert_array_equal(np.asarray(c), np.asarray(d))
+
+
+def test_checkpoint_knobs_remat_without_changing_values():
+    """gelu_checkpoint/attn_dropout_checkpoint/normalize_invertible map onto
+    jax.checkpoint: same values, remat visible in the backward jaxpr."""
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(2, 8, 32)), jnp.float32)
+    plain, params = init_params(_cfg())
+    remat = DeepSpeedTransformerLayer(_cfg(gelu_checkpoint=True))
+    got_p = np.asarray(plain.apply({"params": params}, x))
+    got_r = np.asarray(remat.apply({"params": params}, x))
+    np.testing.assert_allclose(got_r, got_p, rtol=1e-6, atol=1e-6)
+
+    def loss(p):
+        return (remat.apply({"params": p}, x).astype(jnp.float32) ** 2).mean()
+
+    jaxpr = str(jax.make_jaxpr(jax.grad(loss))(params))
+    assert "remat" in jaxpr or "checkpoint" in jaxpr
+    g = jax.grad(loss)(params)
+    assert all(np.isfinite(l).all() for l in jax.tree.leaves(g))
+
+
+def test_return_tuple():
+    layer, params = init_params(_cfg(return_tuple=True))
+    x = jnp.zeros((1, 4, 32), jnp.float32)
+    out = layer.apply({"params": params}, x)
+    assert isinstance(out, tuple) and out[0].shape == (1, 4, 32)
+
+
+def test_broadcast_integer_keep_mask_masks_not_adds():
+    """A binary int [B,1,1,S] keep-mask must MASK (bool/int = keep-mask in any
+    rank), not be silently added to the logits."""
+    layer, params = init_params(_cfg(pre_layer_norm=True))
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(2, 8, 32)), jnp.float32)
+    keep2d = np.ones((2, 8), np.int32)
+    keep2d[:, 6:] = 0
+    via_2d = np.asarray(layer.apply({"params": params}, x, jnp.asarray(keep2d)))
+    via_4d = np.asarray(layer.apply({"params": params}, x,
+                                    jnp.asarray(keep2d[:, None, None, :])))
+    np.testing.assert_allclose(via_4d, via_2d, rtol=1e-6, atol=1e-6)
+    # a float ADDITIVE mask of the same pattern (-1e30 on masked) also agrees
+    additive = np.where(keep2d[:, None, None, :] > 0, 0.0, -1e30).astype(np.float32)
+    via_add = np.asarray(layer.apply({"params": params}, x, jnp.asarray(additive)))
+    np.testing.assert_allclose(via_add[:, :6], via_2d[:, :6], rtol=1e-5, atol=1e-5)
