@@ -102,6 +102,8 @@ class _LayerBody(nn.Module):
                 # float masks are additive (the HF extended-mask convention).
                 # A binary float [B,1,1,S] mask would otherwise be silently
                 # ADDED — wrong by +1 on kept logits and no masking at all.
+                if m.ndim == 3:  # [B, Q, K] → [B, 1, Q, K]: right-aligned
+                    m = m[:, None]  # broadcast would land batch on heads
                 if m.ndim == 2:
                     logits = jnp.where(m[:, None, None, :] > 0, logits, -1e30)
                 elif jnp.issubdtype(m.dtype, jnp.bool_) or jnp.issubdtype(m.dtype, jnp.integer):

@@ -286,10 +286,16 @@ class DeepSpeedEngine:
             # mix ZeROOptimizer into the instance: reference callers use
             # isinstance(engine.optimizer, ZeROOptimizer) to detect sharded
             # state (their ZeRO stages WRAP the base optimizer; here the
-            # sharding lives in placement policies, so the marker is mixed in)
+            # sharding lives in placement policies, so the marker is mixed
+            # in). Only our own TpuOptimizer family — a user-supplied
+            # optimizer (any init/update object, e.g. a NamedTuple-style
+            # optax transformation) must not have its class mutated, and
+            # some layouts can't be (__class__ assignment raises).
+            from deepspeed_tpu.ops.optimizer import TpuOptimizer
             from deepspeed_tpu.runtime import ZeROOptimizer
             cls = type(self.optimizer)
-            if not isinstance(self.optimizer, ZeROOptimizer):
+            if isinstance(self.optimizer, TpuOptimizer) \
+                    and not isinstance(self.optimizer, ZeROOptimizer):
                 self.optimizer.__class__ = type(cls.__name__, (cls, ZeROOptimizer), {})
         opt_shapes = jax.eval_shape(self.optimizer.init, self.params)
         opt_base = _broadcast_param_specs(opt_shapes, self.params, self.param_specs) \

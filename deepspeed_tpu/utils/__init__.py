@@ -1,3 +1,10 @@
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import NoopTimer, SynchronizedWallClockTimer, ThroughputTimer
+from deepspeed_tpu.utils.init_on_device import OnDevice
+from deepspeed_tpu.utils.tensor_fragment import (safe_get_full_fp32_param,
+                                                 safe_get_full_grad,
+                                                 safe_get_full_optimizer_state,
+                                                 safe_get_local_fp32_param,
+                                                 safe_set_full_fp32_param,
+                                                 safe_set_full_optimizer_state)

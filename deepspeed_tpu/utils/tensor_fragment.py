@@ -1,0 +1,153 @@
+"""safe_get/set accessors for ZeRO-partitioned state.
+
+Reference: ``deepspeed/utils/tensor_fragment.py:101-241`` — the RLHF-era API
+for reading/writing the full fp32 master value, optimizer state, or gradient
+of an individual parameter regardless of how ZeRO sharded it.
+
+TPU formulation: the reference keys off a live ``torch.nn.Parameter`` (whose
+``ds_id``/``_hp_mapping`` attributes find its shards); functional parameter
+trees have no param identity, so the key is the TREE PATH ("layers_0/mlp/fc1/
+kernel" or a tuple of keys). Gathering is jax's job: ``jax.device_get`` of a
+ZeRO-sharded global array materializes the full host value, and setting
+``device_put``s the new value back through the leaf's sharding — no
+per-stage cases; stages 1/2/3 and hpZ all take the same path.
+"""
+
+from typing import Any, Sequence, Union
+
+import numpy as np
+
+Path = Union[str, Sequence[str]]
+
+
+def _keys(path: Path):
+    if isinstance(path, str):
+        return [k for k in path.replace(".", "/").split("/") if k]
+    return list(path)
+
+
+def _resolve(tree, path: Path):
+    node = tree
+    for k in _keys(path):
+        if not isinstance(node, dict) or k not in node:
+            raise KeyError(f"no leaf at path {path!r} (failed at {k!r}; "
+                           f"available: {sorted(node) if isinstance(node, dict) else type(node)})")
+        node = node[k]
+    return node
+
+
+def _set(tree, path: Path, value):
+    """Copy-on-write nested set; returns the new tree."""
+    keys = _keys(path)
+    if not keys:
+        return value
+
+    def rec(node, i):
+        if i == len(keys):
+            return value
+        if not isinstance(node, dict) or keys[i] not in node:
+            raise KeyError(f"no leaf at path {path!r} (failed at {keys[i]!r})")
+        out = dict(node)
+        out[keys[i]] = rec(node[keys[i]], i + 1)
+        return out
+
+    return rec(tree, 0)
+
+
+def _put_like(value, leaf):
+    import jax
+    import jax.numpy as jnp
+    arr = jnp.asarray(np.asarray(value), leaf.dtype)
+    if arr.shape != leaf.shape:
+        raise ValueError(f"value shape {arr.shape} != param shape {leaf.shape}")
+    sharding = getattr(leaf, "sharding", None)
+    return jax.device_put(arr, sharding) if sharding is not None else arr
+
+
+def safe_get_full_fp32_param(engine, path: Path):
+    """Full (gathered) fp32 master value of the parameter at ``path``
+    (reference :101)."""
+    import jax
+    return np.asarray(jax.device_get(_resolve(engine.params, path)))
+
+
+def safe_set_full_fp32_param(engine, path: Path, value) -> None:
+    """Replace the fp32 master at ``path``; the value is re-sharded through
+    the leaf's existing placement (reference :117)."""
+    leaf = _resolve(engine.params, path)
+    engine.params = _set(engine.params, path, _put_like(value, leaf))
+
+
+def _opt_field(engine, optim_state_key: str):
+    state = engine.opt_state
+    if not hasattr(state, optim_state_key):
+        fields = getattr(state, "_fields", ())
+        raise KeyError(f"optimizer state has no {optim_state_key!r} "
+                       f"(available: {list(fields)})")
+    return getattr(state, optim_state_key)
+
+
+def safe_get_full_optimizer_state(engine, path: Path, optim_state_key: str):
+    """Full value of one optimizer-state slot ('exp_avg', 'exp_avg_sq', ...)
+    for the parameter at ``path`` (reference :133). Offloaded (host/NVMe)
+    leaves are materialized through the engine's checkpoint view."""
+    import jax
+    leaf = _resolve(_opt_field(engine, optim_state_key), path)
+    if not hasattr(leaf, "dtype"):  # offloaded stub — go through the host view
+        view = engine._offload.checkpoint_view(engine.opt_state)
+        leaf = _resolve(getattr(view, optim_state_key), path)
+    return np.asarray(jax.device_get(leaf))
+
+
+def safe_set_full_optimizer_state(engine, path: Path, value, optim_state_key: str) -> None:
+    """Replace one optimizer-state slot for the parameter at ``path``
+    (reference :150)."""
+    field = _opt_field(engine, optim_state_key)
+    leaf = _resolve(field, path)
+    if not hasattr(leaf, "dtype"):
+        raise NotImplementedError(
+            f"safe_set_full_optimizer_state: the {optim_state_key!r} slot at "
+            f"{path!r} is offloaded (host/NVMe); restore it (disable offload "
+            "or load a checkpoint) before writing through this API.")
+    new_field = _set(field, path, _put_like(value, leaf))
+    engine.opt_state = type(engine.opt_state)(
+        **{k: (new_field if k == optim_state_key else getattr(engine.opt_state, k))
+           for k in engine.opt_state._fields})
+
+
+def safe_get_full_grad(engine, path: Path):
+    """Full accumulated gradient at ``path``, or None outside the
+    accumulation window (reference :168 returns None when no grad exists)."""
+    import jax
+    if getattr(engine, "acc_grads", None) is None:
+        return None
+    return np.asarray(jax.device_get(_resolve(engine.acc_grads, path)))
+
+
+# the reference's "local" variants return this rank's partition; under
+# single-controller SPMD "this rank" = this PROCESS's addressable devices
+def safe_get_local_fp32_param(engine, path: Path):
+    """This process's partition of the fp32 master (reference :204).
+
+    When every shard is addressable (single-host — the common case) this is
+    the full value. On multi-host meshes the addressable shards are
+    reassembled when they tile exactly one dim; irregular local tilings have
+    no well-defined flat partition and raise with a pointer at the full
+    accessor."""
+    import jax
+    leaf = _resolve(engine.params, path)
+    shards = getattr(leaf, "addressable_shards", None)
+    if not shards:
+        return np.asarray(leaf)
+    if getattr(leaf, "is_fully_addressable", False):
+        return np.asarray(jax.device_get(leaf))
+    # multi-host: reassemble along the single sharded dim, in index order
+    starts = [tuple(idx.start or 0 for idx in s.index) for s in shards]
+    sharded_dims = {d for st in starts for d, off in enumerate(st) if off != 0}
+    if len(sharded_dims) > 1:
+        raise NotImplementedError(
+            f"safe_get_local_fp32_param: the leaf at {path!r} is locally "
+            "sharded over multiple dims; use safe_get_full_fp32_param.")
+    dim = sharded_dims.pop() if sharded_dims else 0
+    ordered = sorted(shards, key=lambda s: s.index[dim].start or 0)
+    return np.concatenate([np.asarray(s.data) for s in ordered], axis=dim)

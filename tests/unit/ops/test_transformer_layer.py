@@ -136,3 +136,18 @@ def test_broadcast_integer_keep_mask_masks_not_adds():
     additive = np.where(keep2d[:, None, None, :] > 0, 0.0, -1e30).astype(np.float32)
     via_add = np.asarray(layer.apply({"params": params}, x, jnp.asarray(additive)))
     np.testing.assert_allclose(via_add[:, :6], via_2d[:, :6], rtol=1e-5, atol=1e-5)
+
+
+def test_3d_keep_mask_aligns_per_sample():
+    """[B,Q,K] bool/int keep-masks broadcast per SAMPLE (not onto the heads
+    axis): equivalent 2-D and 3-D forms of the same mask must agree."""
+    layer, params = init_params(_cfg(pre_layer_norm=True))  # heads=2
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(2, 8, 32)), jnp.float32)  # B == heads == 2
+    keep = np.ones((2, 8), np.int32)
+    keep[0, 6:] = 0
+    keep[1, 4:] = 0  # different pattern per sample — head-misalignment would show
+    via_2d = np.asarray(layer.apply({"params": params}, x, jnp.asarray(keep)))
+    m3 = np.broadcast_to(keep[:, None, :], (2, 8, 8)).copy()
+    via_3d = np.asarray(layer.apply({"params": params}, x, jnp.asarray(m3)))
+    np.testing.assert_allclose(via_3d, via_2d, rtol=1e-6, atol=1e-6)

@@ -85,11 +85,9 @@ def test_on_device_casts_init_dtype_and_is_reentrant():
 def test_zero_engine_optimizer_isinstance_markers():
     """Reference-style isinstance checks on engine.optimizer must hold:
     DeepSpeedOptimizer always, ZeROOptimizer exactly when ZeRO shards."""
-    import os
-    import sys
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__)))
-    from simple_model import make_simple_model, random_batches
     from deepspeed_tpu.utils import groups
+
+    from .simple_model import make_simple_model, random_batches
 
     groups.initialize_mesh(force=True)
     model, params = make_simple_model(hidden_dim=16, batch_size=8)
@@ -110,3 +108,43 @@ def test_zero_engine_optimizer_isinstance_markers():
     assert isinstance(opt2, deepspeed_tpu.ZeROOptimizer)
     # the remix keeps the optimizer functional
     float(e2.train_batch(batch=random_batches(1, 8, 16)[0]))
+
+
+def test_user_supplied_optimizer_not_mutated_by_zero_marker():
+    """A user-supplied optimizer object (any init/update duck type) must not
+    have its class rewritten by the ZeRO marker mixin."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.utils import groups
+    from .simple_model import make_simple_model, random_batches
+
+    class UserSGD:
+        def __init__(self):
+            self.lr = 1e-2
+            self.weight_decay = 0.0
+
+        def init(self, params):
+            return ()
+
+        def update(self, grads, state, params, lr):
+            return jax.tree.map(lambda g: -lr * g, grads), state
+
+        def get_lr(self):
+            return self.lr
+
+        def set_lr(self, lr):
+            self.lr = lr
+
+    groups.initialize_mesh(force=True)
+    model, params = make_simple_model(hidden_dim=16, batch_size=8)
+    opt = UserSGD()
+    cls_before = type(opt)
+    eng, ret_opt, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, optimizer=opt,
+        config={"train_micro_batch_size_per_gpu": 8,
+                "zero_optimization": {"stage": 2}})
+    assert type(opt) is cls_before  # untouched
+    assert not isinstance(ret_opt, deepspeed_tpu.ZeROOptimizer)
+    loss = float(eng.train_batch(batch=random_batches(1, 8, 16)[0]))
+    assert np.isfinite(loss)
