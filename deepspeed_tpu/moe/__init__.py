@@ -1,0 +1,2 @@
+from deepspeed_tpu.moe.utils import (is_moe_param_spec,
+                                     split_params_into_different_moe_groups_for_optimizer)

@@ -1,0 +1,79 @@
+"""MoE parameter-group utilities.
+
+Reference: ``deepspeed/moe/utils.py`` — ``is_moe_param`` (keyed off the
+``allreduce=False`` attribute the MoE layers stamp on expert params) and
+``split_params_into_different_moe_groups_for_optimizer:65`` (splits torch
+optimizer ``param_groups`` so expert params form their own groups, which
+the engine then reduces over the expert-data group instead of the dense DP
+world).
+
+TPU formulation: expert membership is STRUCTURAL — a parameter is an expert
+parameter iff its PartitionSpec carries the ``expert`` mesh axis (the same
+information the reference encodes imperatively). The splitter therefore
+takes (param tree, spec tree) and returns reference-shaped group dicts whose
+``params`` are same-structure trees with the other group's leaves masked to
+``None`` — the partitioned-tree form optax-style per-group transforms (and
+per-group LR/weight-decay configs) consume.
+"""
+
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.utils import groups as _groups
+
+
+def is_moe_param_spec(spec, expert_axis: str = _groups.EXPERT_AXIS) -> bool:
+    """True iff ``spec`` places any dim on the expert axis (the structural
+    analog of reference ``is_moe_param``'s ``allreduce=False`` stamp)."""
+    spec = getattr(spec, "spec", spec)  # NamedSharding or bare PartitionSpec
+    if spec is None:
+        return False
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry, )
+        if expert_axis in axes:
+            return True
+    return False
+
+
+def _mask_tree(params, specs, keep_expert: bool, expert_axis: str):
+    import jax
+
+    def one(p, s):
+        member = is_moe_param_spec(s, expert_axis)
+        return p if member == keep_expert else None
+
+    return jax.tree.map(one, params, specs, is_leaf=lambda x: x is None)
+
+
+def split_params_into_different_moe_groups_for_optimizer(
+        param_groups: Any, param_specs=None,
+        expert_axis: str = _groups.EXPERT_AXIS) -> List[Dict]:
+    """Reference moe/utils.py:65. Accepts one group dict (or a list of them)
+    whose ``params`` is a parameter TREE; returns the dense group(s) plus one
+    ``moe`` group per input group, with leaves partitioned by expert
+    membership (masked to None on the other side, structures preserved).
+
+    ``param_specs`` may live in the group dict (key ``"param_specs"``) or be
+    passed once for all groups.
+    """
+    if isinstance(param_groups, dict):
+        param_groups = [param_groups]
+    out: List[Dict] = []
+    for group in param_groups:
+        specs = group.get("param_specs", param_specs)
+        if specs is None:
+            raise ValueError(
+                "split_params_into_different_moe_groups_for_optimizer needs "
+                "param_specs (expert membership is structural on TPU — the "
+                "spec tree carries it; see models.mixtral.mixtral_param_specs)")
+        base = {k: v for k, v in group.items() if k not in ("params", "param_specs")}
+        dense = dict(base)
+        dense["params"] = _mask_tree(group["params"], specs, False, expert_axis)
+        out.append(dense)
+        moe = dict(base)
+        moe["params"] = _mask_tree(group["params"], specs, True, expert_axis)
+        moe["moe"] = True
+        moe["name"] = base.get("name", "") + "_moe" if base.get("name") else "moe"
+        out.append(moe)
+    return out

@@ -777,6 +777,7 @@ class DeepSpeedEngine:
             self.acc_grads = self._cached_grads
         else:
             self.acc_grads = self._accum_fn()(self.acc_grads, self._cached_grads)
+        self._grads_live = True  # consumed+zeroed at the step boundary
         self._cached_grads = None
         self.timers(BACKWARD_MICRO_TIMER).stop()
         return loss if loss is not None else self._cached_loss
@@ -793,6 +794,9 @@ class DeepSpeedEngine:
             (self.params, self.opt_state, self.acc_grads, self.scale_state, norm,
              overflow) = self._apply_fn()(self.params, opt_in, self.acc_grads, self.scale_state, lr)
             self.opt_state = self._offload.stage_out(self.opt_state)
+            # acc_grads is now the zeroed buffer, not a gradient — the
+            # safe_get_full_grad contract returns None outside the window
+            self._grads_live = False
             self._global_grad_norm = norm
             self._overflow_count = self._overflow_count + overflow.astype(jnp.int32)
             self._last_step_applied = ~overflow  # device scalar; synced on query

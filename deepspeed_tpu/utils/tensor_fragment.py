@@ -87,13 +87,18 @@ def _opt_field(engine, optim_state_key: str):
     return getattr(state, optim_state_key)
 
 
+def _is_offloaded_stub(leaf) -> bool:
+    from deepspeed_tpu.runtime.swap_tensor.partitioned_optimizer_swapper import _is_stub
+    return _is_stub(leaf)
+
+
 def safe_get_full_optimizer_state(engine, path: Path, optim_state_key: str):
     """Full value of one optimizer-state slot ('exp_avg', 'exp_avg_sq', ...)
-    for the parameter at ``path`` (reference :133). Offloaded (host/NVMe)
-    leaves are materialized through the engine's checkpoint view."""
+    for the parameter at ``path`` (reference :133). NVMe-offloaded leaves are
+    materialized through the engine's checkpoint view."""
     import jax
     leaf = _resolve(_opt_field(engine, optim_state_key), path)
-    if not hasattr(leaf, "dtype"):  # offloaded stub — go through the host view
+    if _is_offloaded_stub(leaf):  # NVMe stub — go through the host view
         view = engine._offload.checkpoint_view(engine.opt_state)
         leaf = _resolve(getattr(view, optim_state_key), path)
     return np.asarray(jax.device_get(leaf))
@@ -104,11 +109,11 @@ def safe_set_full_optimizer_state(engine, path: Path, value, optim_state_key: st
     (reference :150)."""
     field = _opt_field(engine, optim_state_key)
     leaf = _resolve(field, path)
-    if not hasattr(leaf, "dtype"):
+    if _is_offloaded_stub(leaf):
         raise NotImplementedError(
             f"safe_set_full_optimizer_state: the {optim_state_key!r} slot at "
-            f"{path!r} is offloaded (host/NVMe); restore it (disable offload "
-            "or load a checkpoint) before writing through this API.")
+            f"{path!r} is NVMe-offloaded; restore it (disable offload or load "
+            "a checkpoint) before writing through this API.")
     new_field = _set(field, path, _put_like(value, leaf))
     engine.opt_state = type(engine.opt_state)(
         **{k: (new_field if k == optim_state_key else getattr(engine.opt_state, k))
@@ -117,9 +122,12 @@ def safe_set_full_optimizer_state(engine, path: Path, value, optim_state_key: st
 
 def safe_get_full_grad(engine, path: Path):
     """Full accumulated gradient at ``path``, or None outside the
-    accumulation window (reference :168 returns None when no grad exists)."""
+    accumulation window (reference :168 returns None when no grad exists).
+    After a boundary step() the engine's buffer holds re-zeroed storage, not
+    a gradient — the engine's ``_grads_live`` flag distinguishes the two."""
     import jax
-    if getattr(engine, "acc_grads", None) is None:
+    if getattr(engine, "acc_grads", None) is None \
+            or not getattr(engine, "_grads_live", False):
         return None
     return np.asarray(jax.device_get(_resolve(engine.acc_grads, path)))
 
@@ -141,13 +149,19 @@ def safe_get_local_fp32_param(engine, path: Path):
         return np.asarray(leaf)
     if getattr(leaf, "is_fully_addressable", False):
         return np.asarray(jax.device_get(leaf))
-    # multi-host: reassemble along the single sharded dim, in index order
-    starts = [tuple(idx.start or 0 for idx in s.index) for s in shards]
-    sharded_dims = {d for st in starts for d, off in enumerate(st) if off != 0}
+    # multi-host: dedupe replicated copies (one entry per local DEVICE —
+    # replication repeats the same index), then reassemble distinct tiles
+    def start(s):
+        return tuple(idx.start or 0 for idx in s.index)
+
+    distinct = list({start(s): s for s in shards}.values())
+    if len(distinct) == 1:
+        return np.asarray(distinct[0].data)
+    sharded_dims = {d for s in distinct for d, off in enumerate(start(s)) if off != 0}
     if len(sharded_dims) > 1:
         raise NotImplementedError(
-            f"safe_get_local_fp32_param: the leaf at {path!r} is locally "
-            "sharded over multiple dims; use safe_get_full_fp32_param.")
+            f"safe_get_local_fp32_param: this process's shards of {path!r} "
+            "tile multiple dims; use safe_get_full_fp32_param.")
     dim = sharded_dims.pop() if sharded_dims else 0
-    ordered = sorted(shards, key=lambda s: s.index[dim].start or 0)
+    ordered = sorted(distinct, key=lambda s: s.index[dim].start or 0)
     return np.concatenate([np.asarray(s.data) for s in ordered], axis=dim)

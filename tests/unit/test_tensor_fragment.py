@@ -85,3 +85,39 @@ def test_bad_path_raises():
     eng = _engine(1)
     with pytest.raises(KeyError, match="no leaf"):
         safe_get_full_fp32_param(eng, "nope/nothing")
+
+
+def test_grad_is_none_after_boundary_step():
+    """After step() the engine holds a re-zeroed buffer, not a gradient —
+    the accessor must return None, not stale zeros (reference contract)."""
+    eng = _engine(2)
+    path = _first_kernel_path(eng)
+    loss = eng.forward(random_batches(1, 8, 16)[0])
+    eng.backward(loss)
+    assert safe_get_full_grad(eng, path) is not None
+    eng.step()
+    assert safe_get_full_grad(eng, path) is None
+
+
+def test_nvme_offloaded_optimizer_state_reads_and_refuses_writes(tmp_path):
+    """NVMe-offloaded slots read through the host view; writes refuse loudly
+    (the stub check must actually detect NvmeSwappedLeaf)."""
+    groups.initialize_mesh(force=True)
+    model, params = make_simple_model(hidden_dim=16, batch_size=8)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2,
+                                      "offload_optimizer": {"device": "nvme",
+                                                            "nvme_path": str(tmp_path)}}})
+    path = _first_kernel_path(eng)
+    float(eng.train_batch(batch=random_batches(1, 8, 16)[0]))
+    from deepspeed_tpu.runtime.swap_tensor.partitioned_optimizer_swapper import _is_stub
+    from deepspeed_tpu.utils.tensor_fragment import _resolve
+    leaf = _resolve(eng.opt_state.exp_avg, path)
+    assert _is_stub(leaf), "precondition: the slot must actually be swapped out"
+    m = safe_get_full_optimizer_state(eng, path, "exp_avg")
+    assert m.shape == (16, 16) and np.abs(m).sum() > 0
+    with pytest.raises(NotImplementedError, match="NVMe-offloaded"):
+        safe_set_full_optimizer_state(eng, path, np.zeros_like(m), "exp_avg")
