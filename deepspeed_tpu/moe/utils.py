@@ -57,10 +57,16 @@ def split_params_into_different_moe_groups_for_optimizer(
     ``param_specs`` may live in the group dict (key ``"param_specs"``) or be
     passed once for all groups.
     """
+    import jax
+
     if isinstance(param_groups, dict):
         param_groups = [param_groups]
+
+    def nonempty(tree):
+        return any(l is not None for l in jax.tree.leaves(tree))
+
     out: List[Dict] = []
-    for group in param_groups:
+    for i, group in enumerate(param_groups):
         specs = group.get("param_specs", param_specs)
         if specs is None:
             raise ValueError(
@@ -68,12 +74,18 @@ def split_params_into_different_moe_groups_for_optimizer(
                 "param_specs (expert membership is structural on TPU — the "
                 "spec tree carries it; see models.mixtral.mixtral_param_specs)")
         base = {k: v for k, v in group.items() if k not in ("params", "param_specs")}
-        dense = dict(base)
-        dense["params"] = _mask_tree(group["params"], specs, False, expert_axis)
-        out.append(dense)
-        moe = dict(base)
-        moe["params"] = _mask_tree(group["params"], specs, True, expert_axis)
-        moe["moe"] = True
-        moe["name"] = base.get("name", "") + "_moe" if base.get("name") else "moe"
-        out.append(moe)
+        dense_tree = _mask_tree(group["params"], specs, False, expert_axis)
+        moe_tree = _mask_tree(group["params"], specs, True, expert_axis)
+        # reference parity: groups are only created for params that exist —
+        # an all-dense input yields no (junk) moe group and vice versa
+        if nonempty(dense_tree):
+            dense = dict(base)
+            dense["params"] = dense_tree
+            out.append(dense)
+        if nonempty(moe_tree):
+            moe = dict(base)
+            moe["params"] = moe_tree
+            moe["moe"] = True
+            moe["name"] = f"{base['name']}_moe" if base.get("name") else f"moe_group_{i}"
+            out.append(moe)
     return out

@@ -777,7 +777,6 @@ class DeepSpeedEngine:
             self.acc_grads = self._cached_grads
         else:
             self.acc_grads = self._accum_fn()(self.acc_grads, self._cached_grads)
-        self._grads_live = True  # consumed+zeroed at the step boundary
         self._cached_grads = None
         self.timers(BACKWARD_MICRO_TIMER).stop()
         return loss if loss is not None else self._cached_loss
@@ -791,12 +790,14 @@ class DeepSpeedEngine:
             assert self.acc_grads is not None, "step() with no accumulated gradients"
             lr = jnp.asarray(self._current_lr, jnp.float32)
             opt_in = self._offload.stage_in(self.opt_state)
-            (self.params, self.opt_state, self.acc_grads, self.scale_state, norm,
+            (self.params, self.opt_state, _zeroed, self.scale_state, norm,
              overflow) = self._apply_fn()(self.params, opt_in, self.acc_grads, self.scale_state, lr)
             self.opt_state = self._offload.stage_out(self.opt_state)
-            # acc_grads is now the zeroed buffer, not a gradient — the
-            # safe_get_full_grad contract returns None outside the window
-            self._grads_live = False
+            # the consumed window's grads are gone: dropping the returned
+            # zeroed buffer keeps grad-visibility truth in acc_grads alone
+            # (safe_get_full_grad → None) and lets the next window's first
+            # backward take the free assignment instead of an add-into-zeros
+            self.acc_grads = None
             self._global_grad_norm = norm
             self._overflow_count = self._overflow_count + overflow.astype(jnp.int32)
             self._last_step_applied = ~overflow  # device scalar; synced on query

@@ -94,13 +94,16 @@ def _is_offloaded_stub(leaf) -> bool:
 
 def safe_get_full_optimizer_state(engine, path: Path, optim_state_key: str):
     """Full value of one optimizer-state slot ('exp_avg', 'exp_avg_sq', ...)
-    for the parameter at ``path`` (reference :133). NVMe-offloaded leaves are
-    materialized through the engine's checkpoint view."""
+    for the parameter at ``path`` (reference :133). An NVMe-offloaded leaf is
+    read back ALONE (pending writes drained first) — materializing the whole
+    state per call would defeat the tier's zero-host-RAM purpose for
+    RLHF-style per-parameter loops."""
     import jax
     leaf = _resolve(_opt_field(engine, optim_state_key), path)
-    if _is_offloaded_stub(leaf):  # NVMe stub — go through the host view
-        view = engine._offload.checkpoint_view(engine.opt_state)
-        leaf = _resolve(getattr(view, optim_state_key), path)
+    if _is_offloaded_stub(leaf):
+        swapper = engine._offload.swapper
+        swapper._drain_writes()  # the leaf's file may still be in flight
+        return leaf._read_local(swapper.aio)
     return np.asarray(jax.device_get(leaf))
 
 
@@ -122,12 +125,11 @@ def safe_set_full_optimizer_state(engine, path: Path, value, optim_state_key: st
 
 def safe_get_full_grad(engine, path: Path):
     """Full accumulated gradient at ``path``, or None outside the
-    accumulation window (reference :168 returns None when no grad exists).
-    After a boundary step() the engine's buffer holds re-zeroed storage, not
-    a gradient — the engine's ``_grads_live`` flag distinguishes the two."""
+    accumulation window (reference :168 returns None when no grad exists;
+    the engine drops its buffer at the step boundary, so buffer identity IS
+    the window truth)."""
     import jax
-    if getattr(engine, "acc_grads", None) is None \
-            or not getattr(engine, "_grads_live", False):
+    if getattr(engine, "acc_grads", None) is None:
         return None
     return np.asarray(jax.device_get(_resolve(engine.acc_grads, path)))
 
