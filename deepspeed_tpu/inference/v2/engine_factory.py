@@ -28,9 +28,65 @@ def build_engine(params, model_config, engine_config: Optional[RaggedInferenceEn
     return InferenceEngineV2(model, engine_config)
 
 
+def build_engine_from_ds_checkpoint(path: str,
+                                    engine_config: Optional[RaggedInferenceEngineConfig] = None):
+    """Rebuild an engine from an ``InferenceEngineV2.serialize`` directory
+    (reference engine_factory.py:29) — the inference-checkpoint round-trip.
+    The config is JSON (never pickle: a checkpoint directory must not be an
+    arbitrary-code-execution vector) and its class is restricted to this
+    package's model configs."""
+    import importlib
+    import json
+    import os
+
+    import jax.numpy as jnp
+
+    with open(os.path.join(path, "ds_model_config.json")) as f:
+        cfg_doc = json.load(f)
+    mod_name, _, cls_name = cfg_doc["config_class"].rpartition(".")
+    if not mod_name.startswith("deepspeed_tpu."):
+        raise ValueError(f"refusing to import config class from {mod_name!r} "
+                         "(only deepspeed_tpu model configs are loadable)")
+    cfg_cls = getattr(importlib.import_module(mod_name), cls_name)
+
+    def dec(v):
+        if isinstance(v, dict) and "__dtype__" in v:
+            # restore the jnp SCALAR TYPE (jnp.float32), not np.dtype: they
+            # compare equal but models may branch on the exact object
+            return getattr(jnp, v["__dtype__"], jnp.dtype(v["__dtype__"]))
+        return v
+
+    model_config = cfg_cls(**{k: dec(v) for k, v in cfg_doc["fields"].items()})
+    with open(os.path.join(path, "metadata_rank0.json")) as f:
+        meta = json.load(f)
+    params: Dict = {}
+    with np.load(os.path.join(path, "params_rank0.npz")) as z:
+        for i, m in enumerate(meta):
+            arr = z[f"p{i}"]
+            if str(arr.dtype) != m["dtype"]:  # stored as a uint view (bf16)
+                arr = jnp.asarray(arr).view(jnp.dtype(m["dtype"]))
+            node = params
+            keys = m["path"].split("/")
+            for k in keys[:-1]:
+                node = node.setdefault(k, {})
+            node[keys[-1]] = jnp.asarray(arr).reshape(m["shape"])
+    return build_engine(params, model_config, engine_config)
+
+
 def build_hf_engine(path: str, engine_config: Optional[RaggedInferenceEngineConfig] = None):
     """Load an HF checkpoint directory and build an engine (reference
-    engine_factory.py:66). Supports llama/mixtral-architecture configs."""
+    engine_factory.py:66); a directory written by ``engine.serialize`` routes
+    to the DS-checkpoint loader (reference :84 ds_model_config detection)."""
+    import os
+
+    if os.path.exists(os.path.join(path, "ds_model_config.json")):
+        return build_engine_from_ds_checkpoint(path, engine_config)
+    if os.path.exists(os.path.join(path, "ds_model_config.pkl")):
+        raise ValueError(
+            f"{path} is a LEGACY pickle-format DS checkpoint; the format was "
+            "retired (pickle in a checkpoint is an arbitrary-code-execution "
+            "vector). Re-serialize the engine with the current code to get "
+            "the JSON-config format.")
     from deepspeed_tpu.inference.checkpoint import load_hf_checkpoint
 
     params, model_config = load_hf_checkpoint(path)

@@ -15,7 +15,6 @@ the model program replace the reference's explicit process-group collectives.
 
 import json
 import os
-import pickle
 from typing import Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -304,19 +303,58 @@ class InferenceEngineV2:
 
     # -------------------------------------------------------------- serialize --
     def serialize(self, save_path: str) -> None:
-        """Write flattened params + metadata (reference engine_v2.py:289)."""
+        """Write flattened params + metadata (reference engine_v2.py:289).
+        ``engine_factory.build_engine_from_ds_checkpoint`` is the loader.
+
+        Format notes: sub-byte/non-native dtypes (bf16) are stored as
+        same-width uint views with the logical dtype in the metadata (npz
+        can't carry ml_dtypes); trees must be pure string-keyed dicts with
+        '/'-free keys (anything else cannot round-trip through the path
+        encoding and is REJECTED here, not corrupted on load); the model
+        config is JSON (no pickle — a checkpoint directory must never be an
+        arbitrary-code-execution vector)."""
+        import dataclasses
+
         import jax
 
         os.makedirs(save_path, exist_ok=True)
         leaves_with_paths = jax.tree_util.tree_flatten_with_path(self._model._params)[0]
         arrays, meta = {}, []
         for i, (path, leaf) in enumerate(leaves_with_paths):
-            arrays[f"p{i}"] = np.asarray(jax.device_get(leaf))
-            meta.append({"path": jax.tree_util.keystr(path), "shape": list(leaf.shape),
-                         "dtype": str(leaf.dtype)})
+            if not path:
+                raise ValueError(
+                    "serialize needs a dict param tree (a bare-leaf root has "
+                    "no key path to encode and would not round-trip)")
+            keys = []
+            for k in path:
+                key = getattr(k, "key", None)
+                if not isinstance(key, str) or "/" in key:
+                    raise ValueError(
+                        f"serialize supports string-keyed dict trees with "
+                        f"'/'-free keys only; cannot round-trip node {k!r} "
+                        f"in path {jax.tree_util.keystr(path)}")
+                keys.append(key)
+            arr = np.asarray(jax.device_get(leaf))
+            logical = str(arr.dtype)
+            if arr.dtype.kind not in "fiub" or logical == "bfloat16":
+                arr = arr.view(np.dtype(f"u{arr.dtype.itemsize}"))
+            arrays[f"p{i}"] = arr
+            meta.append({"path": "/".join(keys), "shape": list(leaf.shape),
+                         "dtype": logical})
         np.savez(os.path.join(save_path, "params_rank0.npz"), **arrays)
         with open(os.path.join(save_path, "metadata_rank0.json"), "w") as f:
             json.dump(meta, f)
-        with open(os.path.join(save_path, "ds_model_config.pkl"), "wb") as f:
-            pickle.dump(self._model.config, f)
+
+        cfg = self._model.config
+        fields = {}
+        for f_ in dataclasses.fields(cfg):
+            v = getattr(cfg, f_.name)
+            try:
+                json.dumps(v)
+            except TypeError:
+                v = {"__dtype__": np.dtype(v).name}
+            fields[f_.name] = v
+        with open(os.path.join(save_path, "ds_model_config.json"), "w") as f:
+            json.dump({"config_class": f"{type(cfg).__module__}.{type(cfg).__qualname__}",
+                       "fields": fields}, f, indent=2)
         logger.info(f"serialized {len(arrays)} param tensors to {save_path}")

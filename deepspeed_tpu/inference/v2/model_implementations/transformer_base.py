@@ -83,8 +83,12 @@ class DSTransformerModelBase:
         import jax.numpy as jnp
         sm = self._engine_config.state_manager
         model_dtype = getattr(self._config, "dtype", jnp.bfloat16)
-        cache_dtype = {jnp.bfloat16: "bfloat16", jnp.float16: "float16",
-                       jnp.float32: "float32"}.get(model_dtype, "bfloat16")
+        # normalize through np.dtype: keying on the jnp scalar OBJECTS would
+        # silently default an equivalent representation (np.float32,
+        # np.dtype('float32')) to a bf16 cache under an fp32 model
+        cache_dtype = np.dtype(model_dtype).name
+        if cache_dtype not in ("bfloat16", "float16", "float32"):
+            cache_dtype = "bfloat16"
         return KVCacheConfig(block_size=self._engine_config.kv_block_size,
                              cache_shape=(self.num_layers, self.num_kv_heads, self.head_dim),
                              cache_dtype=cache_dtype,

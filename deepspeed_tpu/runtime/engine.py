@@ -624,7 +624,7 @@ class DeepSpeedEngine:
             self._compiled["apply"] = jax.jit(
                 self._apply_fn_inner(),
                 donate_argnums=(0, 1, 2),
-                out_shardings=(self._param_shardings, self._opt_shardings, self._grad_shardings, None, None, None))
+                out_shardings=(self._param_shardings, self._opt_shardings, None, None, None))
         return self._compiled["apply"]
 
     def _train_batch_fn(self):
@@ -668,7 +668,7 @@ class DeepSpeedEngine:
                 return jax.tree.map(lambda a, b: a + b, acc, grads), loss
 
             acc, losses = jax.lax.scan(body, zero, (batches, rngs))
-            new_params, new_opt, _, new_scale, norm, overflow = apply_inner(params, opt_state, acc, scale_state, lr)
+            new_params, new_opt, new_scale, norm, overflow = apply_inner(params, opt_state, acc, scale_state, lr)
             return new_params, new_opt, new_scale, jnp.mean(losses), norm, overflow
 
         self._compiled["train_batch"] = jax.jit(
@@ -711,8 +711,7 @@ class DeepSpeedEngine:
                                            delayed_shift=fp16_cfg.hysteresis,
                                            consecutive_hysteresis=fp16_cfg.consecutive_hysteresis,
                                            dynamic=dynamic)
-            zeroed = jax.tree.map(jnp.zeros_like, acc_grads)
-            return new_params, new_opt, zeroed, scale_state, norm, ~finite
+            return new_params, new_opt, scale_state, norm, ~finite
 
         return fn
 
@@ -790,13 +789,12 @@ class DeepSpeedEngine:
             assert self.acc_grads is not None, "step() with no accumulated gradients"
             lr = jnp.asarray(self._current_lr, jnp.float32)
             opt_in = self._offload.stage_in(self.opt_state)
-            (self.params, self.opt_state, _zeroed, self.scale_state, norm,
+            (self.params, self.opt_state, self.scale_state, norm,
              overflow) = self._apply_fn()(self.params, opt_in, self.acc_grads, self.scale_state, lr)
             self.opt_state = self._offload.stage_out(self.opt_state)
-            # the consumed window's grads are gone: dropping the returned
-            # zeroed buffer keeps grad-visibility truth in acc_grads alone
-            # (safe_get_full_grad → None) and lets the next window's first
-            # backward take the free assignment instead of an add-into-zeros
+            # the consumed window's grads are gone: clearing acc_grads keeps
+            # grad-visibility truth in one place (safe_get_full_grad → None)
+            # and the next window's first backward takes the free assignment
             self.acc_grads = None
             self._global_grad_norm = norm
             self._overflow_count = self._overflow_count + overflow.astype(jnp.int32)

@@ -254,7 +254,7 @@ class PipelineEngine(DeepSpeedEngine):
         loss, grads = self._grad_fn()(self.params, batch, rng, self.scale_state.cur_scale)
         lr = jnp.asarray(self._current_lr, jnp.float32)
         opt_in = self._offload.stage_in(self.opt_state)
-        (self.params, self.opt_state, _, self.scale_state, norm,
+        (self.params, self.opt_state, self.scale_state, norm,
          overflow) = self._apply_fn()(self.params, opt_in, grads, self.scale_state, lr)
         self.opt_state = self._offload.stage_out(self.opt_state)
         self._global_grad_norm = norm

@@ -101,6 +101,13 @@ def safe_get_full_optimizer_state(engine, path: Path, optim_state_key: str):
     import jax
     leaf = _resolve(_opt_field(engine, optim_state_key), path)
     if _is_offloaded_stub(leaf):
+        if jax.process_count() > 1:
+            # each process's swap file holds only ITS shards; assembling the
+            # full value here would silently return zeros for foreign regions
+            raise NotImplementedError(
+                "safe_get_full_optimizer_state on an NVMe-offloaded leaf is "
+                "single-process only (this process's swap file lacks other "
+                "hosts' shards); load a checkpoint or disable offload first.")
         swapper = engine._offload.swapper
         swapper._drain_writes()  # the leaf's file may still be in flight
         return leaf._read_local(swapper.aio)

@@ -145,12 +145,83 @@ def test_tracer_records_per_layer(llama_setup):
 
 
 def test_serialize_roundtrip(llama_setup, tmp_path):
+    """serialize → build_engine_from_ds_checkpoint is a REAL round-trip
+    (reference engine_factory.py:29): the rebuilt engine serves identical
+    logits, and build_hf_engine auto-detects the DS checkpoint (ref :84)."""
+    from deepspeed_tpu.inference.v2.engine_factory import (build_engine_from_ds_checkpoint,
+                                                           build_hf_engine)
+
     cfg, _, params = llama_setup
     engine = build_engine(params, cfg, _engine_config())
     engine.serialize(str(tmp_path))
     data = np.load(tmp_path / "params_rank0.npz")
     flat = jax.tree.leaves(params)
     assert len(data.files) == len(flat)
+
+    prompt = np.arange(17) % cfg.vocab_size
+    want = np.asarray(engine.put([0], [prompt]))
+    rebuilt = build_engine_from_ds_checkpoint(str(tmp_path), _engine_config())
+    got = np.asarray(rebuilt.put([0], [prompt]))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(rebuilt._model._params), flat):
+        assert a.dtype == b.dtype and a.shape == b.shape
+    via_hf = build_hf_engine(str(tmp_path), _engine_config())  # auto-detect
+    np.testing.assert_allclose(np.asarray(via_hf.put([0], [prompt])), want,
+                               rtol=1e-5, atol=1e-5)
+    # no pickle anywhere in the checkpoint dir (config is JSON; a checkpoint
+    # must never be an arbitrary-code-execution vector)
+    import os
+    assert not any(f.endswith(".pkl") for f in os.listdir(tmp_path))
+
+
+def test_serialize_roundtrip_bf16(llama_setup, tmp_path):
+    """bf16 params exercise the uint-view storage branch: dtypes and logits
+    must survive the round-trip."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.v2.engine_factory import build_engine_from_ds_checkpoint
+
+    cfg, _, params = llama_setup
+    bf16_params = jax.tree.map(lambda l: l.astype(jnp.bfloat16)
+                               if jnp.issubdtype(l.dtype, jnp.floating) else l, params)
+    engine = build_engine(bf16_params, cfg, _engine_config())
+    engine.serialize(str(tmp_path))
+    prompt = np.arange(11) % cfg.vocab_size
+    want = np.asarray(engine.put([0], [prompt]))
+    rebuilt = build_engine_from_ds_checkpoint(str(tmp_path), _engine_config())
+    for a, b in zip(jax.tree.leaves(rebuilt._model._params),
+                    jax.tree.leaves(bf16_params)):
+        assert a.dtype == b.dtype, (a.dtype, b.dtype)
+    got = np.asarray(rebuilt.put([0], [prompt]))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_serialize_rejects_unroundtrippable_trees(llama_setup, tmp_path):
+    """Trees the path encoding cannot reconstruct (list nodes, '/' in keys)
+    must be rejected at SAVE time, not corrupted at load time; and the loader
+    refuses config classes outside the package."""
+    import json
+    import pytest as _pytest
+    from deepspeed_tpu.inference.v2.engine_factory import build_engine_from_ds_checkpoint
+
+    cfg, _, params = llama_setup
+    eng = build_engine(params, cfg, _engine_config())
+    good_params = eng._model._params
+    try:
+        eng._model._params = {"weird/key": np.ones((4, 4), np.float32)}
+        with _pytest.raises(ValueError, match="'/'-free"):
+            eng.serialize(str(tmp_path / "bad1"))
+        eng._model._params = {"layers": [np.ones((4, 4), np.float32)]}
+        with _pytest.raises(ValueError, match="string-keyed"):
+            eng.serialize(str(tmp_path / "bad2"))
+    finally:
+        eng._model._params = good_params
+
+    eng.serialize(str(tmp_path / "ok"))
+    doc = json.loads((tmp_path / "ok" / "ds_model_config.json").read_text())
+    doc["config_class"] = "os.path.join"
+    (tmp_path / "ok" / "ds_model_config.json").write_text(json.dumps(doc))
+    with _pytest.raises(ValueError, match="refusing to import"):
+        build_engine_from_ds_checkpoint(str(tmp_path / "ok"))
 
 
 def test_decode_loop_matches_host_loop(llama_setup):
@@ -268,3 +339,18 @@ def test_generate_chunked_matches_stepwise(llama_setup):
     ref = run(1)
     eos = ref[0][3]
     np.testing.assert_equal(run(4, eos=eos), run(1, eos=eos))
+
+
+def test_kv_cache_dtype_follows_any_f32_representation(llama_setup):
+    """An fp32 model config expressed as np.float32 / np.dtype('float32')
+    (not the jnp scalar type) must still get an fp32 KV cache — the silent
+    bf16 default only applies to genuinely low-precision/unknown dtypes."""
+    import dataclasses
+    cfg, model, params = llama_setup
+    for rep in (np.float32, np.dtype("float32"), jnp.float32):
+        c = dataclasses.replace(cfg, dtype=rep)
+        eng = build_engine(params, c, _engine_config())
+        assert eng._model.kv_cache_config().cache_dtype == "float32", rep
+    bf = dataclasses.replace(cfg, dtype=jnp.bfloat16)
+    eng = build_engine(params, bf, _engine_config())
+    assert eng._model.kv_cache_config().cache_dtype == "bfloat16"
