@@ -31,18 +31,22 @@ def main(argv=None):
     for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint", "numpy"):
         print(f"{mod:<22} ... {_version(mod)}")
     print("-" * 60)
-    try:
-        import jax
-        devs = jax.devices()
-        print(f"backend ................ {jax.default_backend()}")
-        print(f"devices ................ {len(devs)}: {devs[0].device_kind if devs else '-'}")
-        print(f"process count .......... {jax.process_count()}")
-        mems = [m.kind for m in devs[0].addressable_memories()] if devs else []
+    # a dead TPU tunnel HANGS backend init rather than raising — the device
+    # facts come from ONE timed subprocess (shared probe; the parent never
+    # touches the backend, so the report can't freeze and doesn't pay
+    # backend init twice)
+    from deepspeed_tpu.utils.jax_platform import probe_backend
+    info, why = probe_backend()
+    if info is None:
+        print(f"backend ................ UNREACHABLE ({why})")
+    else:
+        mems = info["memory_kinds"]
+        print(f"backend ................ {info['backend']}")
+        print(f"devices ................ {info['device_count']}: {info['device_kind']}")
+        print(f"process count .......... {info['process_count']}")
         print(f"memory kinds ........... {mems}")
         print(f"host offload ........... "
               f"{GREEN_OK if 'pinned_host' in mems else RED_NO}")
-    except Exception as e:
-        print(f"backend ................ ERROR: {e}")
     print("-" * 60)
     # native-op compat matrix (reference env_report.py op_report / ds_report)
     from deepspeed_tpu.ops.op_builder import ALL_OPS

@@ -9,7 +9,40 @@ smoke worker, CLI tools under test) has to re-assert it through
 cpu-pinned child hangs forever initializing a dead TPU tunnel.
 """
 
+import json
 import os
+import subprocess
+import sys
+
+PROBE_TIMEOUT_S = 150  # backend init on a pod can legitimately take >60s
+
+
+def probe_backend(timeout_s: int = PROBE_TIMEOUT_S):
+    """Ask a SUBPROCESS for backend facts (a dead TPU tunnel hangs backend
+    init rather than raising, so the parent must never touch it first).
+
+    Returns (info_dict, ""), or (None, why) when the backend is unreachable.
+    info: {backend, device_count, device_kind, process_count, memory_kinds}.
+    """
+    code = (
+        "import json, jax\n"
+        "d = jax.devices()\n"
+        "print(json.dumps({'backend': jax.default_backend(),"
+        " 'device_count': len(d),"
+        " 'device_kind': d[0].device_kind if d else '-',"
+        " 'process_count': jax.process_count(),"
+        " 'memory_kinds': [m.kind for m in d[0].addressable_memories()] if d else []}))\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None, f"backend probe hung >{timeout_s}s (dead TPU tunnel?)"
+    if r.returncode != 0:
+        return None, f"probe rc={r.returncode}: {(r.stderr or '').strip()[-200:]}"
+    for line in reversed(r.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            return json.loads(line), ""
+    return None, "probe produced no info"
 
 
 def honor_platform_env(default: str = "") -> None:
