@@ -1,0 +1,71 @@
+"""Quickstart: FastGen-style ragged serving with the v2 engine.
+
+Prefill + on-device decode_loop + continuous-batching generate + the
+inference-checkpoint round-trip, on a tiny random llama.
+
+Run:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 python examples/serve_v2.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.realpath(__file__))))
+import tempfile
+
+if "host_platform_device_count" in os.environ.get("XLA_FLAGS", "") \
+        or os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from deepspeed_tpu.models.llama import LlamaConfig, init_params
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.engine_factory import (build_engine, build_hf_engine,
+                                                       generate)
+from deepspeed_tpu.inference.v2.ragged.manager_configs import (AllocationMode,
+                                                               DSStateManagerConfig,
+                                                               MemoryConfig)
+
+
+def main():
+    cfg = LlamaConfig.tiny(vocab_size=512, max_position_embeddings=128)
+    _, params = init_params(cfg, seq_len=16)
+    engine_config = RaggedInferenceEngineConfig(
+        state_manager=DSStateManagerConfig(
+            memory_config=MemoryConfig(mode=AllocationMode.ALLOCATE, size=128),
+            max_context=128, max_ragged_batch_size=256, max_ragged_sequence_count=8),
+        kv_block_size=16,
+        # int4 at-rest weights (ZeRO-Inference): halve again with bits=4
+        weight_quantization={"enabled": True, "bits": 8})
+    engine = build_engine(params, cfg, engine_config)
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, n) for n in (24, 9, 40)]
+
+    # continuous batching, chunks of 4 decode steps per device dispatch
+    outs = generate(engine, prompts, max_new_tokens=12, decode_chunk=4)
+    for i, out in enumerate(outs):
+        print(f"seq {i}: {len(prompts[i])} prompt tokens -> {out}")
+
+    # KV offload: evict a cold sequence; it restores transparently on touch
+    pre = engine.put([7], [prompts[0]])
+    engine.offload_sequence(7)
+    first = np.asarray([int(np.argmax(np.asarray(pre)[0]))], np.int32)
+    toks = engine.decode_loop([7], [first], 4)   # restore + 4 steps, one program
+    print("offload/restore decode:", np.asarray(toks)[0].tolist())
+
+    # inference-checkpoint round-trip
+    d = tempfile.mkdtemp()
+    engine.serialize(d)
+    rebuilt = build_hf_engine(d, engine_config)  # auto-detects the DS checkpoint
+    np.testing.assert_allclose(np.asarray(rebuilt.put([0], [prompts[1]])),
+                               np.asarray(engine.put([9], [prompts[1]])),
+                               rtol=1e-4, atol=1e-4)
+    print("serialize round-trip OK")
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()
