@@ -37,6 +37,7 @@ from deepspeed_tpu.comm.reduce_op import ReduceOp
 from deepspeed_tpu.utils import groups as groups_mod
 from deepspeed_tpu.utils.comms_logging import CommsLogger
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.jax_compat import shard_map as _compat_shard_map
 
 cdb = None  # current distributed backend (reference: comm.py:41)
 comms_logger = CommsLogger()
@@ -158,22 +159,30 @@ def _reduce_fn(op):
 
 
 def timed_op(func):
-    """Profile collectives through the comms logger (reference: comm.py:101-134)."""
+    """Profile collectives through the comms logger and/or the unified
+    telemetry layer (reference: comm.py:101-134 @timed_op). Disabled (the
+    default) the wrapper costs two boolean checks and nothing else — the
+    telemetry registry/span sinks are only touched when ``telemetry.state
+    .active``."""
+    from deepspeed_tpu import telemetry
 
     @functools.wraps(func)
     def wrapper(*args, **kwargs):
+        if not (comms_logger.enabled or telemetry.state.active):
+            return func(*args, **kwargs)
+        import jax
         name = func.__name__
+        t0 = time.time()
+        result = func(*args, **kwargs)
+        jax.block_until_ready(result)
+        elapsed = time.time() - t0
+        tensor = args[0] if args else kwargs.get("tensor")
+        size = int(np.prod(tensor.shape)) * tensor.dtype.itemsize if tensor is not None else 0
         if comms_logger.enabled:
-            import jax
-            t0 = time.time()
-            result = func(*args, **kwargs)
-            jax.block_until_ready(result)
-            elapsed = time.time() - t0
-            tensor = args[0] if args else kwargs.get("tensor")
-            size = int(np.prod(tensor.shape)) * tensor.dtype.itemsize if tensor is not None else 0
             comms_logger.append(name, kwargs.get("log_name", name), elapsed, size)
-            return result
-        return func(*args, **kwargs)
+        if telemetry.state.active:
+            telemetry.record_comm_op(name, elapsed, size)
+        return result
 
     return wrapper
 
@@ -181,7 +190,7 @@ def timed_op(func):
 def _shard_map(fn, in_specs, out_specs):
     import jax
     mesh = groups_mod.get_mesh()
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    return _compat_shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
 
 
 def _device_put_grouped(tensor, axes):
@@ -494,7 +503,11 @@ def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
 
 
 def log_summary(show_straggler=False):
-    """Print per-op communication statistics (reference: comm.py:422)."""
+    """Print per-op communication statistics (reference: comm.py:422).
+
+    With ``show_straggler=True`` on a multi-process job this is a COLLECTIVE
+    (cross-rank latency allgather, as in the reference): call it on every
+    process, not under an ``if rank == 0`` guard."""
     comms_logger.log_all(print_log=True, show_straggler=show_straggler)
 
 

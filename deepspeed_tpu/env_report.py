@@ -21,7 +21,61 @@ GREEN_OK = "\033[92m[OKAY]\033[0m"
 RED_NO = "\033[93m[NO]\033[0m"
 
 
+def metrics_report(url):
+    """``dstpu_report --metrics-url <url>``: scrape a running engine's
+    telemetry endpoint and pretty-print it (plus the /healthz verdict)."""
+    import json
+    import urllib.request
+
+    from deepspeed_tpu.telemetry import scrape_metrics
+
+    base = url if url.startswith(("http://", "https://")) else "http://" + url
+    base = base.rstrip("/")
+    for suffix in ("/metrics", "/healthz", "/trace"):
+        if base.endswith(suffix):
+            base = base[:-len(suffix)]
+            break
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as resp:
+            health = json.loads(resp.read().decode()).get("status", "?")
+            health_line = f"{GREEN_OK} ({health}, HTTP {resp.status})"
+    except Exception as e:
+        health_line = f"{RED_NO} ({e})"
+    print("-" * 60)
+    print(f"telemetry endpoint ..... {base}")
+    print(f"healthz ................ {health_line}")
+    print("-" * 60)
+    try:
+        families = scrape_metrics(base)
+    except Exception as e:
+        print(f"scrape failed: {e}")
+        return 1
+    for name in sorted(families):
+        fam = families[name]
+        header = f"{name} [{fam['type']}]"
+        if fam["help"]:
+            header += f" — {fam['help']}"
+        print(header)
+        for sample_name, labels, value in fam["samples"]:
+            if sample_name.endswith("_bucket"):
+                continue  # count/sum summarize; buckets are for the scraper
+            print(f"  {sample_name + _fmt_labels(labels):<44} {value:g}")
+        print()
+    return 0
+
+
+def _fmt_labels(labels):
+    return "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}" if labels else ""
+
+
 def main(argv=None):
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if "--metrics-url" in argv:
+        idx = argv.index("--metrics-url")
+        if idx + 1 >= len(argv):
+            print("usage: dstpu_report --metrics-url <host:port | http://...>")
+            return 2
+        return metrics_report(argv[idx + 1])
     import deepspeed_tpu
     print("-" * 60)
     print("DeepSpeed-TPU C++/JAX environment report")

@@ -11,6 +11,7 @@ from pydantic import Field
 
 from deepspeed_tpu.inference.v2.ragged.manager_configs import DSStateManagerConfig
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+from deepspeed_tpu.telemetry.config import TelemetryConfig
 
 
 class DeepSpeedTPConfig(DeepSpeedConfigModel):
@@ -59,3 +60,10 @@ class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     simulated_gating: bool = False
     simulated_gating_temperature: float = 1.0
     trace_enabled: bool = False
+    max_trace_batches: int = 1024
+    """Tracer ring-buffer capacity (batches); beyond it the oldest unconsumed
+    trace is dropped — drain via ``engine.tracer.drain_summaries()``."""
+
+    telemetry: TelemetryConfig = TelemetryConfig()
+    """Unified telemetry: batch/token/KV gauges, per-phase spans, and the
+    ``/metrics`` + ``/healthz`` endpoint when ``telemetry.http.enabled``."""

@@ -25,6 +25,7 @@ from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import RaggedBatchWrapper
 from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import PlaceholderSequenceDescriptor
 from deepspeed_tpu.inference.v2.scheduling_utils import SchedulingError, SchedulingResult
 from deepspeed_tpu.inference.v2.tracer import Tracer, set_tracer
+from deepspeed_tpu.telemetry import now_us as _tel_now_us
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.logging import logger
 
@@ -52,8 +53,29 @@ class InferenceEngineV2:
                                              model.kv_cache_config())
         self._model.set_state_manager(self._state_manager)
 
+        # unified telemetry (telemetry/): batch/token/KV gauges + spans +
+        # optional /metrics //healthz endpoint, startable purely from config
+        self._telemetry = None
+        self._tel_metrics = None
+        if engine_config.telemetry.enabled:
+            from deepspeed_tpu import telemetry
+            self._telemetry = telemetry.configure(engine_config.telemetry)
+            reg = self._telemetry.registry
+            self._tel_metrics = {
+                "batches": reg.counter("inference_batches_total", "Ragged batches executed"),
+                "tokens": reg.counter("inference_tokens_total", "Tokens scheduled into batches"),
+                "in_flight": reg.gauge("inference_in_flight_tokens",
+                                       "Tokens in the last ragged batch"),
+                "free_blocks": reg.gauge("inference_kv_free_blocks", "Free KV-cache blocks"),
+                "tracked": reg.gauge("inference_tracked_sequences", "Sequences tracked"),
+                "empty_runs": reg.counter("inference_empty_runs_total",
+                                          "EP lock-step forwards with zero tokens"),
+            }
+
         if engine_config.trace_enabled:
-            self._tracer = Tracer()
+            self._tracer = Tracer(max_batches=engine_config.max_trace_batches,
+                                  span_recorder=self._telemetry.spans
+                                  if self._telemetry is not None else None)
             set_tracer(self._tracer)
         else:
             self._tracer = None
@@ -111,6 +133,21 @@ class InferenceEngineV2:
     def tracer(self) -> Optional[Tracer]:
         return self._tracer
 
+    @property
+    def telemetry_session(self):
+        return self._telemetry
+
+    @property
+    def metrics_url(self) -> Optional[str]:
+        """The served ``/metrics`` URL (None unless ``telemetry.http.enabled``)."""
+        return self._telemetry.metrics_url if self._telemetry is not None else None
+
+    def close(self) -> None:
+        """Stop the telemetry endpoint and flush sinks (idempotent)."""
+        if self._telemetry is not None:
+            self._telemetry.close()
+            self._telemetry = None
+
     # ----------------------------------------------------------------- put() --
     def put(self, batch_uids: Iterable[int], batch_tokens: Iterable, do_checks: bool = True):
         """Run one ragged forward over ``batch_uids``/``batch_tokens``; returns
@@ -140,6 +177,8 @@ class InferenceEngineV2:
 
         self._batch.finalize()
         self._model.prepare_batch(self._batch)
+        if self._telemetry is not None:
+            _t0 = _tel_now_us()
         logits = self._model.forward(self._batch)
         assert logits.shape[0] == self._batch.current_sequences
 
@@ -147,7 +186,22 @@ class InferenceEngineV2:
             seq_desc = self._state_manager.get_sequence(uid)
             seq_desc.post_forward()
             self._model.maybe_free_kv(seq_desc)
+        if self._telemetry is not None:
+            n_tokens = int(sum(t.size for t in batch_tokens))
+            self._telemetry.spans.record("put", cat="inference", ts_us=_t0,
+                                         dur_us=_tel_now_us() - _t0,
+                                         args={"sequences": len(batch_uids),
+                                               "tokens": n_tokens})
+            self._write_telemetry(batch_tokens=n_tokens)
         return logits
+
+    def _write_telemetry(self, batch_tokens: int) -> None:
+        m = self._tel_metrics
+        m["batches"].inc()
+        m["tokens"].inc(batch_tokens)
+        m["in_flight"].set(batch_tokens)
+        m["free_blocks"].set(self._state_manager.free_blocks)
+        m["tracked"].set(self._state_manager.n_tracked_sequences)
 
     # ------------------------------------------------------------ decode_loop --
     def decode_loop(self, batch_uids: Iterable[int], batch_tokens: Iterable,
@@ -201,8 +255,16 @@ class InferenceEngineV2:
             self._batch.insert_sequence(seq_desc, tokens, do_checks=do_checks)
 
         self._batch.finalize()
+        if self._telemetry is not None:
+            _t0 = _tel_now_us()
         tokens = self._model.decode_loop(self._batch, n_steps, temperature=temperature,
                                          rng=rng)  # [n_steps, S_bucket]
+        if self._telemetry is not None:
+            self._telemetry.spans.record("decode_loop", cat="inference", ts_us=_t0,
+                                         dur_us=_tel_now_us() - _t0,
+                                         args={"sequences": len(batch_uids),
+                                               "steps": n_steps})
+            self._write_telemetry(batch_tokens=len(batch_uids) * n_steps)
         for uid in batch_uids:
             seq_desc = self._state_manager.get_sequence(uid)
             seq_desc.post_forward()           # the token passed in
@@ -299,6 +361,8 @@ class InferenceEngineV2:
         engine_v2.py:308) — keeps idle replicas in lock-step with busy ones."""
         if self._tracer:
             self._tracer.init_batch(is_empty_run=True, num_layers=self._model.num_layers)
+        if self._telemetry is not None:
+            self._tel_metrics["empty_runs"].inc()
         self._model.empty_run()
 
     # -------------------------------------------------------------- serialize --

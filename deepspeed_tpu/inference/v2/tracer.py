@@ -10,14 +10,25 @@ device computations with ``block_until_ready`` barriers, and ``record`` takes
 wall-clock timestamps around each. Tracing therefore perturbs performance (the
 reference's CUDA events cost less but also perturb); it reports true per-phase
 device times in microseconds, matching the reference's summary schema.
+
+Memory: traces accumulate in a bounded ring buffer (``max_batches``, default
+1024) so long-running serving cannot leak; consumers should prefer
+``drain_summaries()`` which frees what it returns. When a
+``span_recorder`` is attached (telemetry enabled), every recorded phase also
+emits a Chrome-trace span under the ``inference`` category.
 """
 
 import time
+from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, List
 
+from deepspeed_tpu.telemetry import now_us
+
 RECORD_NAMES = ["attn", "ffn", "moe_a2a_1", "moe_a2a_2", "moe_ffn", "moe_a2a_3"]
+
+DEFAULT_MAX_TRACE_BATCHES = 1024
 
 
 @dataclass
@@ -45,10 +56,17 @@ class BatchTraceSummary:
 
 class Tracer:
 
-    def __init__(self):
+    def __init__(self, max_batches: int = DEFAULT_MAX_TRACE_BATCHES, span_recorder=None):
         self._batch_counter = 0
-        self._batch_traces: List[BatchTraceHolder] = []
+        # ring buffer: long-running serving must not grow host memory per
+        # batch; past capacity the oldest unconsumed trace is dropped
+        self._batch_traces = deque(maxlen=max(1, max_batches))
         self._cur: BatchTraceHolder = None
+        self.span_recorder = span_recorder
+
+    @property
+    def pending_batches(self) -> int:
+        return len(self._batch_traces)
 
     def init_batch(self, is_empty_run: bool, num_layers: int) -> None:
         self._cur = BatchTraceHolder(self._batch_counter, num_layers, is_empty_run)
@@ -59,9 +77,14 @@ class Tracer:
         self._cur.seen_tokens.append(seq_desc.seen_tokens)
         self._cur.in_flight_tokens.append(seq_desc.in_flight_tokens)
 
-    def add_trace(self, name: str, elapsed_us: int) -> None:
-        if self._cur is not None:
-            self._cur.traces.append((name, elapsed_us))
+    def add_trace(self, name: str, elapsed_us: int, ts_us: int = None) -> None:
+        if self._cur is None:
+            return
+        self._cur.traces.append((name, elapsed_us))
+        if self.span_recorder is not None:
+            self.span_recorder.record(name, cat="inference", ts_us=ts_us,
+                                      dur_us=elapsed_us,
+                                      args={"batch_id": self._cur.batch_id})
 
     def _summarize(self, bt: BatchTraceHolder) -> BatchTraceSummary:
         traces = list(bt.traces)
@@ -91,8 +114,20 @@ class Tracer:
                                  unembed=unembed)
 
     def batch_summaries(self):
+        """Summaries of everything still buffered (non-destructive)."""
         for bt in self._batch_traces:
             yield self._summarize(bt)
+
+    def drain_summaries(self) -> List[BatchTraceSummary]:
+        """Summarize AND free the consumed traces — the long-running-serving
+        consumption API (a periodic drain keeps the ring from ever dropping)."""
+        out = []
+        while self._batch_traces:
+            bt = self._batch_traces.popleft()
+            out.append(self._summarize(bt))
+            if bt is self._cur:
+                self._cur = None
+        return out
 
 
 _TRACER = None
@@ -116,7 +151,8 @@ def record(name: str):
         yield
         return
     t0 = time.perf_counter()
+    ts0 = now_us()
     try:
         yield
     finally:
-        tracer.add_trace(name, int((time.perf_counter() - t0) * 1e6))
+        tracer.add_trace(name, int((time.perf_counter() - t0) * 1e6), ts_us=ts0)

@@ -24,11 +24,21 @@ class CSVConfig(DeepSpeedConfigModel):
     job_name: str = "DeepSpeedJobName"
 
 
+class JSONLConfig(DeepSpeedConfigModel):
+    """Append-only JSONL event stream (one ``{"tag", "value", "step", "ts"}``
+    object per line) — the tail-able backend the telemetry layer reads."""
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
 class DeepSpeedMonitorConfig(DeepSpeedConfigModel):
     tensorboard: TensorBoardConfig = {}
     wandb: WandbConfig = {}
     csv_monitor: CSVConfig = {}
+    jsonl: JSONLConfig = {}
 
     @property
     def enabled(self):
-        return self.tensorboard.enabled or self.wandb.enabled or self.csv_monitor.enabled
+        return self.tensorboard.enabled or self.wandb.enabled \
+            or self.csv_monitor.enabled or self.jsonl.enabled

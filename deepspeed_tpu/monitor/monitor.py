@@ -97,6 +97,32 @@ class csvMonitor(Monitor):
                 w.writerow([int(step), float(value)])
 
 
+class JSONLMonitor(Monitor):
+    """Append-only JSONL event stream: one ``{"tag", "value", "step", "ts"}``
+    object per line in ``<output_path>/<job_name>.jsonl`` — tail-able while
+    training runs, and loadable line-by-line (no footer to finalize)."""
+
+    def __init__(self, jsonl_config):
+        super().__init__(jsonl_config)
+        self.enabled = jsonl_config.enabled and _rank() == 0
+        self.log_file = None
+        if self.enabled:
+            log_dir = jsonl_config.output_path or "./jsonl_monitor"
+            os.makedirs(log_dir, exist_ok=True)
+            self.log_file = os.path.join(log_dir, jsonl_config.job_name + ".jsonl")
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        import json
+        import time
+        now = time.time()
+        with open(self.log_file, "a") as f:
+            for name, value, step in event_list:
+                f.write(json.dumps({"tag": name, "value": float(value),
+                                    "step": int(step), "ts": now}) + "\n")
+
+
 class MonitorMaster(Monitor):
     """Reference monitor.py:29 — fans events out to every enabled backend."""
 
@@ -105,7 +131,9 @@ class MonitorMaster(Monitor):
         self.tb_monitor = TensorBoardMonitor(monitor_config.tensorboard)
         self.wandb_monitor = WandbMonitor(monitor_config.wandb)
         self.csv_monitor = csvMonitor(monitor_config.csv_monitor)
-        self.enabled = self.tb_monitor.enabled or self.wandb_monitor.enabled or self.csv_monitor.enabled
+        self.jsonl_monitor = JSONLMonitor(monitor_config.jsonl)
+        self.enabled = self.tb_monitor.enabled or self.wandb_monitor.enabled \
+            or self.csv_monitor.enabled or self.jsonl_monitor.enabled
 
     def write_events(self, event_list):
         if self.tb_monitor.enabled:
@@ -114,3 +142,5 @@ class MonitorMaster(Monitor):
             self.wandb_monitor.write_events(event_list)
         if self.csv_monitor.enabled:
             self.csv_monitor.write_events(event_list)
+        if self.jsonl_monitor.enabled:
+            self.jsonl_monitor.write_events(event_list)

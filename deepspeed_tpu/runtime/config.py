@@ -147,6 +147,8 @@ class DeepSpeedConfig:
         from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngineConfig
         self.hybrid_engine_config = DeepSpeedHybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.comms_config = CommsConfig(**pd.get("comms_logger", {}))
+        from deepspeed_tpu.telemetry.config import TelemetryConfig
+        self.telemetry_config = TelemetryConfig(**pd.get("telemetry", {}))
         self.checkpoint_config = CheckpointConfig(**pd.get(C.CHECKPOINT, {}))
         self.data_types_config = DataTypesConfig(**pd.get(C.DATA_TYPES, {}))
         self.aio_config = AioConfig(**pd.get("aio", {}))

@@ -39,10 +39,12 @@ from deepspeed_tpu.runtime.utils import (cast_tree, clip_grads_by_global_norm, g
                                          tree_select, see_memory_usage)
 from deepspeed_tpu.runtime.zero.policy import ZeroShardingPolicy
 from deepspeed_tpu.utils import groups
+from deepspeed_tpu.telemetry import now_us as _tel_now_us
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER, BACKWARD_MICRO_TIMER, FORWARD_GLOBAL_TIMER,
-                                       FORWARD_MICRO_TIMER, STEP_GLOBAL_TIMER, STEP_MICRO_TIMER, NoopTimer,
-                                       SynchronizedWallClockTimer, ThroughputTimer)
+                                       FORWARD_MICRO_TIMER, STEP_GLOBAL_TIMER, STEP_MICRO_TIMER,
+                                       TRAIN_BATCH_TIMER, NoopTimer, SynchronizedWallClockTimer,
+                                       ThroughputTimer)
 
 MEMORY_OPT_ALLREDUCE_SIZE = 500000000
 
@@ -397,7 +399,22 @@ class DeepSpeedEngine:
 
         # timers / monitor (reference EngineTimers:144, _write_monitor:2261)
         self.wall_clock_breakdown = self._config.wall_clock_breakdown
-        self.timers = SynchronizedWallClockTimer() if self.wall_clock_breakdown else NoopTimer()
+        # unified telemetry (telemetry/): metrics registry + span recorder +
+        # optional /metrics endpoint. With tracing active the real wall-clock
+        # timers run (wrapped so every fwd/bwd/step start/stop emits a span);
+        # disabled, every instrumented site below is a single `is not None`
+        # check on self._telemetry.
+        self._telemetry = None
+        self._tel_metrics = None
+        self._tel_last_step_time = None
+        if self._config.telemetry_config.enabled:
+            from deepspeed_tpu import telemetry
+            self._telemetry = telemetry.configure(self._config.telemetry_config)
+        self.timers = SynchronizedWallClockTimer() \
+            if (self.wall_clock_breakdown or self._telemetry is not None) else NoopTimer()
+        if self._telemetry is not None:
+            from deepspeed_tpu import telemetry
+            self.timers = telemetry.wrap_timers(self.timers)
         self.tput_timer = ThroughputTimer(
             config=type("cfg", (), {"enabled": True})(),
             batch_size=self.train_batch_size(),
@@ -809,6 +826,8 @@ class DeepSpeedEngine:
             if self.monitor is not None and self.monitor.enabled and self.global_steps % max(
                     1, self._config.steps_per_print) == 0:
                 self._write_monitor()
+            if self._telemetry is not None:
+                self._write_telemetry(loss=self._cached_loss)
         self.micro_steps += 1
         self.timers(STEP_MICRO_TIMER).stop()
 
@@ -913,6 +932,8 @@ class DeepSpeedEngine:
         else:
             batch = self.stage_train_batch(batch=batch).tree
         self._maybe_profile_flops(batch, micro_stacked=True)
+        if self._telemetry is not None:
+            _tel_t0 = _tel_now_us()
         self.tput_timer.start()
         import jax.numpy as jnp
         lr = jnp.asarray(self._current_lr, jnp.float32)
@@ -935,9 +956,16 @@ class DeepSpeedEngine:
             self._last_batch = jax.tree.map(lambda x: x[0], batch)
             self.compression_scheduler.step(self)
         self.tput_timer.stop(global_step=True)
+        if self._telemetry is not None:
+            # tput_timer.stop synchronized the device, so the interval is true
+            # device time for the fused accumulate+step program
+            self._telemetry.spans.record(TRAIN_BATCH_TIMER, cat="engine", ts_us=_tel_t0,
+                                         dur_us=_tel_now_us() - _tel_t0)
         if self.monitor is not None and self.monitor.enabled and self.global_steps % max(
                 1, self._config.steps_per_print) == 0:
             self._write_monitor(loss=loss)
+        if self._telemetry is not None:
+            self._write_telemetry(loss=loss)
         return loss
 
     def _micro_stack_sharding(self, leaf):
@@ -963,6 +991,9 @@ class DeepSpeedEngine:
             self._offload.swapper.close()
         if self.monitor is not None and hasattr(self.monitor, "close"):
             self.monitor.close()
+        if self._telemetry is not None:
+            self._telemetry.close()  # flushes the Chrome trace + JSONL sink
+            self._telemetry = None
         self._compiled.clear()
         self._cached_grads = None
         self.acc_grads = None
@@ -1479,6 +1510,16 @@ class DeepSpeedEngine:
 
     # --------------------------------------------------------------- reporting --
     @property
+    def telemetry_session(self):
+        """The live telemetry session (None unless the config enables it)."""
+        return self._telemetry
+
+    @property
+    def metrics_url(self):
+        """The served ``/metrics`` URL (None unless ``telemetry.http.enabled``)."""
+        return self._telemetry.metrics_url if self._telemetry is not None else None
+
+    @property
     def overflow(self):
         return bool(self._overflow_count > 0)
 
@@ -1498,6 +1539,50 @@ class DeepSpeedEngine:
         if self._fp16:
             events.append((f"Train/Samples/loss_scale", self.loss_scale, self.global_samples))
         self.monitor.write_events(events)
+
+    def _write_telemetry(self, loss=None):
+        """Per-boundary step metrics into the unified registry (gauges for
+        scraping) and the JSONL event stream: loss, lr, samples/sec,
+        grad-norm, skipped-steps. The float()/int() reads below sync the
+        device — telemetry, like tracing, perturbs the async pipeline; it is
+        opt-in."""
+        import time as _time
+        if self._tel_metrics is None:
+            reg = self._telemetry.registry
+            self._tel_metrics = {
+                "loss": reg.gauge("train_loss", "Last boundary-step training loss"),
+                "lr": reg.gauge("train_lr", "Current learning rate"),
+                "sps": reg.gauge("train_samples_per_sec", "Boundary-to-boundary throughput"),
+                "norm": reg.gauge("train_grad_norm", "Global gradient norm at the last step"),
+                "skipped": reg.gauge("train_skipped_steps", "Overflow-skipped optimizer steps"),
+                "steps": reg.gauge("train_global_steps", "Optimizer steps taken"),
+                "samples": reg.counter("train_samples_total", "Samples consumed"),
+            }
+        m = self._tel_metrics
+        now = _time.time()
+        sps = self.train_batch_size() / (now - self._tel_last_step_time) \
+            if self._tel_last_step_time is not None and now > self._tel_last_step_time else None
+        self._tel_last_step_time = now
+        norm = self.get_global_grad_norm()
+        skipped = self.skipped_steps
+        m["lr"].set(self._current_lr)
+        m["steps"].set(self.global_steps)
+        m["skipped"].set(skipped)
+        m["samples"].inc(self.train_batch_size())
+        fields = {"step": self.global_steps, "samples": self.global_samples,
+                  "lr": self._current_lr, "skipped_steps": skipped}
+        if loss is not None:
+            fields["loss"] = float(loss)
+            m["loss"].set(fields["loss"])
+        if sps is not None:
+            fields["samples_per_sec"] = sps
+            m["sps"].set(sps)
+        if norm is not None:
+            fields["grad_norm"] = norm
+            m["norm"].set(norm)
+        if self._fp16:
+            fields["loss_scale"] = self.loss_scale
+        self._telemetry.registry.event("train_step", **fields)
 
     # ------------------------------------------------------------- checkpoints --
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,

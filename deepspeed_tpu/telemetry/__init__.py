@@ -1,0 +1,183 @@
+"""Unified telemetry: metrics registry + span recorder + HTTP exporter.
+
+One process-wide layer that every subsystem feeds (training engine step
+metrics, per-collective latency/bytes, inference batch/token occupancy) and
+that an operator can scrape (``/metrics``), tail (JSONL event stream) or load
+into a trace viewer (Chrome-trace export).
+
+Hot-path contract: when telemetry is disabled (the default) instrumented call
+sites perform exactly one boolean check (``telemetry.state.active``) and
+nothing else — no registry lookups, no allocations. The registry counts its
+own API calls so tests can enforce this.
+
+Usage::
+
+    from deepspeed_tpu import telemetry
+    session = telemetry.configure(TelemetryConfig(enabled=True, ...))
+    telemetry.get_registry().counter("my_total").inc()
+    session.close()
+"""
+
+import threading
+
+from deepspeed_tpu.telemetry.config import TelemetryConfig, TelemetryHTTPConfig
+from deepspeed_tpu.telemetry.exporter import (TelemetryHTTPServer, scrape_metrics,
+                                              start_http_server)
+from deepspeed_tpu.telemetry.registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                                              parse_prometheus_text)
+from deepspeed_tpu.telemetry.spans import Span, SpanRecorder, TracingTimers, now_us
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = [
+    "TelemetryConfig", "TelemetryHTTPConfig", "MetricsRegistry", "Counter", "Gauge",
+    "Histogram", "SpanRecorder", "Span", "TracingTimers", "TelemetryHTTPServer",
+    "TelemetrySession", "configure", "shutdown", "get_registry", "get_span_recorder",
+    "is_active", "record_comm_op", "wrap_timers", "start_http_server", "scrape_metrics",
+    "parse_prometheus_text", "state", "now_us",
+]
+
+# comm-op latencies live well under the default buckets' top decades; bytes
+# need their own scale
+_COMM_BYTES_BUCKETS = (1024.0, 16384.0, 131072.0, 1048576.0, 8388608.0,
+                       67108864.0, 536870912.0, 4294967296.0)
+
+
+class _TelemetryState:
+    """The one boolean the hot paths check, plus the live sinks behind it."""
+
+    def __init__(self):
+        self.active = False
+        self.registry = None
+        self.spans = None
+        self.session = None
+        self._lock = threading.RLock()
+        self._comm_metrics = {}
+
+
+state = _TelemetryState()
+
+
+def get_registry():
+    """The process-wide registry (created on first use; exists independently
+    of whether telemetry is active so tests can count calls while disabled)."""
+    with state._lock:
+        if state.registry is None:
+            state.registry = MetricsRegistry()
+        return state.registry
+
+
+def get_span_recorder():
+    return state.spans
+
+
+def is_active():
+    return state.active
+
+
+def _process_index():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+class TelemetrySession:
+
+    def __init__(self, config: TelemetryConfig):
+        self.config = config
+        self.registry = get_registry()
+        self.spans = SpanRecorder(max_spans=config.max_spans)
+        self.server = None
+        self._closed = False
+        # metrics/spans record on every rank (cheap, local); the export
+        # surfaces — file sinks and the HTTP port — are process-0-only by
+        # default, like the monitor backends, so multi-process runs don't
+        # interleave one JSONL file or collide on a fixed port.
+        self.exporting = config.all_ranks or _process_index() == 0
+        if config.jsonl_path and self.exporting:
+            self.registry.open_jsonl(config.jsonl_path)
+        if config.http.enabled and self.exporting:
+            self.server = start_http_server(self.registry, spans=self.spans,
+                                            host=config.http.host, port=config.http.port)
+        state.spans = self.spans
+        state.session = self
+        state.active = True
+
+    @property
+    def metrics_url(self):
+        return self.server.url + "/metrics" if self.server else None
+
+    def flush(self):
+        """Write the Chrome trace (if configured). JSONL is flushed per event."""
+        if self.config.trace_path and self.exporting:
+            self.spans.export_chrome_trace(self.config.trace_path)
+            logger.info(f"telemetry: wrote Chrome trace to {self.config.trace_path} "
+                        f"({len(self.spans)} spans; open in chrome://tracing or Perfetto)")
+
+    def close(self):
+        """Idempotent; a session displaced by a newer configure() was already
+        closed and must not touch the (shared) registry's current sinks."""
+        if self._closed:
+            return
+        self._closed = True
+        self.flush()
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
+        if state.session is self:
+            self.registry.close_jsonl()
+            state.active = False
+            state.session = None
+            state.spans = None
+            with state._lock:
+                state._comm_metrics.clear()
+
+
+def configure(config) -> TelemetrySession:
+    """Activate telemetry from a :class:`TelemetryConfig` (or a raw dict).
+    Reconfiguring closes the previous session's sinks; the registry (and its
+    accumulated metrics) persists across sessions."""
+    if isinstance(config, dict):
+        config = TelemetryConfig(**config)
+    if state.session is not None:
+        state.session.close()
+    return TelemetrySession(config)
+
+
+def shutdown():
+    if state.session is not None:
+        state.session.close()
+
+
+def wrap_timers(timers):
+    """Wrap a timers object so start/stop pairs emit spans (engine fwd/bwd/step)."""
+    return TracingTimers(timers, state.spans) if state.spans is not None else timers
+
+
+def record_comm_op(op_name, latency_s, size_bytes):
+    """One collective's telemetry: latency/bytes histograms, op counter and a
+    span. Called from ``comm.timed_op`` only when ``state.active``."""
+    with state._lock:
+        metrics = state._comm_metrics.get(op_name)
+        if metrics is None:
+            registry = get_registry()
+            labels = {"op": op_name}
+            metrics = (
+                registry.histogram("comm_op_latency_seconds",
+                                   "Per-collective wall latency", labels=labels),
+                registry.histogram("comm_op_bytes", "Per-collective message size",
+                                   labels=labels, buckets=_COMM_BYTES_BUCKETS),
+                registry.counter("comm_ops_total", "Collectives executed", labels=labels),
+            )
+            state._comm_metrics[op_name] = metrics
+    lat_hist, bytes_hist, counter = metrics
+    lat_hist.observe(latency_s)
+    bytes_hist.observe(size_bytes)
+    counter.inc()
+    spans = state.spans  # snapshot: a concurrent close() may null the field
+    if spans is not None:
+        end = now_us()
+        dur = int(latency_s * 1e6)
+        spans.record(op_name, cat="comm", ts_us=end - dur, dur_us=dur,
+                     args={"bytes": int(size_bytes)})

@@ -1,0 +1,42 @@
+"""Telemetry config block (``"telemetry": {...}`` in the master JSON config).
+
+New subsystem (no single reference analog): unifies the knobs that the
+reference scatters over ``comms_logger`` / ``monitor`` / ``flops_profiler``
+into one switch for the metrics registry, span recorder and HTTP exporter.
+"""
+
+from typing import Optional
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class TelemetryHTTPConfig(DeepSpeedConfigModel):
+    """Serving endpoint for scrapes: ``/metrics`` (Prometheus text),
+    ``/healthz`` (liveness) and ``/trace`` (Chrome-trace JSON)."""
+
+    enabled: bool = False
+    host: str = "127.0.0.1"
+    port: int = 0
+    """0 = ephemeral; the bound port is logged and available on the session."""
+
+
+class TelemetryConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+
+    jsonl_path: Optional[str] = None
+    """Append-mode JSONL event sink (one JSON object per line; see README
+    Observability for the schema). None = no file sink."""
+
+    trace_path: Optional[str] = None
+    """Chrome-trace (``chrome://tracing`` / Perfetto) JSON written on
+    ``flush()`` / session close. None = spans stay scrape-only (``/trace``)."""
+
+    max_spans: int = 65536
+    """Span ring-buffer capacity; oldest spans are dropped beyond this."""
+
+    all_ranks: bool = False
+    """Metrics/spans always record on every rank; file sinks and the HTTP
+    endpoint open on process 0 only unless this is set (give each rank its
+    own paths/ephemeral port when you do)."""
+
+    http: TelemetryHTTPConfig = {}

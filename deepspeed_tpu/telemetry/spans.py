@@ -1,0 +1,140 @@
+"""Span recorder: wall-clock intervals → Chrome-trace JSON.
+
+The recorder is the single sink behind every existing timing call site:
+``SynchronizedWallClockTimer`` (fwd/bwd/step — wrapped via
+:class:`TracingTimers`), the comms ``timed_op`` wrapper (one span per
+collective) and the inference ``Tracer.record`` phases. Spans are complete
+``"ph": "X"`` events, so the export loads directly in ``chrome://tracing`` /
+Perfetto.
+
+Memory is bounded: a ring buffer drops the oldest spans past ``max_spans``.
+"""
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+def now_us():
+    """Monotonic microsecond timestamp shared by every span source (mixing
+    clocks would break trace-viewer ordering)."""
+    return int(time.perf_counter() * 1e6)
+
+
+@dataclass
+class Span:
+    name: str
+    cat: str
+    ts_us: int
+    dur_us: int
+    args: Optional[dict] = field(default=None)
+
+
+class SpanRecorder:
+
+    def __init__(self, max_spans=65536):
+        self._lock = threading.Lock()
+        self._spans = deque(maxlen=max_spans)
+        self.dropped = 0
+
+    def __len__(self):
+        return len(self._spans)
+
+    def record(self, name, cat="default", ts_us=None, dur_us=0, args=None):
+        span = Span(name, cat, now_us() if ts_us is None else int(ts_us),
+                    int(dur_us), args)
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self.dropped += 1
+            self._spans.append(span)
+
+    @contextmanager
+    def span(self, name, cat="default", args=None):
+        t0 = now_us()
+        try:
+            yield
+        finally:
+            self.record(name, cat, ts_us=t0, dur_us=now_us() - t0, args=args)
+
+    def clear(self):
+        with self._lock:
+            self._spans.clear()
+
+    # -------------------------------------------------------------- export --
+    def chrome_trace(self):
+        """Chrome-trace dict: complete ("X") events sorted by ts (viewers
+        require non-decreasing timestamps within a track)."""
+        pid = os.getpid()
+        with self._lock:
+            spans = sorted(self._spans, key=lambda s: s.ts_us)
+        events = []
+        for s in spans:
+            ev = {"name": s.name, "cat": s.cat, "ph": "X", "ts": s.ts_us,
+                  "dur": s.dur_us, "pid": pid, "tid": 0}
+            if s.args:
+                ev["args"] = s.args
+            events.append(ev)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(self, path):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+
+class TracingTimers:
+    """Timers-protocol wrapper: delegates to an inner
+    :class:`SynchronizedWallClockTimer` and additionally records one span per
+    start/stop pair, so the engine's existing fwd/bwd/step timer call sites
+    feed the trace unchanged."""
+
+    class _TracingTimer:
+
+        def __init__(self, inner, name, recorder):
+            self._inner = inner
+            self._name = name
+            self._recorder = recorder
+            self._t0 = None
+
+        def start(self):
+            self._inner.start()
+            self._t0 = now_us()
+
+        def stop(self, **kwargs):
+            self._inner.stop(**kwargs)
+            if self._t0 is not None:
+                self._recorder.record(self._name, cat="engine", ts_us=self._t0,
+                                      dur_us=now_us() - self._t0)
+                self._t0 = None
+
+        def reset(self):
+            self._inner.reset()
+
+        def elapsed(self, **kwargs):
+            return self._inner.elapsed(**kwargs)
+
+        def mean(self):
+            return self._inner.mean()
+
+    def __init__(self, inner_timers, recorder):
+        self._inner = inner_timers
+        self._recorder = recorder
+        self._wrapped = {}
+
+    def __call__(self, name):
+        if name not in self._wrapped:
+            self._wrapped[name] = self._TracingTimer(self._inner(name), name, self._recorder)
+        return self._wrapped[name]
+
+    def get_timers(self):
+        return self._inner.get_timers()
+
+    def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
+        self._inner.log(names, normalizer=normalizer, reset=reset,
+                        memory_breakdown=memory_breakdown, ranks=ranks)

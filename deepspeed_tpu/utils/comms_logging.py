@@ -97,7 +97,64 @@ class CommsLogger:
                 lines.append(f"{'':<20}{convert_size(msg_size):<20}{count:<10}"
                              f"{sum(latencies):<20.2f}{mean(latencies):<20.2f}"
                              f"{mean(algbws)*8:<20.2f}{mean(busbws)*8:<20.2f}")
+        if show_straggler:
+            lines.append("")
+            lines.extend(self._straggler_summary())
         out = "\n".join(lines)
         if print_log:
             logger.info("\n" + out)
         return out
+
+    def _straggler_summary(self):
+        """Per-op straggler effect (reference: ``dist.log_summary``'s straggler
+        mode). Multi-process, latencies are gathered across ranks and the
+        straggler is the slowest rank's mean vs the fleet mean; single-process
+        it degrades to max-vs-mean across this process's records.
+
+        COLLECTIVE when multi-process (exactly like the reference's
+        ``log_summary``): every process must call it — do NOT guard the call
+        with ``if rank == 0`` or the allgather deadlocks; gate the *printing*
+        instead (``log_all(print_log=(rank == 0), ...)``)."""
+        from numpy import mean
+        lines = [f"{'Straggler summary':<20}",
+                 f"{'Comm. Op':<20}{'Count':<10}{'Mean Lat(ms)':<16}"
+                 f"{'Max Lat(ms)':<16}{'Straggler(ms)':<16}"]
+        cross = self._cross_process_stats()
+        for record_name, sizes in self.comms_dict.items():
+            lats = [lat for vals in sizes.values() for lat in vals[1]]
+            if not lats:
+                continue
+            local_mean, local_max = float(mean(lats)), float(max(lats))
+            if cross is not None and record_name in cross:
+                g_mean, g_max = cross[record_name]
+                straggler = g_max - g_mean
+                local_mean, local_max = g_mean, g_max
+            else:
+                straggler = local_max - local_mean
+            lines.append(f"{record_name:<20}{len(lats):<10}{local_mean:<16.2f}"
+                         f"{local_max:<16.2f}{straggler:<16.2f}")
+        return lines
+
+    def _cross_process_stats(self):
+        """{op: (fleet mean-of-rank-means, slowest rank mean)} in ms when
+        ``deepspeed_tpu.comm`` is initialized multi-process, else None. Every
+        rank records the same op set under SPMD, so the allgather is aligned."""
+        try:
+            import jax
+            from deepspeed_tpu import comm as dist
+            if not dist.is_initialized() or jax.process_count() <= 1:
+                return None
+            import numpy as np
+            from jax.experimental import multihost_utils
+            ops = sorted(self.comms_dict.keys())
+            if not ops:
+                return None
+            means = np.array([
+                np.mean([lat for vals in self.comms_dict[op].values() for lat in vals[1]] or [0.0])
+                for op in ops
+            ], np.float32)
+            gathered = np.asarray(multihost_utils.process_allgather(means))  # [P, n_ops]
+            return {op: (float(gathered[:, i].mean()), float(gathered[:, i].max()))
+                    for i, op in enumerate(ops)}
+        except Exception:
+            return None

@@ -1,0 +1,26 @@
+"""Version compatibility shims for the JAX API surface.
+
+The codebase targets the modern ``jax.shard_map`` entry point (jax >= 0.7,
+``check_vma``); older jaxlibs ship it as
+``jax.experimental.shard_map.shard_map`` with the ``check_rep`` spelling of
+the same knob. Every shard_map call site routes through here so the
+supported-version window is one function wide.
+"""
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _resolve_shard_map():
+    import jax
+    try:
+        return jax.shard_map, "check_vma"
+    except AttributeError:  # jax < 0.6: the deprecation module raises on getattr
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm, "check_rep"
+
+
+def shard_map(fn, mesh, in_specs, out_specs, check_vma=False):
+    impl, check_kwarg = _resolve_shard_map()
+    return impl(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                **{check_kwarg: check_vma})

@@ -170,9 +170,6 @@ class ThroughputTimer:
         self.epoch_count += 1
         self.micro_step_count = 0
 
-    def _init_timer(self):
-        self.initialized = True
-
     def start(self):
         if not self.config.enabled:
             return
@@ -198,10 +195,15 @@ class ThroughputTimer:
 
         if global_step and report_speed and self.global_step_count >= self.start_step:
             if self.steps_per_output and self.global_step_count % self.steps_per_output == 0:
-                self.logging(f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
-                             f"global_step={self.global_step_count}, "
-                             f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.3f}, "
-                             f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.3f}")
+                msg = (f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                       f"global_step={self.global_step_count}, "
+                       f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.3f}, "
+                       f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.3f}")
+                if self.monitor_memory:
+                    # reference ThroughputTimer monitor_memory: device memory
+                    # appended on report steps
+                    msg += f", {SynchronizedWallClockTimer.memory_usage()}"
+                self.logging(msg)
         if global_step:
             self.step_elapsed_time = 0
 

@@ -38,8 +38,12 @@ def main():
             max_context=128, max_ragged_batch_size=256, max_ragged_sequence_count=8),
         kv_block_size=16,
         # int4 at-rest weights (ZeRO-Inference): halve again with bits=4
-        weight_quantization={"enabled": True, "bits": 8})
+        weight_quantization={"enabled": True, "bits": 8},
+        # serving telemetry: batch/token/KV gauges on a scrapeable endpoint
+        # (ephemeral port; curl <metrics_url> or bin/dstpu_report --metrics-url)
+        telemetry={"enabled": True, "http": {"enabled": True, "port": 0}})
     engine = build_engine(params, cfg, engine_config)
+    print(f"metrics endpoint: {engine.metrics_url}")
 
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, cfg.vocab_size, n) for n in (24, 9, 40)]
@@ -59,11 +63,19 @@ def main():
     # inference-checkpoint round-trip
     d = tempfile.mkdtemp()
     engine.serialize(d)
-    rebuilt = build_hf_engine(d, engine_config)  # auto-detects the DS checkpoint
+    from deepspeed_tpu.telemetry import TelemetryConfig
+    rebuilt = build_hf_engine(  # auto-detects the DS checkpoint; keep the one
+        d, engine_config.model_copy(update={"telemetry": TelemetryConfig()}))
     np.testing.assert_allclose(np.asarray(rebuilt.put([0], [prompts[1]])),
                                np.asarray(engine.put([9], [prompts[1]])),
                                rtol=1e-4, atol=1e-4)
     print("serialize round-trip OK")
+    import urllib.request
+    with urllib.request.urlopen(engine.metrics_url, timeout=5) as resp:
+        body = resp.read().decode()
+    assert "inference_batches_total" in body and "inference_tokens_total" in body
+    print("metrics scrape OK")
+    engine.close()
     print("OK")
 
 

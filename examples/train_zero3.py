@@ -41,23 +41,40 @@ def main():
     y = (x[:, 0] * 0.5 - x[:, 1]).astype(np.float32)
     params = model.init(jax.random.PRNGKey(0), (jnp.asarray(x), jnp.asarray(y)))["params"]
 
+    config = {
+        "train_micro_batch_size_per_gpu": 32,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 3,
+                              "zero_quantized_weights": True,   # qwZ: s8 gathers
+                              "stage3_param_persistence_threshold": 0},
+    }
+    # DSTPU_TELEMETRY_DIR=<dir>: unified telemetry — JSONL metrics stream +
+    # Chrome trace (open telemetry.trace.json in chrome://tracing / Perfetto)
+    tel_dir = os.environ.get("DSTPU_TELEMETRY_DIR")
+    if tel_dir:
+        config["telemetry"] = {"enabled": True,
+                               "jsonl_path": os.path.join(tel_dir, "telemetry.jsonl"),
+                               "trace_path": os.path.join(tel_dir, "telemetry.trace.json")}
+
     engine, optimizer, _, _ = deepspeed_tpu.initialize(
-        model=model, model_parameters=params,
-        config={
-            "train_micro_batch_size_per_gpu": 32,
-            "gradient_accumulation_steps": 2,
-            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
-            "bf16": {"enabled": True},
-            "zero_optimization": {"stage": 3,
-                                  "zero_quantized_weights": True,   # qwZ: s8 gathers
-                                  "stage3_param_persistence_threshold": 0},
-        })
+        model=model, model_parameters=params, config=config)
     assert isinstance(optimizer, deepspeed_tpu.ZeROOptimizer)
 
     for step in range(20):
         loss = engine.train_batch(batch=(np.tile(x, (2, 1)), np.tile(y, 2)))
         if step % 5 == 0:
             print(f"step {step:3d}  loss {float(loss):.4f}  lr {engine.get_lr()[0]:.2e}")
+
+    if tel_dir:
+        # micro-loop steps so the trace carries fwd/bwd/step spans, plus one
+        # profiled eager collective for a comm span + latency/bytes histograms
+        for _ in range(2):
+            loss = engine.forward((x, y))
+            engine.backward(loss)
+            engine.step()
+        deepspeed_tpu.comm.all_reduce(np.ones((8, 32), np.float32))
 
     # checkpoint + RLHF-style surgery on the sharded master
     import tempfile
@@ -66,6 +83,7 @@ def main():
     from deepspeed_tpu.utils import safe_get_full_fp32_param
     w = safe_get_full_fp32_param(engine, "Dense_0/kernel")
     print(f"checkpoint saved; Dense_0/kernel gathered shape {w.shape}")
+    engine.destroy()  # flushes the telemetry trace/JSONL when enabled
     print("OK")
 
 

@@ -1,0 +1,123 @@
+"""Tracer summarization edge cases, ring-buffer bounds and span export."""
+
+import json
+
+from deepspeed_tpu.inference.v2 import tracer as tracer_mod
+from deepspeed_tpu.inference.v2.tracer import RECORD_NAMES, Tracer
+from deepspeed_tpu.telemetry import SpanRecorder
+
+
+class _Seq:
+
+    def __init__(self, seen, in_flight):
+        self.seen_tokens = seen
+        self.in_flight_tokens = in_flight
+
+
+def test_summarize_empty_run_batch():
+    tr = Tracer()
+    tr.init_batch(is_empty_run=True, num_layers=2)
+    (summary, ) = list(tr.batch_summaries())
+    assert summary.is_empty_run is True
+    assert summary.embed == 0 and summary.unembed == 0
+    assert summary.record_exec_times == [[0] * len(RECORD_NAMES)] * 2
+
+
+def test_summarize_missing_embed_unembed_markers():
+    tr = Tracer()
+    tr.init_batch(is_empty_run=False, num_layers=2)
+    tr.add_sequence(_Seq(4, 1))
+    # no embed/unembed phases recorded at all: layer phases must not be
+    # misattributed to them
+    for _ in range(2):
+        tr.add_trace("attn", 10)
+        tr.add_trace("ffn", 20)
+    (summary, ) = list(tr.batch_summaries())
+    assert summary.embed == 0 and summary.unembed == 0
+    attn = RECORD_NAMES.index("attn")
+    ffn = RECORD_NAMES.index("ffn")
+    assert [row[attn] for row in summary.record_exec_times] == [10, 10]
+    assert [row[ffn] for row in summary.record_exec_times] == [20, 20]
+
+
+def test_summarize_with_markers():
+    tr = Tracer()
+    tr.init_batch(is_empty_run=False, num_layers=1)
+    tr.add_trace("embed", 5)
+    tr.add_trace("attn", 10)
+    tr.add_trace("ffn", 20)
+    tr.add_trace("unembed", 7)
+    (summary, ) = list(tr.batch_summaries())
+    assert summary.embed == 5 and summary.unembed == 7
+    assert summary.record_exec_times[0][RECORD_NAMES.index("attn")] == 10
+
+
+def test_summarize_layer_count_mismatch_does_not_crash():
+    tr = Tracer()
+    # claims 3 layers but records phases for 2: summaries stay well-formed
+    tr.init_batch(is_empty_run=False, num_layers=3)
+    tr.add_trace("attn", 10)
+    tr.add_trace("attn", 11)
+    (summary, ) = list(tr.batch_summaries())
+    assert summary.num_layers == 3
+    assert len(summary.record_exec_times) == 3
+    assert all(len(row) == len(RECORD_NAMES) for row in summary.record_exec_times)
+
+
+def test_ring_buffer_bounds_memory():
+    tr = Tracer(max_batches=4)
+    for _ in range(10):
+        tr.init_batch(is_empty_run=False, num_layers=1)
+        tr.add_trace("attn", 1)
+    assert tr.pending_batches == 4
+    assert [s.batch_id for s in tr.batch_summaries()] == [6, 7, 8, 9]
+
+
+def test_drain_summaries_frees_consumed_traces():
+    tr = Tracer(max_batches=8)
+    for _ in range(3):
+        tr.init_batch(is_empty_run=False, num_layers=1)
+        tr.add_trace("attn", 1)
+    drained = tr.drain_summaries()
+    assert [s.batch_id for s in drained] == [0, 1, 2]
+    assert tr.pending_batches == 0
+    assert tr.drain_summaries() == []
+    # the drained current batch must not resurrect through add_trace
+    tr.add_trace("attn", 1)
+    assert tr.pending_batches == 0
+    # and tracing continues cleanly afterwards
+    tr.init_batch(is_empty_run=False, num_layers=1)
+    tr.add_trace("attn", 2)
+    assert [s.batch_id for s in tr.drain_summaries()] == [3]
+
+
+def test_record_context_manager_emits_spans(tmp_path):
+    rec = SpanRecorder()
+    tr = Tracer(span_recorder=rec)
+    tracer_mod.set_tracer(tr)
+    try:
+        tr.init_batch(is_empty_run=False, num_layers=1)
+        with tracer_mod.record("attn"):
+            pass
+        with tracer_mod.record("ffn"):
+            pass
+    finally:
+        tracer_mod.set_tracer(None)
+
+    path = rec.export_chrome_trace(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        trace = json.load(f)  # valid JSON
+    evs = trace["traceEvents"]
+    assert [e["name"] for e in evs] == ["attn", "ffn"]
+    assert all(e["ph"] == "X" and e["cat"] == "inference" for e in evs)
+    assert [e["ts"] for e in evs] == sorted(e["ts"] for e in evs)
+    assert all(e["args"]["batch_id"] == 0 for e in evs)
+    # the tracer's own trace list recorded the same phases
+    (summary, ) = list(tr.batch_summaries())
+    assert summary.record_exec_times[0][RECORD_NAMES.index("attn")] >= 0
+
+
+def test_record_noop_without_tracer():
+    tracer_mod.set_tracer(None)
+    with tracer_mod.record("attn"):
+        pass  # must not raise

@@ -1,0 +1,67 @@
+"""Monitor backends: csv round-trip, JSONL backend, MonitorMaster enablement.
+
+Reference coverage model: ``tests/unit/monitor/test_monitor.py`` (the reference
+repo tests each writer and the master's fan-out)."""
+
+import csv
+import json
+import os
+
+from deepspeed_tpu.monitor.config import (CSVConfig, DeepSpeedMonitorConfig, JSONLConfig)
+from deepspeed_tpu.monitor.monitor import JSONLMonitor, MonitorMaster, csvMonitor
+
+
+def test_csv_monitor_round_trip(tmp_path):
+    mon = csvMonitor(CSVConfig(enabled=True, output_path=str(tmp_path), job_name="job"))
+    mon.write_events([("Train/Samples/train_loss", 0.5, 1)])
+    mon.write_events([("Train/Samples/train_loss", 0.25, 2)])
+
+    fname = os.path.join(str(tmp_path), "job", "Train_Samples_train_loss.csv")
+    with open(fname) as f:
+        rows = list(csv.reader(f))
+    # header written exactly once, values appended
+    assert rows[0] == ["step", "Train/Samples/train_loss"]
+    assert rows[1:] == [["1", "0.5"], ["2", "0.25"]]
+
+
+def test_csv_monitor_disabled_writes_nothing(tmp_path):
+    mon = csvMonitor(CSVConfig(enabled=False, output_path=str(tmp_path), job_name="job"))
+    mon.write_events([("tag", 1.0, 1)])
+    assert not os.path.exists(os.path.join(str(tmp_path), "job"))
+
+
+def test_jsonl_monitor_appends_schema_lines(tmp_path):
+    mon = JSONLMonitor(JSONLConfig(enabled=True, output_path=str(tmp_path), job_name="run"))
+    mon.write_events([("Train/Samples/lr", 1e-3, 8), ("Train/Samples/train_loss", 0.7, 8)])
+    mon.write_events([("Train/Samples/lr", 5e-4, 16)])
+
+    lines = [json.loads(line) for line in
+             open(os.path.join(str(tmp_path), "run.jsonl")).read().splitlines()]
+    assert len(lines) == 3
+    assert lines[0] == {"tag": "Train/Samples/lr", "value": 1e-3, "step": 8,
+                        "ts": lines[0]["ts"]}
+    assert {"tag", "value", "step", "ts"} <= set(lines[2])
+    assert lines[2]["step"] == 16
+
+
+def test_monitor_master_enablement(tmp_path):
+    # everything off → master disabled, write_events a no-op
+    master = MonitorMaster(DeepSpeedMonitorConfig())
+    assert master.enabled is False
+    master.write_events([("tag", 1.0, 1)])
+
+    # one backend on → master enabled, events fan out to it (and only it)
+    cfg = DeepSpeedMonitorConfig(jsonl=JSONLConfig(enabled=True, output_path=str(tmp_path),
+                                                   job_name="fanout"))
+    master = MonitorMaster(cfg)
+    assert master.enabled is True
+    assert master.jsonl_monitor.enabled and not master.csv_monitor.enabled
+    master.write_events([("tag", 2.0, 3)])
+    (line, ) = open(os.path.join(str(tmp_path), "fanout.jsonl")).read().splitlines()
+    assert json.loads(line)["value"] == 2.0
+
+
+def test_monitor_config_enabled_property():
+    assert DeepSpeedMonitorConfig().enabled is False
+    assert DeepSpeedMonitorConfig(jsonl={"enabled": True}).enabled is True
+    assert DeepSpeedMonitorConfig(csv_monitor={"enabled": True}).enabled is True

@@ -1,0 +1,15 @@
+import pytest
+
+from deepspeed_tpu import telemetry
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    """Telemetry state is process-global: every test gets a clean slate and
+    leaves none behind (a leaked active session would silently instrument
+    unrelated tests' hot paths)."""
+    telemetry.shutdown()
+    telemetry.state.registry = None
+    yield
+    telemetry.shutdown()
+    telemetry.state.registry = None

@@ -1,0 +1,62 @@
+"""The stdlib HTTP exporter: /metrics, /healthz, /trace."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deepspeed_tpu.telemetry import (MetricsRegistry, SpanRecorder, parse_prometheus_text,
+                                     scrape_metrics, start_http_server)
+
+
+@pytest.fixture
+def server():
+    reg = MetricsRegistry()
+    reg.counter("hits_total", "hits").inc(3)
+    reg.gauge("free", "free").set(12)
+    spans = SpanRecorder()
+    spans.record("phase", cat="test", ts_us=1, dur_us=2)
+    srv = start_http_server(reg, spans=spans, host="127.0.0.1", port=0)
+    yield srv
+    srv.stop()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_metrics_endpoint(server):
+    status, body = _get(server.url + "/metrics")
+    assert status == 200
+    fams = parse_prometheus_text(body)
+    assert fams["hits_total"]["samples"][0][2] == 3.0
+    assert fams["free"]["samples"][0][2] == 12.0
+
+
+def test_healthz_endpoint(server):
+    status, body = _get(server.url + "/healthz")
+    assert status == 200
+    assert json.loads(body) == {"status": "ok"}
+
+
+def test_trace_endpoint_serves_chrome_trace(server):
+    status, body = _get(server.url + "/trace")
+    assert status == 200
+    trace = json.loads(body)
+    assert trace["traceEvents"][0]["name"] == "phase"
+
+
+def test_unknown_route_404(server):
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(server.url + "/nope")
+    assert exc.value.code == 404
+
+
+def test_scrape_metrics_helper(server):
+    host, port = server.address
+    # bare host:port → /metrics appended; http://... /metrics passthrough
+    for url in (f"{host}:{port}", server.url + "/metrics"):
+        fams = scrape_metrics(url)
+        assert fams["hits_total"]["samples"][0][2] == 3.0

@@ -1,0 +1,78 @@
+"""InferenceEngineV2 serving telemetry: /metrics + /healthz from config."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.engine_factory import build_engine
+from deepspeed_tpu.inference.v2.ragged.manager_configs import (AllocationMode, DSStateManagerConfig,
+                                                               MemoryConfig)
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+from deepspeed_tpu.telemetry import parse_prometheus_text
+
+
+@pytest.fixture(scope="module")
+def llama_setup():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = {"model": model.init(jax.random.PRNGKey(0), ids)["params"]}
+    return cfg, params
+
+
+def _serving_engine(params, cfg):
+    mgr = DSStateManagerConfig(memory_config=MemoryConfig(mode=AllocationMode.ALLOCATE, size=64),
+                               max_context=512)
+    engine_config = RaggedInferenceEngineConfig(
+        state_manager=mgr, kv_block_size=16,
+        telemetry={"enabled": True, "http": {"enabled": True, "port": 0}})
+    return build_engine(params, cfg, engine_config)
+
+
+def test_metrics_endpoint_reports_serving_gauges(llama_setup):
+    cfg, params = llama_setup
+    engine = _serving_engine(params, cfg)
+    try:
+        rng = np.random.default_rng(0)
+        engine.put([0, 1], [rng.integers(0, cfg.vocab_size, 9),
+                            rng.integers(0, cfg.vocab_size, 4)])
+
+        assert engine.metrics_url is not None
+        with urllib.request.urlopen(engine.metrics_url, timeout=5) as resp:
+            assert resp.status == 200
+            fams = parse_prometheus_text(resp.read().decode())
+        assert fams["inference_batches_total"]["samples"][0][2] == 1.0
+        assert fams["inference_tokens_total"]["samples"][0][2] == 13.0
+        assert fams["inference_in_flight_tokens"]["samples"][0][2] == 13.0
+        assert fams["inference_kv_free_blocks"]["samples"][0][2] > 0
+        assert fams["inference_tracked_sequences"]["samples"][0][2] == 2.0
+    finally:
+        engine.close()
+
+
+def test_healthz_returns_200(llama_setup):
+    cfg, params = llama_setup
+    engine = _serving_engine(params, cfg)
+    try:
+        base = engine.metrics_url.rsplit("/metrics", 1)[0]
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as resp:
+            assert resp.status == 200
+            assert json.loads(resp.read().decode()) == {"status": "ok"}
+    finally:
+        engine.close()
+
+
+def test_close_is_idempotent_and_stops_endpoint(llama_setup):
+    cfg, params = llama_setup
+    engine = _serving_engine(params, cfg)
+    url = engine.metrics_url
+    engine.close()
+    engine.close()
+    with pytest.raises(Exception):
+        urllib.request.urlopen(url, timeout=2)

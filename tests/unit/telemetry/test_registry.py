@@ -1,0 +1,102 @@
+"""MetricsRegistry primitives, Prometheus exposition and the JSONL sink."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.telemetry import MetricsRegistry, parse_prometheus_text
+
+
+def test_counter_gauge_histogram_values():
+    reg = MetricsRegistry()
+    c = reg.counter("req_total", "requests")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+
+    g = reg.gauge("inflight", "in flight")
+    g.set(7)
+    g.inc(2)
+    g.dec()
+    assert g.value == 8
+
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == pytest.approx(5.555)
+
+
+def test_get_or_create_is_idempotent_and_kind_checked():
+    reg = MetricsRegistry()
+    assert reg.counter("a") is reg.counter("a")
+    # same family, different labels → distinct instruments
+    assert reg.counter("b", labels={"op": "x"}) is not reg.counter("b", labels={"op": "y"})
+    with pytest.raises(ValueError):
+        reg.gauge("a")
+
+
+def test_histogram_family_shares_one_bucket_layout():
+    reg = MetricsRegistry()
+    h1 = reg.histogram("bytes", "b", labels={"op": "x"}, buckets=(10.0, 100.0))
+    # omitted buckets inherit the family's layout (not the latency defaults)
+    h2 = reg.histogram("bytes", "b", labels={"op": "y"})
+    assert h2.buckets == h1.buckets == (10.0, 100.0)
+    # a conflicting layout in the same family is rejected, not silently mixed
+    with pytest.raises(ValueError):
+        reg.histogram("bytes", "b", labels={"op": "z"}, buckets=(1.0, 2.0))
+    with pytest.raises(ValueError):
+        reg.histogram("bytes", "b", labels={"op": "x"}, buckets=(1.0, 2.0))
+    # re-request with the matching layout still returns the same instrument
+    assert reg.histogram("bytes", labels={"op": "x"}, buckets=(10.0, 100.0)) is h1
+
+
+def test_prometheus_render_parse_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("ops_total", "ops", labels={"op": "all_reduce"}).inc(3)
+    reg.gauge("free_blocks", "blocks").set(11)
+    h = reg.histogram("lat_seconds", "lat", buckets=(0.01, 1.0))
+    h.observe(0.002)
+    h.observe(0.5)
+    h.observe(100.0)
+
+    text = reg.render_prometheus()
+    fams = parse_prometheus_text(text)
+    assert fams["ops_total"]["type"] == "counter"
+    assert fams["ops_total"]["samples"] == [("ops_total", {"op": "all_reduce"}, 3.0)]
+    assert fams["free_blocks"]["samples"][0][2] == 11.0
+
+    hist = {(n, labels.get("le")): v for n, labels, v in fams["lat_seconds"]["samples"]}
+    # cumulative bucket semantics: le=0.01 → 1, le=1.0 → 2, +Inf → count=3
+    assert hist[("lat_seconds_bucket", "0.01")] == 1.0
+    assert hist[("lat_seconds_bucket", "1.0")] == 2.0
+    assert hist[("lat_seconds_bucket", "+Inf")] == 3.0
+    assert hist[("lat_seconds_count", None)] == 3.0
+    assert hist[("lat_seconds_sum", None)] == pytest.approx(100.502)
+
+
+def test_jsonl_event_sink(tmp_path):
+    reg = MetricsRegistry()
+    path = tmp_path / "events.jsonl"
+    reg.open_jsonl(str(path))
+    reg.event("train_step", step=1, loss=0.5)
+    reg.event("train_step", step=2, loss=0.25, lr=1e-3)
+    reg.close_jsonl()
+
+    lines = [json.loads(line) for line in path.read_text().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["event"] == "train_step" and lines[0]["loss"] == 0.5
+    assert lines[1]["step"] == 2 and lines[1]["lr"] == 1e-3
+    assert all("ts" in rec for rec in lines)
+
+
+def test_api_call_counting():
+    """The registry counts every telemetry API call — the probe the disabled-
+    hot-path test relies on."""
+    reg = MetricsRegistry()
+    assert reg.api_calls == 0
+    reg.counter("c").inc()
+    reg.gauge("g").set(1)
+    reg.histogram("h").observe(0.1)
+    reg.event("e")  # counted even with no sink attached
+    assert reg.api_calls == 4

@@ -1,6 +1,11 @@
 """The examples/ quickstarts must actually run (user-facing surface; each
-executes in its own process on the virtual CPU mesh and prints OK)."""
+executes in its own process on the virtual CPU mesh and prints OK).
 
+train_zero3.py additionally runs in telemetry mode (DSTPU_TELEMETRY_DIR): the
+run must leave a tail-able JSONL metrics stream and a loadable Chrome trace
+with fwd/bwd/step and collective spans — the ISSUE-2 acceptance path."""
+
+import json
 import os
 import subprocess
 import sys
@@ -10,13 +15,42 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-@pytest.mark.parametrize("script", ["train_zero3.py", "serve_v2.py", "autotune.py"])
-def test_example_runs(script):
+def _run_example(script, extra_env=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    env.update(extra_env or {})
     r = subprocess.run([sys.executable, os.path.join(REPO, "examples", script)],
                        capture_output=True, text=True, timeout=900, env=env,
                        cwd=REPO)
     assert r.returncode == 0, r.stderr[-800:]
     assert "OK" in r.stdout
+    return r
+
+
+@pytest.mark.parametrize("script", ["serve_v2.py", "autotune.py"])
+def test_example_runs(script):
+    _run_example(script)
+
+
+def test_train_zero3_with_telemetry(tmp_path):
+    _run_example("train_zero3.py", extra_env={"DSTPU_TELEMETRY_DIR": str(tmp_path)})
+
+    # JSONL metrics stream: per-step events carrying loss / lr / samples-per-sec
+    events = [json.loads(line)
+              for line in (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+    steps = [e for e in events if e["event"] == "train_step"]
+    assert len(steps) >= 20
+    assert all("loss" in e and "lr" in e for e in steps)
+    assert any("samples_per_sec" in e for e in steps)
+
+    # Chrome trace: valid JSON, monotonic ts, complete (X) events, and both
+    # the engine phases and a collective present
+    with open(tmp_path / "telemetry.trace.json") as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    names = {e["name"] for e in evs}
+    assert {"fwd_microstep", "bwd_microstep", "step_microstep",
+            "train_batch", "all_reduce"} <= names
+    assert [e["ts"] for e in evs] == sorted(e["ts"] for e in evs)
+    assert all(e["ph"] == "X" for e in evs)

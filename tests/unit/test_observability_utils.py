@@ -1,0 +1,87 @@
+"""CommsLogger straggler summary + ThroughputTimer satellite fixes."""
+
+import re
+
+from deepspeed_tpu.utils.comms_logging import CommsLogger
+from deepspeed_tpu.utils.timer import ThroughputTimer
+
+
+# ------------------------------------------------------------- comms straggler --
+def _logger_with_records():
+    cl = CommsLogger()
+    cl.configure(enabled=True, verbose=False)
+    # one fast + one straggling record for the same op/size
+    cl.append("all_reduce", "all_reduce", 0.001, 1024, n=8)
+    cl.append("all_reduce", "all_reduce", 0.009, 1024, n=8)
+    cl.append("broadcast", "broadcast", 0.002, 4096, n=8)
+    return cl
+
+
+def test_log_all_without_straggler_unchanged():
+    out = _logger_with_records().log_all(print_log=False, show_straggler=False)
+    assert "all_reduce" in out and "broadcast" in out
+    assert "Straggler" not in out
+
+
+def test_log_all_show_straggler_reports_max_vs_mean():
+    out = _logger_with_records().log_all(print_log=False, show_straggler=True)
+    assert "Straggler summary" in out
+    row = next(line for line in out.splitlines() if re.match(r"^all_reduce\s", line))
+    cols = row.split()
+    # count / mean(ms) / max(ms) / straggler(ms) with latencies 1ms and 9ms:
+    # mean 5, max 9, straggler effect 4
+    assert cols[1] == "2"
+    assert abs(float(cols[2]) - 5.0) < 1e-6
+    assert abs(float(cols[3]) - 9.0) < 1e-6
+    assert abs(float(cols[4]) - 4.0) < 1e-6
+    # single-record op: straggler collapses to zero, not an error
+    brow = next(line for line in out.splitlines() if re.match(r"^broadcast\s", line))
+    assert abs(float(brow.split()[4])) < 1e-6
+
+
+def test_show_straggler_with_no_records():
+    cl = CommsLogger()
+    out = cl.log_all(print_log=False, show_straggler=True)
+    assert "Straggler summary" in out  # header only, nothing to report
+
+
+# ------------------------------------------------------------ throughput timer --
+class _Cfg:
+    enabled = True
+
+
+def _run_steps(timer, n):
+    for _ in range(n):
+        timer.start()
+        timer.stop(global_step=True)
+
+
+def test_dead_init_timer_removed():
+    timer = ThroughputTimer(_Cfg(), batch_size=4)
+    assert not hasattr(timer, "_init_timer")
+    assert not hasattr(timer, "initialized")
+
+
+def test_monitor_memory_appends_device_memory_on_report_steps():
+    logged = []
+    timer = ThroughputTimer(_Cfg(), batch_size=4, start_step=1, steps_per_output=1,
+                            monitor_memory=True, logging_fn=logged.append)
+    _run_steps(timer, 3)
+    assert logged, "report steps must log"
+    assert all("Mem" in msg for msg in logged)
+
+
+def test_monitor_memory_off_keeps_plain_message():
+    logged = []
+    timer = ThroughputTimer(_Cfg(), batch_size=4, start_step=1, steps_per_output=1,
+                            monitor_memory=False, logging_fn=logged.append)
+    _run_steps(timer, 3)
+    assert logged and all("Mem" not in msg for msg in logged)
+    assert all("SamplesPerSec" in msg for msg in logged)
+
+
+def test_avg_samples_per_sec_counts_post_warmup_steps():
+    timer = ThroughputTimer(_Cfg(), batch_size=8, start_step=2)
+    _run_steps(timer, 4)
+    assert timer.avg_samples_per_sec() > 0
+    assert timer.global_step_count == 4
