@@ -24,7 +24,7 @@ from deepspeed_tpu.inference.v2.ragged.ragged_manager import DSStateManager
 from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import RaggedBatchWrapper
 from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import PlaceholderSequenceDescriptor
 from deepspeed_tpu.inference.v2.scheduling_utils import SchedulingError, SchedulingResult
-from deepspeed_tpu.inference.v2.tracer import Tracer, set_tracer
+from deepspeed_tpu.inference.v2.tracer import Tracer, get_tracer, set_tracer
 from deepspeed_tpu.telemetry import now_us as _tel_now_us
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.logging import logger
@@ -71,6 +71,10 @@ class InferenceEngineV2:
                 "empty_runs": reg.counter("inference_empty_runs_total",
                                           "EP lock-step forwards with zero tokens"),
             }
+
+        # a ServingScheduler attaches here (serving/scheduler.py); close()
+        # stops it so the engine can always be torn down safely
+        self._serving_scheduler = None
 
         if engine_config.trace_enabled:
             self._tracer = Tracer(max_batches=engine_config.max_trace_batches,
@@ -138,12 +142,25 @@ class InferenceEngineV2:
         return self._telemetry
 
     @property
+    def serving_scheduler(self):
+        """The attached :class:`ServingScheduler` (None when not serving)."""
+        return self._serving_scheduler
+
+    @property
     def metrics_url(self) -> Optional[str]:
         """The served ``/metrics`` URL (None unless ``telemetry.http.enabled``)."""
         return self._telemetry.metrics_url if self._telemetry is not None else None
 
     def close(self) -> None:
-        """Stop the telemetry endpoint and flush sinks (idempotent)."""
+        """Tear the engine down (idempotent): stop an attached serving
+        scheduler, deregister this engine's tracer from the module-global slot
+        (so tracer state cannot leak into the next engine in this process),
+        and stop the telemetry endpoint / flush sinks."""
+        if self._serving_scheduler is not None:
+            self._serving_scheduler.stop(drain=False)
+            self._serving_scheduler = None
+        if self._tracer is not None and get_tracer() is self._tracer:
+            set_tracer(None)
         if self._telemetry is not None:
             self._telemetry.close()
             self._telemetry = None

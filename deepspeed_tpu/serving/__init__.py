@@ -1,0 +1,32 @@
+"""Serving layer: persistent request-lifecycle subsystem over InferenceEngineV2.
+
+Reference: DeepSpeed-FastGen/MII's persistent deployment (Holmes et al. 2024)
+— continuous admission, Dynamic SplitFuse chunked-prefill/decode interleaving
+(iteration-level scheduling per Orca, Yu et al. OSDI'22), per-request token
+streaming, deadlines, and backpressure.
+
+Usage::
+
+    from deepspeed_tpu.serving import ServingConfig, ServingScheduler, ServingServer
+
+    scheduler = ServingScheduler(engine, ServingConfig(decode_chunk=4))
+    req = scheduler.submit(prompt_tokens, max_new_tokens=64, deadline_s=2.0)
+    for token in req.stream:          # streams as the scheduler generates
+        ...
+    server = ServingServer(scheduler).start()   # POST /v1/generate (SSE), GET /v1/stats
+    server.stop()                               # graceful drain
+"""
+
+from deepspeed_tpu.serving.config import ServingConfig
+from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.request import (Request, RequestState, TERMINAL_STATES,
+                                           TokenStream)
+from deepspeed_tpu.serving.scheduler import (QueueFullError, SchedulerStopped,
+                                             ServingScheduler)
+from deepspeed_tpu.serving.server import ServingServer
+
+__all__ = [
+    "ServingConfig", "ServingMetrics", "Request", "RequestState", "TERMINAL_STATES",
+    "TokenStream", "ServingScheduler", "QueueFullError", "SchedulerStopped",
+    "ServingServer",
+]

@@ -1,0 +1,70 @@
+"""Serving config block.
+
+Reference role: DeepSpeed-MII's deployment/``RaggedInferenceEngineConfig``
+knobs for the persistent server (queue sizing, response behavior under load);
+validated pydantic-style like the other config blocks (``config_v2.py``,
+``telemetry/config.py``).
+"""
+
+from typing import Literal, Optional
+
+from pydantic import Field, field_validator
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class ServingConfig(DeepSpeedConfigModel):
+    """Knobs for the request scheduler + HTTP front-end."""
+
+    queue_capacity: int = Field(128, ge=1)
+    """Maximum QUEUED (admitted-but-unscheduled) requests; beyond it the
+    backpressure policy applies."""
+
+    backpressure: Literal["reject", "block"] = "reject"
+    """Queue-full behavior: ``reject`` fails ``submit()`` immediately (HTTP
+    429); ``block`` stalls the submitting thread until space frees (the
+    closed-loop client pattern)."""
+
+    default_max_new_tokens: int = Field(64, ge=1)
+    """Per-request cap when the request doesn't specify one."""
+
+    default_deadline_s: Optional[float] = Field(None, gt=0)
+    """Deadline applied to requests that don't carry their own; None = no
+    deadline (requests are bounded by max_new_tokens only)."""
+
+    drain_timeout_s: float = Field(30.0, ge=0)
+    """Graceful-shutdown budget: how long ``stop(drain=True)`` lets in-flight
+    requests finish before cancelling the remainder."""
+
+    scheduler_tick_s: float = Field(0.001, gt=0)
+    """Idle sleep between scheduler iterations when there is no work; busy
+    iterations run back-to-back."""
+
+    decode_chunk: int = Field(1, ge=1)
+    """Decode steps per device dispatch on the decode-only fast path
+    (``engine.decode_loop``); >1 trades up-to-(K-1)-token speculative
+    over-generation for one host round-trip per K tokens."""
+
+    max_prefill_chunk: Optional[int] = Field(None, ge=1)
+    """Cap on prompt tokens admitted per batch per request (Dynamic SplitFuse
+    chunk size); None = bounded only by the engine's ragged token budget."""
+
+    heartbeat_interval_s: float = Field(0.05, ge=0)
+    """How often an *idle* scheduler runs ``engine.empty_run()`` so EP
+    replicas stay in collective lock-step. 0 = every idle tick."""
+
+    heartbeat_enabled: Optional[bool] = None
+    """None = auto (heartbeat only when the engine has expert parallelism
+    enabled); True/False force it."""
+
+    host: str = "127.0.0.1"
+    port: int = Field(0, ge=0, le=65535)
+    """Bind address for ``ServingServer``; port 0 = ephemeral (the bound
+    address is on ``server.address`` after ``start()``)."""
+
+    @field_validator("default_deadline_s")
+    @classmethod
+    def _deadline_finite(cls, v):
+        if v is not None and not (v > 0 and v == v):  # rejects NaN too
+            raise ValueError("default_deadline_s must be a positive number")
+        return v

@@ -1,0 +1,52 @@
+"""Serving telemetry on the unified registry (``deepspeed_tpu/telemetry``).
+
+Zero-cost-when-disabled contract: ``ServingMetrics.maybe_create()`` returns
+None unless a telemetry session is active, and every scheduler call site is
+guarded by that None check — the disabled hot path performs no registry work
+(the same unit-enforceable guarantee the engine and comm layers give).
+"""
+
+from typing import Optional
+
+# TTFT/e2e live in the default latency decades; inter-token latency needs the
+# sub-millisecond end emphasized (a fast decode step is ~100us-10ms)
+_ITL_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                0.5, 1.0, 2.5)
+
+
+class ServingMetrics:
+    """The serving-layer metric family; one instance per scheduler."""
+
+    def __init__(self, registry):
+        self.queue_depth = registry.gauge(
+            "serving_queue_depth", "Requests waiting for admission")
+        self.in_flight = registry.gauge(
+            "serving_in_flight_requests", "Requests in PREFILL or DECODE")
+        self.ttft = registry.histogram(
+            "serving_ttft_seconds", "Submission to first generated token")
+        self.itl = registry.histogram(
+            "serving_inter_token_seconds", "Gap between consecutive streamed tokens",
+            buckets=_ITL_BUCKETS)
+        self.e2e = registry.histogram(
+            "serving_e2e_latency_seconds", "Submission to terminal state")
+        self.admissions = registry.counter(
+            "serving_admissions_total", "Requests accepted into the queue")
+        self.rejections = registry.counter(
+            "serving_rejections_total", "Requests rejected by backpressure")
+        self.completions = registry.counter(
+            "serving_completions_total", "Requests finished DONE")
+        self.timeouts = registry.counter(
+            "serving_timeouts_total", "Requests that hit their deadline")
+        self.cancellations = registry.counter(
+            "serving_cancellations_total", "Requests cancelled mid-flight")
+        self.failures = registry.counter(
+            "serving_failures_total", "Requests that FAILED")
+        self.evictions = registry.counter(
+            "serving_kv_evictions_total", "Idle sequences offloaded under KV pressure")
+
+    @classmethod
+    def maybe_create(cls) -> Optional["ServingMetrics"]:
+        from deepspeed_tpu import telemetry
+        if not telemetry.is_active():
+            return None
+        return cls(telemetry.get_registry())

@@ -1,0 +1,197 @@
+"""Request lifecycle primitives for the serving layer.
+
+Reference role: DeepSpeed-MII's ``RaggedRequest``/``RaggedRequestMsg`` (the
+request objects FastGen's persistent deployment schedules); here the request
+additionally owns a thread-safe streaming output channel so time-to-first-token
+is a real, observable event — the scheduler thread pushes tokens as they are
+sampled and any number of consumer threads (an SSE handler, ``generate()``)
+iterate them live.
+
+State machine::
+
+    QUEUED -> PREFILL -> DECODE -> DONE
+       \\         \\         \\---> CANCELLED | FAILED | TIMED_OUT
+        \\         \\--------------^
+         \\------------------------^
+
+Terminal transitions happen on the scheduler thread only (engine state — KV
+blocks, sequence descriptors — is freed there); ``cancel()`` from any thread
+just raises a flag the scheduler honors on its next tick.
+"""
+
+import queue
+import threading
+import time
+from enum import Enum
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+
+class RequestState(Enum):
+    QUEUED = 0
+    PREFILL = 1
+    DECODE = 2
+    DONE = 3
+    CANCELLED = 4
+    FAILED = 5
+    TIMED_OUT = 6
+
+
+TERMINAL_STATES = frozenset(
+    {RequestState.DONE, RequestState.CANCELLED, RequestState.FAILED, RequestState.TIMED_OUT})
+
+_END = object()
+
+
+class TokenStream:
+    """Thread-safe single-producer token channel: the scheduler ``put()``s,
+    consumers iterate (blocking) or poll ``get(timeout)``. Closing wakes every
+    consumer; iteration then stops."""
+
+    def __init__(self):
+        self._q = queue.SimpleQueue()
+        self._closed = threading.Event()
+
+    def put(self, token: int) -> None:
+        self._q.put(token)
+
+    def close(self) -> None:
+        if not self._closed.is_set():
+            self._closed.set()
+            self._q.put(_END)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+    def get(self, timeout: Optional[float] = None) -> Optional[int]:
+        """Next token, or None once the stream is closed and drained.
+        Raises ``queue.Empty`` on timeout."""
+        item = self._q.get(timeout=timeout)
+        if item is _END:
+            self._q.put(_END)  # keep the sentinel for other/later consumers
+            return None
+        return item
+
+    def __iter__(self) -> Iterator[int]:
+        while True:
+            item = self._q.get()
+            if item is _END:
+                self._q.put(_END)
+                return
+            yield item
+
+
+class Request:
+    """One generation request: prompt in, token stream out.
+
+    ``deadline_s`` is a *relative* budget from submission; the scheduler
+    enforces the absolute ``deadline`` (monotonic clock) at every tick and
+    mid-decode. ``max_new_tokens``/``eos_token_id``/``temperature``/``seed``
+    are per-request sampling parameters (the seed feeds a private numpy
+    stream so concurrent requests sample independently).
+    """
+
+    def __init__(self,
+                 prompt,
+                 max_new_tokens: int = 64,
+                 temperature: float = 0.0,
+                 eos_token_id: Optional[int] = None,
+                 deadline_s: Optional[float] = None,
+                 seed: int = 0):
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("prompt must contain at least one token")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.eos_token_id = eos_token_id
+        self.deadline_s = deadline_s
+        self.seed = int(seed)
+
+        self.uid: Optional[int] = None  # assigned at admission by the scheduler
+        self.tokens: List[int] = []
+        self.stream = TokenStream()
+        self.error: Optional[str] = None
+        self.finish_reason: Optional[str] = None  # "eos" | "length" | "context"
+
+        self.arrival_s = time.monotonic()
+        self.deadline = (self.arrival_s + deadline_s) if deadline_s is not None else None
+        self.first_token_s: Optional[float] = None
+        self.finished_s: Optional[float] = None
+
+        self._state = RequestState.QUEUED
+        self._state_lock = threading.Lock()
+        self._done = threading.Event()
+        self._cancel_requested = threading.Event()
+
+        # scheduler-private bookkeeping (touched on the scheduler thread only)
+        self._fed = 0                 # prompt tokens already put() into the engine
+        self._next: Optional[int] = None  # next decode input token
+        self._deferred = 0            # consecutive ticks skipped under pressure
+        self._last_touch_s = self.arrival_s  # eviction coldness ordering
+        self._last_token_s: Optional[float] = None  # ITL measurement
+        self._rng: Optional[np.random.Generator] = None
+
+    # ----------------------------------------------------------------- state --
+    @property
+    def state(self) -> RequestState:
+        return self._state
+
+    @property
+    def finished(self) -> bool:
+        return self._state in TERMINAL_STATES
+
+    def _set_state(self, state: RequestState) -> None:
+        with self._state_lock:
+            if self._state in TERMINAL_STATES:
+                return  # terminal states are sticky
+            self._state = state
+            if state in TERMINAL_STATES:
+                self.finished_s = time.monotonic()
+                self.stream.close()
+                self._done.set()
+
+    def cancel(self) -> None:
+        """Request cancellation (any thread); the scheduler finalizes — frees
+        the sequence's KV blocks — on its next tick."""
+        self._cancel_requested.set()
+
+    @property
+    def cancel_requested(self) -> bool:
+        return self._cancel_requested.is_set()
+
+    # ----------------------------------------------------------------- waits --
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request reaches a terminal state."""
+        return self._done.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block for completion and return the generated tokens. FAILED raises
+        (the scheduler's error message); CANCELLED/TIMED_OUT return the tokens
+        produced before the cut — the caller can inspect ``state``."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.uid} not finished within {timeout}s")
+        if self._state is RequestState.FAILED:
+            raise RuntimeError(self.error or "request failed")
+        return list(self.tokens)
+
+    # ----------------------------------------------------------------- stats --
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_s is None:
+            return None
+        return self.first_token_s - self.arrival_s
+
+    @property
+    def e2e_s(self) -> Optional[float]:
+        if self.finished_s is None:
+            return None
+        return self.finished_s - self.arrival_s
+
+    def __repr__(self):
+        return (f"Request(uid={self.uid}, state={self._state.name}, "
+                f"prompt={self.prompt.size}t, generated={len(self.tokens)}t)")

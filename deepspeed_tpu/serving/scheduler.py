@@ -1,0 +1,523 @@
+"""Async continuous-batching request scheduler (Dynamic SplitFuse).
+
+Reference: DeepSpeed-FastGen's persistent serving loop (Holmes et al. 2024 —
+MII ``RaggedBatchBase.schedule_requests``) and Orca-style iteration-level
+scheduling (Yu et al., OSDI'22): requests are admitted continuously, every
+engine iteration re-composes the ragged batch from in-flight decodes plus
+prompt *chunks* under the token budget, and finished sequences leave the batch
+the moment they finish.
+
+The scheduler is the only thing that touches the engine once started —
+``InferenceEngineV2`` is not thread-safe, so cancellation, deadline expiry and
+shutdown are flags honored at tick boundaries on the scheduler thread, where
+KV blocks can be freed safely.
+
+Batch composition per tick (``step()``):
+
+1. finalize cancelled / past-deadline requests (flush their KV blocks);
+2. admit QUEUED requests (permanently-infeasible ones FAIL immediately);
+3. decode tokens first (latency-critical, one token each), then prompt chunks
+   fill the remaining ``max_ragged_batch_size`` budget — Dynamic SplitFuse;
+4. under KV pressure: shrink the prompt chunk (halving), then evict the
+   coldest idle sequence via ``engine.offload_sequence`` (restore-on-touch is
+   transparent) and retry;
+5. decode-only batches with ``decode_chunk > 1`` run through the on-device
+   ``engine.decode_loop`` (one dispatch per K tokens);
+6. idle ticks heartbeat ``engine.empty_run()`` so idle EP replicas stay in
+   collective lock-step with busy ones.
+"""
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.inference.v2.scheduling_utils import SchedulingError, SchedulingResult
+from deepspeed_tpu.serving.config import ServingConfig
+from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.request import Request, RequestState
+from deepspeed_tpu.utils.logging import logger
+
+# ticks with active requests but nothing engine-schedulable before the
+# scheduler declares them wedged (covers allocator corner cases the
+# permanent-infeasibility admission checks cannot see)
+_STARVATION_FAIL_TICKS = 5000
+
+
+class QueueFullError(RuntimeError):
+    """reject-mode backpressure: the submission queue is at capacity."""
+
+
+class SchedulerStopped(RuntimeError):
+    """submit() after stop(): the scheduler no longer admits requests."""
+
+
+class ServingScheduler:
+    """Owns the request lifecycle end-to-end over one :class:`InferenceEngineV2`.
+
+    ``start=False`` skips the background thread; callers (tests, or an outer
+    event loop) then drive ``step()`` manually. Exactly one scheduler may be
+    attached to an engine at a time; ``engine.close()`` stops it.
+    """
+
+    def __init__(self, engine, config: Optional[ServingConfig] = None, start: bool = True):
+        if getattr(engine, "_serving_scheduler", None) is not None:
+            raise RuntimeError("engine already has an attached ServingScheduler; "
+                               "stop it (or engine.close()) first")
+        self._engine = engine
+        self._config = config or ServingConfig()
+        self._metrics = ServingMetrics.maybe_create()
+
+        self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._active: Dict[int, Request] = {}  # uid -> Request, admission order
+        self._uids = itertools.count()
+        self._counters = {k: 0 for k in
+                          ("submitted", "rejected", "completed", "cancelled",
+                           "timed_out", "failed", "evictions", "batches", "heartbeats")}
+        self._stopping = False   # no new submits
+        self._shutdown = False   # thread exit
+        self._stopped = False
+        self._starved_ticks = 0
+        self._start_s = time.monotonic()
+        self._last_heartbeat_s = 0.0
+        # pool capacity for permanent-infeasibility checks (a prompt needing
+        # more KV blocks than the whole pool can never run)
+        self._capacity_blocks = engine._state_manager.kv_cache.num_blocks
+
+        engine._serving_scheduler = self
+        self._thread = None
+        if start:
+            self._thread = threading.Thread(target=self._run, name="dstpu-serving-scheduler",
+                                            daemon=True)
+            self._thread.start()
+
+    # ------------------------------------------------------------- submission --
+    def submit(self,
+               prompt,
+               max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0,
+               eos_token_id: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               seed: int = 0) -> Request:
+        """Enqueue a generation request (any thread). Returns the live
+        :class:`Request`; stream tokens from ``request.stream`` or block on
+        ``request.result()``. Backpressure per ``config.backpressure``:
+        ``reject`` raises :class:`QueueFullError`, ``block`` stalls until the
+        queue has room."""
+        req = Request(prompt,
+                      max_new_tokens=max_new_tokens if max_new_tokens is not None
+                      else self._config.default_max_new_tokens,
+                      temperature=temperature,
+                      eos_token_id=eos_token_id,
+                      deadline_s=deadline_s if deadline_s is not None
+                      else self._config.default_deadline_s,
+                      seed=seed)
+        with self._not_full:
+            if self._stopping:
+                raise SchedulerStopped("scheduler is stopping; not admitting requests")
+            if len(self._queue) >= self._config.queue_capacity:
+                if self._config.backpressure == "reject":
+                    self._counters["rejected"] += 1
+                    if self._metrics:
+                        self._metrics.rejections.inc()
+                    raise QueueFullError(
+                        f"queue at capacity ({self._config.queue_capacity})")
+                while len(self._queue) >= self._config.queue_capacity and not self._stopping:
+                    self._not_full.wait(0.05)
+                if self._stopping:
+                    raise SchedulerStopped("scheduler stopped while blocked on a full queue")
+            self._queue.append(req)
+            self._counters["submitted"] += 1
+            if self._metrics:
+                self._metrics.admissions.inc()
+                self._metrics.queue_depth.set(len(self._queue))
+        return req
+
+    def cancel(self, request: Request) -> None:
+        """Flag a request for cancellation; the scheduler thread frees its KV
+        blocks on the next tick (``Request.cancel()`` is equivalent)."""
+        request.cancel()
+
+    # ------------------------------------------------------------------ tick --
+    def step(self) -> bool:
+        """One scheduling iteration; returns True iff a batch executed.
+        Runs on the scheduler thread — or inline when ``start=False``."""
+        now = time.monotonic()
+        for req in list(self._active.values()):
+            if req.cancel_requested:
+                self._finalize(req, RequestState.CANCELLED)
+            elif req.deadline is not None and now > req.deadline:
+                self._finalize(req, RequestState.TIMED_OUT)
+        self._admit(now)
+        plan = self._build_batch()
+        if not plan:
+            if not self._active:
+                self._starved_ticks = 0  # idle, not starved
+            else:
+                self._starved_ticks += 1
+                if self._starved_ticks >= _STARVATION_FAIL_TICKS:
+                    for req in list(self._active.values()):
+                        self._finalize(req, RequestState.FAILED,
+                                       error=f"starved: unschedulable for "
+                                             f"{self._starved_ticks} ticks "
+                                             f"({self._engine.free_blocks} free KV blocks)")
+                    self._starved_ticks = 0  # a fresh grace period for later work
+            return False
+        self._starved_ticks = 0
+        self._execute(plan)
+        self._counters["batches"] += 1
+        return True
+
+    def _admit(self, now: float) -> None:
+        max_active = self._engine._config.state_manager.max_tracked_sequences
+        with self._not_full:
+            while self._queue and len(self._active) < max_active:
+                req = self._queue.popleft()
+                self._not_full.notify()
+                if req.cancel_requested:
+                    self._finalize(req, RequestState.CANCELLED)
+                    continue
+                if req.deadline is not None and now > req.deadline:
+                    self._finalize(req, RequestState.TIMED_OUT)
+                    continue
+                infeasible = self._permanently_infeasible(req)
+                if infeasible:
+                    self._finalize(req, RequestState.FAILED, error=infeasible)
+                    continue
+                req.uid = next(self._uids)
+                req._set_state(RequestState.PREFILL)
+                self._active[req.uid] = req
+            if self._metrics:
+                self._metrics.queue_depth.set(len(self._queue))
+                self._metrics.in_flight.set(len(self._active))
+
+    def _permanently_infeasible(self, req: Request) -> Optional[str]:
+        """A reason this request can NEVER be scheduled, or None. Failing at
+        admission beats starving it forever against budgets that will not
+        change (generate()'s old 'no sequence schedulable' RuntimeError)."""
+        sm = self._engine._config.state_manager
+        if req.prompt.size + 1 > sm.max_context:
+            return (f"prompt of {req.prompt.size} tokens exceeds max_context="
+                    f"{sm.max_context} (room for at least one generated token "
+                    f"is required)")
+        block_size = self._engine._state_manager.kv_block_size
+        min_blocks = -(-(req.prompt.size + 1) // block_size)
+        if min_blocks > self._capacity_blocks:
+            return (f"prompt needs {min_blocks} KV blocks; the pool holds "
+                    f"{self._capacity_blocks}")
+        return None
+
+    # -------------------------------------------------------- batch building --
+    def _build_batch(self) -> List[Tuple[Request, np.ndarray]]:
+        engine = self._engine
+        sm_cfg = engine._config.state_manager
+        budget = sm_cfg.max_ragged_batch_size
+        plan: List[Tuple[Request, np.ndarray]] = []
+        uids: List[int] = []
+        lens: List[int] = []
+
+        def admission(uid: int, n: int) -> SchedulingResult:
+            return engine.can_schedule(uids + [uid], lens + [n])
+
+        def admit(req: Request, toks) -> None:
+            toks = np.asarray(toks, np.int32).reshape(-1)
+            uids.append(req.uid)
+            lens.append(toks.size)
+            plan.append((req, toks))
+
+        def admit_under_pressure(req: Request, n: int) -> bool:
+            """1-token admission with evict-coldest retries on KV pressure."""
+            while True:
+                result = admission(req.uid, n)
+                if result == SchedulingResult.Success:
+                    return True
+                if result != SchedulingResult.KVCacheLimitExceeded:
+                    return False  # token/sequence budget: eviction cannot help
+                if not self._evict_one(set(uids) | {req.uid}):
+                    return False
+
+        def by_pressure_priority(reqs):
+            # requests deferred under KV pressure go first the next tick —
+            # in-batch sequences are never eviction candidates, so without
+            # this a permanently-admitted peer could starve a deferred one
+            return sorted(reqs, key=lambda r: (-r._deferred, r.uid))
+
+        # --- decode tokens first: one each, latency-critical
+        for req in by_pressure_priority(
+                [r for r in list(self._active.values()) if r.state is RequestState.DECODE]):
+            if len(lens) + 1 > sm_cfg.max_ragged_sequence_count or sum(lens) + 1 > budget:
+                break
+            seq = engine._state_manager.get_sequence(req.uid)
+            if seq is not None and seq.seen_tokens + 1 > sm_cfg.max_context:
+                # context window exhausted: a clean length-cut, not an error
+                req.finish_reason = "context"
+                self._finalize(req, RequestState.DONE)
+                continue
+            if admit_under_pressure(req, 1):
+                req._deferred = 0
+                admit(req, [req._next])
+            else:
+                req._deferred += 1  # KV held by in-flight work; retry next tick
+
+        # --- prompt chunks fill what's left (Dynamic SplitFuse)
+        for req in by_pressure_priority(
+                [r for r in list(self._active.values()) if r.state is RequestState.PREFILL]):
+            room = budget - sum(lens)
+            if self._config.max_prefill_chunk is not None:
+                room = min(room, self._config.max_prefill_chunk)
+            if room < 1 or len(lens) + 1 > sm_cfg.max_ragged_sequence_count:
+                break
+            remaining = req.prompt[req._fed:]
+            while True:
+                chunk = remaining[:room]
+                while chunk.size and admission(req.uid, chunk.size) != SchedulingResult.Success:
+                    chunk = chunk[:chunk.size // 2]  # shrink under KV pressure first
+                if chunk.size or not self._evict_one(set(uids) | {req.uid}):
+                    break  # admitted something, or nothing left to evict
+            if chunk.size:
+                req._deferred = 0
+                admit(req, chunk)
+            else:
+                req._deferred += 1
+        return plan
+
+    def _evict_one(self, exclude_uids) -> bool:
+        """Offload the coldest idle engine-resident sequence (not in the batch
+        being built) to free device KV blocks; it restores transparently when
+        next touched. Returns False when nothing is evictable."""
+        engine = self._engine
+        candidates = []
+        for req in self._active.values():
+            if req.uid in exclude_uids or engine.is_offloaded(req.uid):
+                continue
+            seq = engine._state_manager.get_sequence(req.uid)
+            if seq is not None and seq.cur_allocated_blocks > 0:
+                candidates.append(req)
+        if not candidates:
+            return False
+        coldest = min(candidates, key=lambda r: r._last_touch_s)
+        engine.offload_sequence(coldest.uid)
+        self._counters["evictions"] += 1
+        if self._metrics:
+            self._metrics.evictions.inc()
+        return True
+
+    # --------------------------------------------------------------- execute --
+    def _execute(self, plan: List[Tuple[Request, np.ndarray]]) -> None:
+        engine = self._engine
+        uids = [req.uid for req, _ in plan]
+        tokens = [t for _, t in plan]
+        now = time.monotonic()
+        for req, _ in plan:
+            req._last_touch_s = now
+
+        K = self._config.decode_chunk
+        max_context = self._engine._config.state_manager.max_context
+
+        def chunk_safe(req):
+            # greedy only (a sampled batch must keep each request on its own
+            # private seeded stream, which a shared device PRNG cannot honor)
+            # and never past max_context: the device loop always runs K steps,
+            # and tokens beyond the context window must not reach the client
+            seq = engine._state_manager.get_sequence(req.uid)
+            return (req.temperature <= 0.0
+                    and (seq is None or seq.seen_tokens + K <= max_context))
+
+        decode_only = (K > 1 and all(req.state is RequestState.DECODE
+                                     and chunk_safe(req) for req, _ in plan))
+        if decode_only:
+            try:
+                rows = np.asarray(engine.decode_loop(uids, tokens, K))
+            except SchedulingError:
+                rows = None  # KV too tight for K steps — single-step fallback
+            if rows is not None:
+                for (req, _), row in zip(plan, rows):
+                    prev = req._last_token_s
+                    pushed = 0
+                    for tok in row:
+                        self._push_token(req, int(tok), record_itl=False)
+                        pushed += 1
+                        if req.finished:
+                            break  # discard over-generated tokens past eos/cap
+                    else:
+                        req._next = int(row[-1])
+                    if self._metrics and prev is not None and pushed:
+                        # the chunk arrives as one burst: record the dispatch
+                        # gap amortized per token, so ITL reflects the cadence
+                        # a client sees rather than the microsecond host loop
+                        gap = (req._last_token_s - prev) / pushed
+                        for _ in range(pushed):
+                            self._metrics.itl.observe(gap)
+                return
+
+        try:
+            logits = np.asarray(engine.put(uids, tokens))
+        except Exception as e:  # pragma: no cover - defensive: the scheduler
+            # thread must survive an engine fault; the batch's requests fail
+            logger.exception("serving: engine.put failed; failing the batch")
+            for req, _ in plan:
+                self._finalize(req, RequestState.FAILED, error=f"engine error: {e}")
+            return
+        for i, (req, toks) in enumerate(plan):
+            if req.state is RequestState.PREFILL:
+                req._fed += toks.size
+                if req._fed < req.prompt.size:
+                    continue  # mid-prefill logits are meaningless
+                req._set_state(RequestState.DECODE)
+            nxt = self._sample(req, logits[i])
+            self._push_token(req, nxt)
+            if not req.finished:
+                req._next = nxt
+
+    @staticmethod
+    def _sample(req: Request, row: np.ndarray) -> int:
+        if req.temperature <= 0.0:
+            return int(np.argmax(row))
+        if req._rng is None:
+            req._rng = np.random.default_rng(req.seed)
+        z = row.astype(np.float64) / req.temperature
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(req._rng.choice(row.shape[0], p=p))
+
+    def _push_token(self, req: Request, tok: int, record_itl: bool = True) -> None:
+        now = time.monotonic()
+        req.tokens.append(tok)
+        if req.first_token_s is None:
+            req.first_token_s = now
+            if self._metrics:
+                self._metrics.ttft.observe(now - req.arrival_s)
+        elif self._metrics and record_itl:
+            self._metrics.itl.observe(now - req._last_token_s)
+        req._last_token_s = now
+        req.stream.put(tok)
+        if req.eos_token_id is not None and tok == req.eos_token_id:
+            req.finish_reason = "eos"
+            self._finalize(req, RequestState.DONE)
+        elif len(req.tokens) >= req.max_new_tokens:
+            req.finish_reason = "length"
+            self._finalize(req, RequestState.DONE)
+
+    # -------------------------------------------------------------- finalize --
+    _FINAL_COUNTER = {RequestState.DONE: "completed", RequestState.CANCELLED: "cancelled",
+                      RequestState.TIMED_OUT: "timed_out", RequestState.FAILED: "failed"}
+
+    def _finalize(self, req: Request, state: RequestState, error: Optional[str] = None) -> None:
+        """Terminal transition on the scheduler thread: free engine state
+        (tracked OR offloaded KV), close the stream, account."""
+        if req.finished:
+            return
+        req.error = error
+        if req.uid is not None:
+            self._active.pop(req.uid, None)
+            if self._engine._state_manager.get_sequence(req.uid) is not None:
+                self._engine.flush(req.uid)  # returns KV blocks (incl. offloaded)
+        req._set_state(state)
+        self._counters[self._FINAL_COUNTER[state]] += 1
+        if self._metrics:
+            {RequestState.DONE: self._metrics.completions,
+             RequestState.CANCELLED: self._metrics.cancellations,
+             RequestState.TIMED_OUT: self._metrics.timeouts,
+             RequestState.FAILED: self._metrics.failures}[state].inc()
+            self._metrics.e2e.observe(req.e2e_s)
+            self._metrics.in_flight.set(len(self._active))
+
+    # ------------------------------------------------------------------ loop --
+    def _run(self) -> None:
+        while not self._shutdown:
+            try:
+                progressed = self.step()
+            except Exception:  # pragma: no cover - must never kill the thread
+                logger.exception("serving scheduler: step() raised")
+                progressed = False
+            if not progressed:
+                self._maybe_heartbeat()
+                time.sleep(self._config.scheduler_tick_s)
+
+    def _maybe_heartbeat(self) -> None:
+        enabled = self._config.heartbeat_enabled
+        if enabled is None:
+            enabled = self._engine._config.expert_parallel.enabled
+        if not enabled:
+            return
+        now = time.monotonic()
+        if now - self._last_heartbeat_s >= self._config.heartbeat_interval_s:
+            self._last_heartbeat_s = now
+            self._counters["heartbeats"] += 1
+            self._engine.empty_run()
+
+    # ------------------------------------------------------------------ stop --
+    def _has_work(self) -> bool:
+        return bool(self._queue) or bool(self._active)
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """Stop the scheduler: no further admissions; with ``drain`` in-flight
+        and queued requests get up to ``timeout`` (default
+        ``config.drain_timeout_s``) to finish, then the remainder is
+        CANCELLED. Idempotent."""
+        if self._stopped:
+            return
+        if timeout is None:
+            timeout = self._config.drain_timeout_s
+        with self._not_full:
+            self._stopping = True
+            self._not_full.notify_all()  # wake blocked submitters
+        deadline = time.monotonic() + (timeout if drain else 0.0)
+        if self._thread is not None:
+            while drain and self._has_work() and time.monotonic() < deadline:
+                time.sleep(min(self._config.scheduler_tick_s, 0.01))
+            self._shutdown = True
+            self._thread.join()
+            self._thread = None
+        else:
+            while drain and self._has_work() and time.monotonic() < deadline:
+                if not self.step():
+                    time.sleep(self._config.scheduler_tick_s)
+        # cancel whatever drain didn't finish (scheduler thread is dead, so
+        # touching the engine from here is safe)
+        for req in list(self._active.values()):
+            self._finalize(req, RequestState.CANCELLED)
+        while self._queue:
+            self._finalize(self._queue.popleft(), RequestState.CANCELLED)
+        if getattr(self._engine, "_serving_scheduler", None) is self:
+            self._engine._serving_scheduler = None
+        self._stopped = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop(drain=False)
+
+    # ----------------------------------------------------------------- stats --
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def n_active(self) -> int:
+        return len(self._active)
+
+    def stats(self) -> dict:
+        active = list(self._active.values())
+        return {
+            "queue_depth": len(self._queue),
+            "active": {
+                "total": len(active),
+                "prefill": sum(1 for r in active if r.state is RequestState.PREFILL),
+                "decode": sum(1 for r in active if r.state is RequestState.DECODE),
+            },
+            "counters": dict(self._counters),
+            "engine": {
+                "free_blocks": self._engine.free_blocks,
+                "tracked_sequences": self._engine._state_manager.n_tracked_sequences,
+            },
+            "draining": self._stopping,
+            "uptime_s": time.monotonic() - self._start_s,
+        }

@@ -1,0 +1,206 @@
+"""Stdlib HTTP front-end for the serving scheduler.
+
+In the style of ``telemetry/exporter.py`` (daemon ``ThreadingHTTPServer``,
+ephemeral-port support), serving the request lifecycle instead of metrics:
+
+- ``POST /v1/generate`` — JSON body::
+
+      {"prompt": [1, 2, 3],            // token ids (required, non-empty)
+       "max_new_tokens": 64,           // optional, server default otherwise
+       "temperature": 0.0,             // optional
+       "eos_token_id": 2,              // optional
+       "deadline_s": 2.0,              // optional per-request deadline
+       "seed": 0,                      // optional sampling seed
+       "stream": true}                 // optional: SSE token streaming
+
+  Non-streaming responses are one JSON object
+  ``{"tokens": [...], "state": "DONE", "finish_reason": "length", ...}``.
+  Streaming responses are Server-Sent Events (``text/event-stream``): one
+  ``data: {"token": N, "index": I}`` event per generated token as it is
+  sampled (TTFT is real), then a final ``data: {"done": true, "state": ...,
+  "tokens": [...]}`` event. A dropped connection cancels the request (its KV
+  blocks return to the pool on the next scheduler tick).
+
+  Backpressure: queue-full in ``reject`` mode returns **429**; ``block`` mode
+  stalls the handler thread until the queue drains. During shutdown new
+  requests get **503**.
+
+- ``GET /v1/stats`` — scheduler + engine occupancy JSON.
+- ``GET /healthz`` — liveness (same contract as the telemetry exporter).
+
+``stop()`` drains gracefully: admission stops (503), in-flight requests run to
+completion bounded by ``config.drain_timeout_s``, stragglers are CANCELLED,
+then the listener shuts down.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from deepspeed_tpu.serving.config import ServingConfig
+from deepspeed_tpu.serving.request import Request
+from deepspeed_tpu.serving.scheduler import (QueueFullError, SchedulerStopped,
+                                             ServingScheduler)
+from deepspeed_tpu.utils.logging import logger
+
+_MAX_BODY_BYTES = 8 << 20  # an 8 MiB prompt is already ~2M tokens of JSON
+
+
+def _request_doc(req: Request) -> dict:
+    return {
+        "tokens": list(req.tokens),
+        "n_tokens": len(req.tokens),
+        "state": req.state.name,
+        "finish_reason": req.finish_reason,
+        "error": req.error,
+        "ttft_s": req.ttft_s,
+        "e2e_s": req.e2e_s,
+    }
+
+
+class ServingServer:
+    """HTTP front-end over a :class:`ServingScheduler` (constructed outside so
+    the same scheduler can also be driven programmatically)."""
+
+    def __init__(self, scheduler: ServingScheduler,
+                 host: Optional[str] = None, port: Optional[int] = None):
+        self._scheduler = scheduler
+        cfg: ServingConfig = scheduler._config
+        self._host = host if host is not None else cfg.host
+        self._port = port if port is not None else cfg.port
+        self._server = None
+        self._thread = None
+        self._draining = threading.Event()
+
+    @property
+    def scheduler(self) -> ServingScheduler:
+        return self._scheduler
+
+    @property
+    def address(self):
+        """(host, port) once started."""
+        return self._server.server_address if self._server else None
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    # ----------------------------------------------------------------- start --
+    def start(self) -> "ServingServer":
+        scheduler, draining = self._scheduler, self._draining
+
+        class Handler(BaseHTTPRequestHandler):
+
+            def _send_json(self, code, doc):
+                data = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if path == "/v1/stats":
+                    self._send_json(200, scheduler.stats())
+                elif path == "/healthz":
+                    self._send_json(200, {"status": "draining" if draining.is_set()
+                                          else "ok"})
+                else:
+                    self._send_json(404, {"error": f"no route {path}"})
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path != "/v1/generate":
+                    self._send_json(404, {"error": f"no route {path}"})
+                    return
+                if draining.is_set():
+                    self._send_json(503, {"error": "server is draining"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    if not 0 < length <= _MAX_BODY_BYTES:
+                        raise ValueError(f"body length {length} out of bounds")
+                    doc = json.loads(self.rfile.read(length))
+                    prompt = doc["prompt"]
+                    if (not isinstance(prompt, list) or not prompt
+                            or not all(isinstance(t, int) for t in prompt)):
+                        raise ValueError("'prompt' must be a non-empty list of token ids")
+                except (KeyError, ValueError, json.JSONDecodeError) as e:
+                    self._send_json(400, {"error": str(e)})
+                    return
+                try:
+                    req = scheduler.submit(
+                        prompt,
+                        max_new_tokens=doc.get("max_new_tokens"),
+                        temperature=float(doc.get("temperature") or 0.0),
+                        eos_token_id=doc.get("eos_token_id"),
+                        deadline_s=doc.get("deadline_s"),
+                        seed=int(doc.get("seed") or 0))
+                except QueueFullError as e:
+                    self._send_json(429, {"error": str(e),
+                                          "queue_depth": scheduler.queue_depth})
+                    return
+                except SchedulerStopped as e:
+                    self._send_json(503, {"error": str(e)})
+                    return
+                except (ValueError, TypeError) as e:
+                    # wrongly-typed optional fields (null temperature, string
+                    # max_new_tokens, ...) are client errors, not handler crashes
+                    self._send_json(400, {"error": str(e)})
+                    return
+                if doc.get("stream"):
+                    self._stream_sse(req)
+                else:
+                    req.wait()  # terminal by deadline/max_new_tokens/cancel
+                    self._send_json(200, _request_doc(req))
+
+            def _stream_sse(self, req):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                try:
+                    for i, tok in enumerate(req.stream):
+                        self.wfile.write(
+                            f"data: {json.dumps({'token': tok, 'index': i})}\n\n".encode())
+                        self.wfile.flush()
+                    self.wfile.write(
+                        f"data: {json.dumps({'done': True, **_request_doc(req)})}\n\n".encode())
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    # client went away: cancel so the sequence's KV blocks
+                    # return to the pool on the next scheduler tick
+                    req.cancel()
+
+            def log_message(self, fmt, *args):
+                ...  # request logging must not spam the serving log
+
+        self._server = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="dstpu-serving-http", daemon=True)
+        self._thread.start()
+        logger.info(f"serving: /v1/generate /v1/stats /healthz on {self.url}")
+        return self
+
+    # ------------------------------------------------------------------ stop --
+    def stop(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """Graceful shutdown: stop admitting (503), drain in-flight bounded by
+        the drain timeout, then close the listener. Idempotent."""
+        if self._server is None:
+            return
+        self._draining.set()
+        self._scheduler.stop(drain=drain, timeout=timeout)
+        self._server.shutdown()
+        self._server.server_close()
+        self._server = None
+        self._thread = None
+
+    def __enter__(self):
+        return self.start() if self._server is None else self
+
+    def __exit__(self, *exc):
+        self.stop(drain=False)

@@ -5,6 +5,11 @@ inference-checkpoint round-trip, on a tiny random llama.
 
 Run:
     XLA_FLAGS=--xla_force_host_platform_device_count=8 python examples/serve_v2.py
+
+Server mode (``DSTPU_SERVE_MODE=server``): start the persistent serving layer
+— ServingScheduler + ServingServer on an ephemeral port — submit two
+overlapping SSE streaming requests over HTTP, and print tokens as they
+arrive; then drain gracefully.
 """
 
 import os
@@ -27,6 +32,61 @@ from deepspeed_tpu.inference.v2.engine_factory import (build_engine, build_hf_en
 from deepspeed_tpu.inference.v2.ragged.manager_configs import (AllocationMode,
                                                                DSStateManagerConfig,
                                                                MemoryConfig)
+
+
+def serve_main():
+    """Persistent-server demo: overlapping streaming requests over HTTP."""
+    import json
+    import threading
+    import urllib.request
+
+    from deepspeed_tpu.serving import ServingConfig, ServingScheduler, ServingServer
+
+    cfg = LlamaConfig.tiny(vocab_size=512, max_position_embeddings=128)
+    _, params = init_params(cfg, seq_len=16)
+    engine_config = RaggedInferenceEngineConfig(
+        state_manager=DSStateManagerConfig(
+            memory_config=MemoryConfig(mode=AllocationMode.ALLOCATE, size=128),
+            max_context=128, max_ragged_batch_size=256, max_ragged_sequence_count=8),
+        kv_block_size=16)
+    engine = build_engine(params, cfg, engine_config)
+    scheduler = ServingScheduler(engine, ServingConfig(decode_chunk=4))
+    server = ServingServer(scheduler).start()
+    print(f"serving on {server.url}")
+
+    def stream_one(name, prompt, n):
+        body = json.dumps({"prompt": prompt, "max_new_tokens": n,
+                           "stream": True}).encode()
+        req = urllib.request.Request(server.url + "/v1/generate", data=body,
+                                     headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            for line in resp:
+                line = line.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                event = json.loads(line[len("data: "):])
+                if event.get("done"):
+                    print(f"[{name}] done: state={event['state']} "
+                          f"tokens={event['tokens']}")
+                else:
+                    print(f"[{name}] token {event['index']}: {event['token']}")
+
+    rng = np.random.default_rng(0)
+    threads = [threading.Thread(target=stream_one,
+                                args=(name, rng.integers(0, cfg.vocab_size, n).tolist(), 8))
+               for name, n in (("A", 24), ("B", 9))]
+    for t in threads:
+        t.start()  # both requests are in flight concurrently
+    for t in threads:
+        t.join()
+
+    stats = json.loads(urllib.request.urlopen(server.url + "/v1/stats",
+                                              timeout=10).read())
+    assert stats["counters"]["completed"] == 2, stats
+    server.stop()  # graceful drain
+    assert engine.free_blocks == 128, "KV blocks must all return to the pool"
+    engine.close()
+    print("OK")
 
 
 def main():
@@ -80,4 +140,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("DSTPU_SERVE_MODE") == "server":
+        serve_main()
+    else:
+        main()

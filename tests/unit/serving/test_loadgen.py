@@ -1,0 +1,54 @@
+"""bin/dstpu_loadgen against a live ServingServer (CLI smoke, in the style of
+tests/unit/launcher/test_cli_tools.py)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.serving import ServingConfig, ServingScheduler, ServingServer
+
+BIN = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))), "bin")
+
+
+@pytest.fixture
+def server(make_engine):
+    srv = ServingServer(ServingScheduler(make_engine(), ServingConfig())).start()
+    yield srv
+    srv.stop(drain=False)
+
+
+def _loadgen(*args, timeout=300):
+    return subprocess.run([sys.executable, os.path.join(BIN, "dstpu_loadgen"), *args],
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def test_loadgen_closed_loop_streaming(server, llama_setup):
+    cfg, _, _ = llama_setup
+    r = _loadgen("--url", server.url, "--requests", "4", "--mode", "closed",
+                 "--concurrency", "2", "--prompt-len", "8",
+                 "--max-new-tokens", "4", "--vocab-size", str(cfg.vocab_size))
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "ok=4 err=0" in r.stdout
+    for metric in ("throughput", "ttft", "itl", "e2e"):
+        assert metric in r.stdout, r.stdout
+    assert server.scheduler.stats()["counters"]["completed"] == 4
+
+
+def test_loadgen_open_loop_lognormal(server, llama_setup):
+    cfg, _, _ = llama_setup
+    r = _loadgen("--url", server.url, "--requests", "3", "--mode", "open",
+                 "--rate", "50", "--prompt-len", "6", "--prompt-len-dist",
+                 "lognormal", "--max-new-tokens", "3",
+                 "--vocab-size", str(cfg.vocab_size))
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "ok=3 err=0" in r.stdout
+
+
+def test_loadgen_reports_connection_errors():
+    r = _loadgen("--url", "http://127.0.0.1:1", "--requests", "2",
+                 "--concurrency", "1", "--timeout", "2")
+    assert r.returncode == 1
+    assert "err=2" in r.stdout

@@ -1,0 +1,78 @@
+"""Request/TokenStream lifecycle primitives (no engine involved)."""
+
+import queue
+import threading
+
+import pytest
+
+from deepspeed_tpu.serving.request import Request, RequestState, TokenStream
+
+
+def test_token_stream_iterates_then_stops():
+    s = TokenStream()
+    for t in (5, 7, 9):
+        s.put(t)
+    s.close()
+    assert list(s) == [5, 7, 9]
+    assert list(s) == []  # drained + closed: iteration terminates immediately
+
+
+def test_token_stream_get_timeout_and_close_sentinel():
+    s = TokenStream()
+    with pytest.raises(queue.Empty):
+        s.get(timeout=0.01)
+    s.put(3)
+    assert s.get(timeout=1) == 3
+    s.close()
+    assert s.get(timeout=1) is None
+    assert s.get(timeout=1) is None  # sentinel persists for later consumers
+
+
+def test_token_stream_blocking_consumer_wakes_on_close():
+    s = TokenStream()
+    got = []
+
+    def consume():
+        got.extend(s)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    s.put(1)
+    s.put(2)
+    s.close()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert got == [1, 2]
+
+
+def test_request_validation():
+    with pytest.raises(ValueError, match="at least one token"):
+        Request([])
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        Request([1], max_new_tokens=0)
+
+
+def test_request_terminal_state_is_sticky():
+    req = Request([1, 2], max_new_tokens=4)
+    assert req.state is RequestState.QUEUED and not req.finished
+    req._set_state(RequestState.PREFILL)
+    req._set_state(RequestState.CANCELLED)
+    assert req.finished and req.stream.closed
+    req._set_state(RequestState.DONE)  # must not resurrect
+    assert req.state is RequestState.CANCELLED
+
+
+def test_request_result_raises_on_failure_and_timeout():
+    req = Request([1], max_new_tokens=2)
+    with pytest.raises(TimeoutError):
+        req.result(timeout=0.01)
+    req.error = "boom"
+    req._set_state(RequestState.FAILED)
+    with pytest.raises(RuntimeError, match="boom"):
+        req.result(timeout=1)
+
+
+def test_request_deadline_is_absolute_from_arrival():
+    req = Request([1], deadline_s=100.0)
+    assert req.deadline == pytest.approx(req.arrival_s + 100.0)
+    assert Request([1]).deadline is None

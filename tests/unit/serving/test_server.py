@@ -1,0 +1,172 @@
+"""ServingServer HTTP front-end: JSON + SSE wire formats, backpressure status
+codes, client-disconnect cancellation, stats, and graceful drain."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.serving import (RequestState, ServingConfig, ServingScheduler,
+                                   ServingServer)
+
+
+def _post(url, doc, timeout=120):
+    req = urllib.request.Request(url + "/v1/generate", data=json.dumps(doc).encode(),
+                                 headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _sse_events(resp):
+    events = []
+    for line in resp:
+        line = line.decode().strip()
+        if line.startswith("data: "):
+            events.append(json.loads(line[len("data: "):]))
+    return events
+
+
+@pytest.fixture
+def server(make_engine):
+    engine = make_engine()
+    srv = ServingServer(ServingScheduler(engine, ServingConfig())).start()
+    yield srv, engine
+    srv.stop(drain=False)
+
+
+def test_generate_json_roundtrip_and_stats(server, llama_setup):
+    cfg, _, _ = llama_setup
+    srv, engine = server
+    prompt = (np.arange(7) % cfg.vocab_size).tolist()
+    with _post(srv.url, {"prompt": prompt, "max_new_tokens": 5}) as resp:
+        doc = json.loads(resp.read())
+    assert resp.status == 200
+    assert doc["state"] == "DONE" and doc["finish_reason"] == "length"
+    assert len(doc["tokens"]) == doc["n_tokens"] == 5
+    assert doc["ttft_s"] is not None and doc["ttft_s"] <= doc["e2e_s"]
+
+    stats = json.loads(urllib.request.urlopen(srv.url + "/v1/stats", timeout=10).read())
+    assert stats["counters"]["completed"] == 1
+    assert stats["engine"]["tracked_sequences"] == 0
+
+    health = json.loads(urllib.request.urlopen(srv.url + "/healthz", timeout=10).read())
+    assert health == {"status": "ok"}
+
+
+def test_generate_sse_stream_matches_blocking(server, llama_setup):
+    cfg, _, _ = llama_setup
+    srv, _ = server
+    prompt = (np.arange(11) % cfg.vocab_size).tolist()
+    with _post(srv.url, {"prompt": prompt, "max_new_tokens": 6, "stream": True}) as resp:
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        events = _sse_events(resp)
+    *tokens, final = events
+    assert [e["index"] for e in tokens] == list(range(6))
+    assert final["done"] is True and final["state"] == "DONE"
+    assert [e["token"] for e in tokens] == final["tokens"]
+
+    with _post(srv.url, {"prompt": prompt, "max_new_tokens": 6}) as resp:
+        blocking = json.loads(resp.read())
+    assert blocking["tokens"] == final["tokens"]  # same greedy continuation
+
+
+def test_bad_requests_get_400(server):
+    srv, _ = server
+    for body in ({}, {"prompt": []}, {"prompt": "text"}, {"prompt": [1, "x"]},
+                 {"prompt": [1], "max_new_tokens": 0},
+                 {"prompt": [1], "temperature": "hot"},
+                 {"prompt": [1], "max_new_tokens": "x"}):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url, body)
+        assert e.value.code == 400, body
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(srv.url + "/v1/nope", data=b"{}", timeout=10)
+    assert e.value.code == 404
+
+
+def test_queue_full_returns_429_in_reject_mode(make_engine):
+    engine = make_engine()
+    # start=False: nothing drains the queue, so capacity is hit deterministically
+    sched = ServingScheduler(engine, ServingConfig(queue_capacity=1), start=False)
+    srv = ServingServer(sched).start()
+    try:
+        results = {}
+
+        def first():
+            try:
+                with _post(srv.url, {"prompt": [1, 2]}) as resp:
+                    results["first"] = json.loads(resp.read())
+            except Exception as e:  # cancelled at shutdown is fine too
+                results["first"] = e
+
+        t = threading.Thread(target=first, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10
+        while sched.queue_depth < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url, {"prompt": [3, 4]})
+        assert e.value.code == 429
+        assert json.loads(e.value.read())["queue_depth"] == 1
+    finally:
+        srv.stop(drain=False)  # cancels the queued request; its handler returns
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+def test_draining_server_returns_503(server):
+    srv, _ = server
+    srv._draining.set()  # what stop() flips first, observed before teardown
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(srv.url, {"prompt": [1, 2]})
+    assert e.value.code == 503
+    health = json.loads(urllib.request.urlopen(srv.url + "/healthz", timeout=10).read())
+    assert health == {"status": "draining"}
+
+
+def test_client_disconnect_cancels_request_and_frees_kv(server, llama_setup):
+    cfg, _, _ = llama_setup
+    srv, engine = server
+    free0 = engine.free_blocks
+    prompt = (np.arange(10) % cfg.vocab_size).tolist()
+    resp = _post(srv.url, {"prompt": prompt, "max_new_tokens": 100000, "stream": True})
+    # read one real token, then hang up mid-generation
+    for line in resp:
+        if line.decode().strip().startswith("data: "):
+            break
+    sock = resp.fp.raw._sock if hasattr(resp.fp, "raw") else None
+    resp.close()
+    if sock is not None:  # make the FIN unambiguous for the handler thread
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+    deadline = time.monotonic() + 60
+    sched = srv.scheduler
+    while sched.stats()["counters"]["cancelled"] < 1:
+        assert time.monotonic() < deadline, "disconnect did not cancel the request"
+        time.sleep(0.01)
+    while engine.free_blocks != free0:
+        assert time.monotonic() < deadline, "KV blocks not returned after cancel"
+        time.sleep(0.01)
+    assert engine._state_manager.n_tracked_sequences == 0
+
+
+def test_graceful_drain_finishes_in_flight(make_engine, llama_setup):
+    cfg, _, _ = llama_setup
+    engine = make_engine()
+    sched = ServingScheduler(engine, ServingConfig(drain_timeout_s=120))
+    srv = ServingServer(sched).start()
+    prompt = (np.arange(6) % cfg.vocab_size).tolist()
+    req = sched.submit(prompt, max_new_tokens=4)
+    url = srv.url
+    srv.stop(drain=True)  # stop admitting, finish in-flight, then close
+    assert req.state is RequestState.DONE and len(req.tokens) == 4
+    assert engine._state_manager.n_tracked_sequences == 0
+    with pytest.raises(OSError):  # listener is really down
+        urllib.request.urlopen(url + "/healthz", timeout=1)

@@ -33,6 +33,15 @@ def test_example_runs(script):
     _run_example(script)
 
 
+def test_serve_v2_server_mode():
+    """serve_v2.py DSTPU_SERVE_MODE=server: a real ServingServer on an
+    ephemeral port, two SSE requests in flight concurrently, tokens printed
+    as they arrive, graceful drain."""
+    r = _run_example("serve_v2.py", extra_env={"DSTPU_SERVE_MODE": "server"})
+    assert "[A] token 0:" in r.stdout and "[B] token 0:" in r.stdout
+    assert "[A] done: state=DONE" in r.stdout and "[B] done: state=DONE" in r.stdout
+
+
 def test_train_zero3_with_telemetry(tmp_path):
     _run_example("train_zero3.py", extra_env={"DSTPU_TELEMETRY_DIR": str(tmp_path)})
 
