@@ -68,6 +68,90 @@ def _fmt_labels(labels):
     return "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}" if labels else ""
 
 
+def _load_trace_events(path):
+    """Normalize either export format into one event-dict list: a Chrome trace
+    (``traceEvents`` with ts/dur us) or a flight-recorder dump (``spans`` with
+    ts_us/dur_us)."""
+    import json
+
+    with open(path) as f:
+        doc = json.load(f)
+    if "traceEvents" in doc:
+        return [{"name": e["name"], "cat": e.get("cat", ""), "ts": e["ts"],
+                 "dur": e.get("dur", 0), "args": e.get("args", {})}
+                for e in doc["traceEvents"] if e.get("ph") == "X"]
+    if "spans" in doc:  # flight-recorder dump
+        return [{"name": s["name"], "cat": s.get("cat", ""), "ts": s["ts_us"],
+                 "dur": s.get("dur_us", 0),
+                 "args": {**s.get("args", {}),
+                          **({"trace_id": s["trace_id"], "span_id": s.get("span_id"),
+                              "parent_id": s.get("parent_id")}
+                             if s.get("trace_id") is not None else {})}}
+                for s in doc["spans"]]
+    raise ValueError(f"{path}: neither a Chrome trace (traceEvents) nor a "
+                     f"flight-recorder dump (spans)")
+
+
+def trace_report(path):
+    """``dstpu_report --trace <file>``: per-request timelines (queued/prefill/
+    decode durations, recompiles encountered) from an exported Chrome trace or
+    a flight-recorder dump."""
+    try:
+        events = _load_trace_events(path)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"trace report failed: {e}")
+        return 1
+
+    ms = 1e-3  # event times are microseconds
+    compiles = [e for e in events if e["name"] == "xla_compile"]
+    by_trace = {}
+    for e in events:
+        trace_id = e["args"].get("trace_id")
+        if trace_id is not None:
+            by_trace.setdefault(trace_id, []).append(e)
+
+    print("-" * 78)
+    print(f"trace ................... {path}")
+    print(f"events .................. {len(events)} "
+          f"({len(by_trace)} request traces, {len(compiles)} XLA compiles)")
+    print("-" * 78)
+    if not by_trace:
+        print("no request traces found (serve with telemetry enabled; the "
+              "X-DSTPU-Trace-Id response header names each request's trace)")
+        return 0
+
+    def total(evs, name):
+        return sum(e["dur"] for e in evs if e["name"] == name)
+
+    # roots sorted by arrival so the report reads as an admission log
+    roots = sorted((evs for evs in by_trace.values()),
+                   key=lambda evs: min(e["ts"] for e in evs))
+    for evs in roots:
+        root = next((e for e in evs if e["name"] == "request"), None)
+        head = root or min(evs, key=lambda e: e["ts"])
+        args = head["args"]
+        t0, t1 = head["ts"], head["ts"] + head["dur"]
+        n_recompiles = sum(1 for c in compiles if t0 <= c["ts"] + c["dur"] and c["ts"] <= t1)
+        decode_evs = [e for e in evs if e["name"] in ("decode", "decode_loop")]
+        decode_toks = sum(e["args"].get("tokens", 0) for e in decode_evs)
+        print(f"request uid={args.get('uid')} trace={args.get('trace_id')} "
+              f"[{args.get('state', '?')}"
+              f"{', ' + str(args.get('finish_reason')) if args.get('finish_reason') else ''}]")
+        print(f"  prompt/generated ..... {args.get('prompt_tokens', '?')}t / "
+              f"{args.get('generated', '?')}t")
+        print(f"  total ................ {head['dur'] * ms:10.3f} ms")
+        print(f"  queued ............... {total(evs, 'queued') * ms:10.3f} ms")
+        n_prefill = sum(1 for e in evs if e["name"] == "prefill")
+        print(f"  prefill .............. {total(evs, 'prefill') * ms:10.3f} ms "
+              f"({n_prefill} chunks)")
+        decode_total = total(evs, "decode") + total(evs, "decode_loop")
+        print(f"  decode ............... {decode_total * ms:10.3f} ms "
+              f"({len(decode_evs)} iterations, {decode_toks} tokens)")
+        print(f"  recompiles overlapped  {n_recompiles}")
+        print()
+    return 0
+
+
 def main(argv=None):
     argv = list(sys.argv[1:]) if argv is None else list(argv)
     if "--metrics-url" in argv:
@@ -76,6 +160,12 @@ def main(argv=None):
             print("usage: dstpu_report --metrics-url <host:port | http://...>")
             return 2
         return metrics_report(argv[idx + 1])
+    if "--trace" in argv:
+        idx = argv.index("--trace")
+        if idx + 1 >= len(argv):
+            print("usage: dstpu_report --trace <chrome-trace.json | flight-dump.json>")
+            return 2
+        return trace_report(argv[idx + 1])
     import deepspeed_tpu
     print("-" * 60)
     print("DeepSpeed-TPU C++/JAX environment report")

@@ -25,6 +25,8 @@ from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import RaggedBatchWrapper
 from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import PlaceholderSequenceDescriptor
 from deepspeed_tpu.inference.v2.scheduling_utils import SchedulingError, SchedulingResult
 from deepspeed_tpu.inference.v2.tracer import Tracer, get_tracer, set_tracer
+from deepspeed_tpu.telemetry import get_span_recorder as _tel_get_spans
+from deepspeed_tpu.telemetry import is_active as _tel_is_active
 from deepspeed_tpu.telemetry import now_us as _tel_now_us
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.logging import logger
@@ -60,17 +62,7 @@ class InferenceEngineV2:
         if engine_config.telemetry.enabled:
             from deepspeed_tpu import telemetry
             self._telemetry = telemetry.configure(engine_config.telemetry)
-            reg = self._telemetry.registry
-            self._tel_metrics = {
-                "batches": reg.counter("inference_batches_total", "Ragged batches executed"),
-                "tokens": reg.counter("inference_tokens_total", "Tokens scheduled into batches"),
-                "in_flight": reg.gauge("inference_in_flight_tokens",
-                                       "Tokens in the last ragged batch"),
-                "free_blocks": reg.gauge("inference_kv_free_blocks", "Free KV-cache blocks"),
-                "tracked": reg.gauge("inference_tracked_sequences", "Sequences tracked"),
-                "empty_runs": reg.counter("inference_empty_runs_total",
-                                          "EP lock-step forwards with zero tokens"),
-            }
+            self._tel_metrics = self._build_tel_metrics(self._telemetry.registry)
 
         # a ServingScheduler attaches here (serving/scheduler.py); close()
         # stops it so the engine can always be torn down safely
@@ -194,7 +186,8 @@ class InferenceEngineV2:
 
         self._batch.finalize()
         self._model.prepare_batch(self._batch)
-        if self._telemetry is not None:
+        spans = self._resolve_spans()
+        if spans is not None:
             _t0 = _tel_now_us()
         logits = self._model.forward(self._batch)
         assert logits.shape[0] == self._batch.current_sequences
@@ -203,22 +196,64 @@ class InferenceEngineV2:
             seq_desc = self._state_manager.get_sequence(uid)
             seq_desc.post_forward()
             self._model.maybe_free_kv(seq_desc)
-        if self._telemetry is not None:
+        metrics = self._resolve_tel_metrics()
+        if spans is not None or metrics is not None:
             n_tokens = int(sum(t.size for t in batch_tokens))
-            self._telemetry.spans.record("put", cat="inference", ts_us=_t0,
-                                         dur_us=_tel_now_us() - _t0,
-                                         args={"sequences": len(batch_uids),
-                                               "tokens": n_tokens})
-            self._write_telemetry(batch_tokens=n_tokens)
+        if spans is not None:
+            # uids link this batch span to the per-request serving traces
+            # (each uid's request track carries the same uid in its args)
+            spans.record("put", cat="inference", ts_us=_t0,
+                         dur_us=_tel_now_us() - _t0,
+                         args={"sequences": len(batch_uids),
+                               "tokens": n_tokens,
+                               "uids": [int(u) for u in batch_uids]})
+        if metrics is not None:
+            self._write_telemetry(metrics, batch_tokens=n_tokens)
         return logits
 
-    def _write_telemetry(self, batch_tokens: int) -> None:
-        m = self._tel_metrics
-        m["batches"].inc()
-        m["tokens"].inc(batch_tokens)
-        m["in_flight"].set(batch_tokens)
-        m["free_blocks"].set(self._state_manager.free_blocks)
-        m["tracked"].set(self._state_manager.n_tracked_sequences)
+    @staticmethod
+    def _build_tel_metrics(reg) -> dict:
+        return {
+            "batches": reg.counter("inference_batches_total", "Ragged batches executed"),
+            "tokens": reg.counter("inference_tokens_total", "Tokens scheduled into batches"),
+            "in_flight": reg.gauge("inference_in_flight_tokens",
+                                   "Tokens in the last ragged batch"),
+            "free_blocks": reg.gauge("inference_kv_free_blocks", "Free KV-cache blocks"),
+            "tracked": reg.gauge("inference_tracked_sequences", "Sequences tracked"),
+            "empty_runs": reg.counter("inference_empty_runs_total",
+                                      "EP lock-step forwards with zero tokens"),
+        }
+
+    def _resolve_tel_metrics(self) -> Optional[dict]:
+        """The inference_* families — always on the process-wide registry
+        (an engine session's registry IS ``telemetry.get_registry()``, the
+        singleton). With an engine-owned session the dict is built at init
+        and lives until ``close()``; otherwise it is built lazily and
+        returned only while a globally-configured session is active (the
+        serving quickstart configures telemetry process-wide, not per
+        engine), so a ``telemetry.shutdown()`` mid-process stops metric
+        writes along with spans. Disabled telemetry costs one boolean check
+        here."""
+        if self._telemetry is not None:
+            return self._tel_metrics
+        if not _tel_is_active():
+            return None
+        if self._tel_metrics is None:
+            from deepspeed_tpu import telemetry
+            self._tel_metrics = self._build_tel_metrics(telemetry.get_registry())
+        return self._tel_metrics
+
+    def _resolve_spans(self):
+        """The engine session's recorder — or a globally-configured
+        session's (same fallback policy as :meth:`_resolve_tel_metrics`)."""
+        return self._telemetry.spans if self._telemetry is not None else _tel_get_spans()
+
+    def _write_telemetry(self, metrics: dict, batch_tokens: int) -> None:
+        metrics["batches"].inc()
+        metrics["tokens"].inc(batch_tokens)
+        metrics["in_flight"].set(batch_tokens)
+        metrics["free_blocks"].set(self._state_manager.free_blocks)
+        metrics["tracked"].set(self._state_manager.n_tracked_sequences)
 
     # ------------------------------------------------------------ decode_loop --
     def decode_loop(self, batch_uids: Iterable[int], batch_tokens: Iterable,
@@ -272,16 +307,20 @@ class InferenceEngineV2:
             self._batch.insert_sequence(seq_desc, tokens, do_checks=do_checks)
 
         self._batch.finalize()
-        if self._telemetry is not None:
+        spans = self._resolve_spans()
+        if spans is not None:
             _t0 = _tel_now_us()
         tokens = self._model.decode_loop(self._batch, n_steps, temperature=temperature,
                                          rng=rng)  # [n_steps, S_bucket]
-        if self._telemetry is not None:
-            self._telemetry.spans.record("decode_loop", cat="inference", ts_us=_t0,
-                                         dur_us=_tel_now_us() - _t0,
-                                         args={"sequences": len(batch_uids),
-                                               "steps": n_steps})
-            self._write_telemetry(batch_tokens=len(batch_uids) * n_steps)
+        if spans is not None:
+            spans.record("decode_loop", cat="inference", ts_us=_t0,
+                         dur_us=_tel_now_us() - _t0,
+                         args={"sequences": len(batch_uids),
+                               "steps": n_steps,
+                               "uids": [int(u) for u in batch_uids]})
+        metrics = self._resolve_tel_metrics()
+        if metrics is not None:
+            self._write_telemetry(metrics, batch_tokens=len(batch_uids) * n_steps)
         for uid in batch_uids:
             seq_desc = self._state_manager.get_sequence(uid)
             seq_desc.post_forward()           # the token passed in
@@ -378,8 +417,9 @@ class InferenceEngineV2:
         engine_v2.py:308) — keeps idle replicas in lock-step with busy ones."""
         if self._tracer:
             self._tracer.init_batch(is_empty_run=True, num_layers=self._model.num_layers)
-        if self._telemetry is not None:
-            self._tel_metrics["empty_runs"].inc()
+        metrics = self._resolve_tel_metrics()
+        if metrics is not None:
+            metrics["empty_runs"].inc()
         self._model.empty_run()
 
     # -------------------------------------------------------------- serialize --

@@ -22,6 +22,7 @@ import numpy as np
 from deepspeed_tpu.inference.v2.ragged.manager_configs import KVCacheConfig
 from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import DSSequenceDescriptor
 from deepspeed_tpu.inference.v2.tracer import get_tracer, record
+from deepspeed_tpu.telemetry import compile_watch
 
 
 class DSTransformerModelBase:
@@ -187,7 +188,13 @@ class DSTransformerModelBase:
     def _get_compiled(self, bucket):
         import jax
         if bucket not in self._compiled:
-            self._compiled[bucket] = jax.jit(self._forward_impl, donate_argnums=(1, ))
+            fn = jax.jit(self._forward_impl, donate_argnums=(1, ))
+            cw = compile_watch.get()
+            if cw is not None:
+                # attribute the bucket's XLA compile (and any later internal
+                # recompile) to this site in the compile_* metrics and trace
+                fn = cw.wrap("inference_forward", bucket, fn)
+            self._compiled[bucket] = fn
         return self._compiled[bucket]
 
     # ------------------------------------------------------------ decode loop --
@@ -219,10 +226,14 @@ class DSTransformerModelBase:
         temperature = float(temperature)
         key = (bucket, int(n_steps), temperature > 0)
         if key not in self._compiled:
-            self._compiled[key] = jax.jit(
+            fn = jax.jit(
                 partial(self._decode_loop_impl, n_steps=int(n_steps),
                         sampled=temperature > 0),
                 donate_argnums=(1, ))
+            cw = compile_watch.get()
+            if cw is not None:
+                fn = cw.wrap("inference_decode_loop", key, fn)
+            self._compiled[key] = fn
         cache = self._state_manager.kv_cache.cache
         if temperature > 0 and rng is None:
             raise ValueError("decode_loop(temperature>0) requires an rng key — a fixed "

@@ -18,6 +18,7 @@ import numpy as np
 
 from deepspeed_tpu.inference.v2.ragged.manager_configs import DSStateManagerConfig
 from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import DSSequenceDescriptor
+from deepspeed_tpu.telemetry import compile_watch
 
 
 def to_padded(original_size: int) -> int:
@@ -95,6 +96,11 @@ class RaggedBatchWrapper:
         S = _pad_to(max(1, self.current_sequences), 8)
         mb = max((len(b) for b in self._seq_blocks), default=1)
         MB = _pow2_pad(mb, 4)
+        cw = compile_watch.get()
+        if cw is not None:
+            # (T, S, MB) IS the jit cache key downstream — the watch counts
+            # batch-to-batch bucket churn, the leading recompile indicator
+            cw.note_bucket((T, S, MB))
         n_tok = self.current_tokens
         n_seq = self.current_sequences
 

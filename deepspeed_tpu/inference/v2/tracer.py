@@ -14,8 +14,9 @@ device times in microseconds, matching the reference's summary schema.
 Memory: traces accumulate in a bounded ring buffer (``max_batches``, default
 1024) so long-running serving cannot leak; consumers should prefer
 ``drain_summaries()`` which frees what it returns. When a
-``span_recorder`` is attached (telemetry enabled), every recorded phase also
-emits a Chrome-trace span under the ``inference`` category.
+``span_recorder`` is attached (engine-owned telemetry session) — or, without
+one, while a globally-configured session is active — every recorded phase
+also emits a Chrome-trace span under the ``inference`` category.
 """
 
 import time
@@ -24,6 +25,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, List
 
+from deepspeed_tpu.telemetry import get_span_recorder as _tel_get_spans
 from deepspeed_tpu.telemetry import now_us
 
 RECORD_NAMES = ["attn", "ffn", "moe_a2a_1", "moe_a2a_2", "moe_ffn", "moe_a2a_3"]
@@ -39,6 +41,8 @@ class BatchTraceHolder:
     seen_tokens: Any = field(default_factory=list)
     in_flight_tokens: Any = field(default_factory=list)
     traces: Any = field(default_factory=list)  # (name, elapsed_us)
+    uids: Any = field(default_factory=list)  # constituent request/sequence uids
+    uids_view: Any = None  # one frozen copy shared by all this batch's spans
 
 
 @dataclass
@@ -52,6 +56,7 @@ class BatchTraceSummary:
     record_exec_times: Any  # [num_layers][len(record_names)] in us
     embed: int
     unembed: int
+    uids: List[int] = field(default_factory=list)
 
 
 class Tracer:
@@ -76,15 +81,30 @@ class Tracer:
     def add_sequence(self, seq_desc) -> None:
         self._cur.seen_tokens.append(seq_desc.seen_tokens)
         self._cur.in_flight_tokens.append(seq_desc.in_flight_tokens)
+        # descriptors are duck-typed here; -1 marks one with no engine uid
+        self._cur.uids.append(int(getattr(seq_desc, "tracking_id", -1)))
 
     def add_trace(self, name: str, elapsed_us: int, ts_us: int = None) -> None:
         if self._cur is None:
             return
         self._cur.traces.append((name, elapsed_us))
-        if self.span_recorder is not None:
-            self.span_recorder.record(name, cat="inference", ts_us=ts_us,
-                                      dur_us=elapsed_us,
-                                      args={"batch_id": self._cur.batch_id})
+        # the recorder bound at construction (engine-owned session) — or a
+        # globally-configured session's, resolved per record like engine_v2's
+        # span fallback, so the process-wide-configure pattern gets per-layer
+        # phases too; disabled telemetry pays one global read
+        spans = self.span_recorder if self.span_recorder is not None else _tel_get_spans()
+        if spans is not None:
+            # uids join each per-layer phase against the serving request
+            # traces composed into this ragged batch; snapshot ONCE on the
+            # first phase (sequences are all inserted before the forward
+            # runs) — num_layers * len(RECORD_NAMES) spans share the copy
+            uids = self._cur.uids_view
+            if uids is None:
+                uids = self._cur.uids_view = [int(u) for u in self._cur.uids]
+            spans.record(name, cat="inference", ts_us=ts_us,
+                         dur_us=elapsed_us,
+                         args={"batch_id": self._cur.batch_id,
+                               "uids": uids})
 
     def _summarize(self, bt: BatchTraceHolder) -> BatchTraceSummary:
         traces = list(bt.traces)
@@ -111,7 +131,8 @@ class Tracer:
                                  record_names=RECORD_NAMES,
                                  record_exec_times=exec_times,
                                  embed=embed,
-                                 unembed=unembed)
+                                 unembed=unembed,
+                                 uids=list(bt.uids))
 
     def batch_summaries(self):
         """Summaries of everything still buffered (non-destructive)."""

@@ -572,6 +572,13 @@ class DeepSpeedEngine:
         return sub
 
     # ------------------------------------------------------------- jit builds --
+    def _watched_jit(self, fn, key):
+        """Put a fresh jit cache entry under the compile watch (telemetry's
+        recompile accounting; a no-op single check when disabled)."""
+        from deepspeed_tpu.telemetry import compile_watch
+        cw = compile_watch.get()
+        return cw.wrap("train", key, fn) if cw is not None else fn
+
     def _grad_fn(self):
         import jax
 
@@ -598,7 +605,8 @@ class DeepSpeedEngine:
             from deepspeed_tpu.runtime.comm.quantized_grads import make_qgz_micro_grads
             fn = make_qgz_micro_grads(loss_fn, takes_rng, self.compute_dtype, accum_dtype, self.mesh)
 
-        self._compiled["grad"] = jax.jit(fn, out_shardings=(None, self._grad_shardings))
+        self._compiled["grad"] = self._watched_jit(
+            jax.jit(fn, out_shardings=(None, self._grad_shardings)), "grad")
         return self._compiled["grad"]
 
     def _eval_fn(self):
@@ -619,7 +627,7 @@ class DeepSpeedEngine:
                     cp = cast_params(params)
                     out = loss_fn(cp, batch, rng_value) if takes_rng else loss_fn(cp, batch)
                     return out[0] if isinstance(out, tuple) else out
-                return jax.jit(fn)
+                return self._watched_jit(jax.jit(fn), "eval_loss")
 
             self._compiled["eval_loss"] = make(None)
             self._compiled["eval_fallback"] = (lambda: make(jax.random.PRNGKey(0))) if takes_rng else None
@@ -628,20 +636,21 @@ class DeepSpeedEngine:
     def _accum_fn(self):
         import jax
         if "accum" not in self._compiled:
-            self._compiled["accum"] = jax.jit(
-                lambda acc, g: jax.tree.map(lambda a, b: a + b, acc, g),
-                donate_argnums=(0, ),
-                out_shardings=self._grad_shardings)
+            self._compiled["accum"] = self._watched_jit(
+                jax.jit(lambda acc, g: jax.tree.map(lambda a, b: a + b, acc, g),
+                        donate_argnums=(0, ),
+                        out_shardings=self._grad_shardings), "accum")
         return self._compiled["accum"]
 
     def _apply_fn(self):
         import jax
 
         if "apply" not in self._compiled:
-            self._compiled["apply"] = jax.jit(
-                self._apply_fn_inner(),
-                donate_argnums=(0, 1, 2),
-                out_shardings=(self._param_shardings, self._opt_shardings, None, None, None))
+            self._compiled["apply"] = self._watched_jit(
+                jax.jit(self._apply_fn_inner(),
+                        donate_argnums=(0, 1, 2),
+                        out_shardings=(self._param_shardings, self._opt_shardings,
+                                       None, None, None)), "apply")
         return self._compiled["apply"]
 
     def _train_batch_fn(self):
@@ -688,10 +697,11 @@ class DeepSpeedEngine:
             new_params, new_opt, new_scale, norm, overflow = apply_inner(params, opt_state, acc, scale_state, lr)
             return new_params, new_opt, new_scale, jnp.mean(losses), norm, overflow
 
-        self._compiled["train_batch"] = jax.jit(
-            fn,
-            donate_argnums=(0, 1),
-            out_shardings=(self._param_shardings, self._opt_shardings, None, None, None, None))
+        self._compiled["train_batch"] = self._watched_jit(
+            jax.jit(fn,
+                    donate_argnums=(0, 1),
+                    out_shardings=(self._param_shardings, self._opt_shardings,
+                                   None, None, None, None)), "train_batch")
         return self._compiled["train_batch"]
 
     def _apply_fn_inner(self):

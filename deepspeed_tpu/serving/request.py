@@ -27,6 +27,8 @@ from typing import Iterator, List, Optional
 
 import numpy as np
 
+from deepspeed_tpu.telemetry import now_us
+
 
 class RequestState(Enum):
     QUEUED = 0
@@ -113,12 +115,18 @@ class Request:
         self.seed = int(seed)
 
         self.uid: Optional[int] = None  # assigned at admission by the scheduler
+        # distributed-tracing identity: the scheduler assigns both when a
+        # telemetry session is active; every lifecycle span parents under
+        # root_span_id and the HTTP layer returns trace_id to the client
+        self.trace_id: Optional[str] = None
+        self.root_span_id: Optional[int] = None
         self.tokens: List[int] = []
         self.stream = TokenStream()
         self.error: Optional[str] = None
         self.finish_reason: Optional[str] = None  # "eos" | "length" | "context"
 
         self.arrival_s = time.monotonic()
+        self.arrival_us = now_us()  # span-clock arrival (perf_counter domain)
         self.deadline = (self.arrival_s + deadline_s) if deadline_s is not None else None
         self.first_token_s: Optional[float] = None
         self.finished_s: Optional[float] = None

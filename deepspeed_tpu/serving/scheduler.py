@@ -35,16 +35,22 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from deepspeed_tpu import telemetry
 from deepspeed_tpu.inference.v2.scheduling_utils import SchedulingError, SchedulingResult
 from deepspeed_tpu.serving.config import ServingConfig
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import Request, RequestState
+from deepspeed_tpu.telemetry import new_span_id, new_trace_id, now_us
+from deepspeed_tpu.telemetry.flight_recorder import SERVING_SCHEDULER_CHANNEL
 from deepspeed_tpu.utils.logging import logger
 
 # ticks with active requests but nothing engine-schedulable before the
 # scheduler declares them wedged (covers allocator corner cases the
 # permanent-infeasibility admission checks cannot see)
 _STARVATION_FAIL_TICKS = 5000
+
+# flight-recorder channel disambiguator for multiple schedulers per process
+_SCHEDULER_IDS = itertools.count()
 
 
 class QueueFullError(RuntimeError):
@@ -70,6 +76,10 @@ class ServingScheduler:
         self._engine = engine
         self._config = config or ServingConfig()
         self._metrics = ServingMetrics.maybe_create()
+        # per-instance channel: two schedulers under one telemetry session
+        # must not clobber each other's provider or heartbeat watch
+        self._flight_channel = f"{SERVING_SCHEDULER_CHANNEL}:{next(_SCHEDULER_IDS)}"
+        self._flight = None
 
         self._lock = threading.Lock()
         self._not_full = threading.Condition(self._lock)
@@ -90,11 +100,41 @@ class ServingScheduler:
         self._capacity_blocks = engine._state_manager.kv_cache.num_blocks
 
         engine._serving_scheduler = self
+        # armed last: flight_state() must never observe a half-built
+        # scheduler, and an __init__ that raises must not leak a provider or
+        # a watched channel (which would guarantee a spurious stall dump);
+        # a manually-step()ped scheduler (start=False) has no loop to watch
+        self._attach_flight(telemetry.get_flight_recorder(), watch=start)
         self._thread = None
         if start:
             self._thread = threading.Thread(target=self._run, name="dstpu-serving-scheduler",
                                             daemon=True)
             self._thread.start()
+
+    @property
+    def _spans(self):
+        """The live SpanRecorder (None while telemetry is off) — resolved per
+        use, like engine_v2's span/metric fallback, so a telemetry
+        reconfigure mid-serve cannot strand the scheduler on a displaced
+        recorder; each hot-path use stays one global read + None check."""
+        return telemetry.get_span_recorder()
+
+    def _attach_flight(self, flight, watch: bool = True) -> None:
+        """Move this scheduler's state provider + watchdog channel to
+        ``flight``: a telemetry reconfigure replaces the process-wide
+        recorder, and dumps/stall detection must follow it (the loop
+        re-attaches whenever the recorder changes)."""
+        old = self._flight
+        if old is flight:
+            return
+        if old is not None:
+            old.unwatch_heartbeat(self._flight_channel)
+            old.unregister_provider(self._flight_channel)
+        self._flight = flight
+        if flight is not None:
+            flight.register_provider(self._flight_channel, self.flight_state)
+            if watch:
+                flight.watch_heartbeat(self._flight_channel)
 
     # ------------------------------------------------------------- submission --
     def submit(self,
@@ -117,6 +157,11 @@ class ServingScheduler:
                       deadline_s=deadline_s if deadline_s is not None
                       else self._config.default_deadline_s,
                       seed=seed)
+        if self._spans is not None:
+            # trace identity is assigned at admission so the HTTP layer can
+            # hand the id back in response headers before streaming begins
+            req.trace_id = new_trace_id()
+            req.root_span_id = new_span_id()
         with self._not_full:
             if self._stopping:
                 raise SchedulerStopped("scheduler is stopping; not admitting requests")
@@ -192,6 +237,13 @@ class ServingScheduler:
                 req.uid = next(self._uids)
                 req._set_state(RequestState.PREFILL)
                 self._active[req.uid] = req
+                spans = self._spans  # bind once: the property re-resolves
+                if spans is not None:
+                    spans.record("queued", cat="serving", ts_us=req.arrival_us,
+                                 dur_us=now_us() - req.arrival_us,
+                                 trace_id=req.trace_id,
+                                 parent_id=req.root_span_id,
+                                 args={"uid": req.uid})
             if self._metrics:
                 self._metrics.queue_depth.set(len(self._queue))
                 self._metrics.in_flight.set(len(self._active))
@@ -315,6 +367,23 @@ class ServingScheduler:
         now = time.monotonic()
         for req, _ in plan:
             req._last_touch_s = now
+        spans = self._spans
+        if spans is not None:
+            # capture each request's phase before the processing loop mutates
+            # state (PREFILL flips to DECODE on the final chunk)
+            _t0 = now_us()
+            _phases = [("prefill" if req.state is RequestState.PREFILL else "decode",
+                        int(toks.size)) for req, toks in plan]
+
+        def _record_phase_spans(counts=None):
+            if spans is None:
+                return
+            end = now_us()
+            for i, ((phase, ntok), (req, _)) in enumerate(zip(_phases, plan)):
+                spans.record(phase, cat="serving", ts_us=_t0, dur_us=end - _t0,
+                             trace_id=req.trace_id, parent_id=req.root_span_id,
+                             args={"uid": req.uid,
+                                   "tokens": ntok if counts is None else counts[i]})
 
         K = self._config.decode_chunk
         max_context = self._engine._config.state_manager.max_context
@@ -336,16 +405,23 @@ class ServingScheduler:
             except SchedulingError:
                 rows = None  # KV too tight for K steps — single-step fallback
             if rows is not None:
-                for (req, _), row in zip(plan, rows):
+                # record before pushing: the final token finalizes the request
+                # and closes the root span, which children must nest inside —
+                # with the kept-token counts driving BOTH the span args and
+                # the push loop, so trace and stream cannot disagree
+                counts = [self._kept_tokens(req, row)
+                          for (req, _), row in zip(plan, rows)]
+                _record_phase_spans(counts=counts)
+                for (req, _), row, kept in zip(plan, rows, counts):
                     prev = req._last_token_s
                     pushed = 0
-                    for tok in row:
+                    for tok in row[:kept]:  # eos/cap discard the over-generated tail
                         self._push_token(req, int(tok), record_itl=False)
                         pushed += 1
                         if req.finished:
-                            break  # discard over-generated tokens past eos/cap
-                    else:
-                        req._next = int(row[-1])
+                            break  # _push_token's rules stay the authority
+                    if not req.finished:
+                        req._next = int(row[kept - 1])
                     if self._metrics and prev is not None and pushed:
                         # the chunk arrives as one burst: record the dispatch
                         # gap amortized per token, so ITL reflects the cadence
@@ -363,6 +439,7 @@ class ServingScheduler:
             for req, _ in plan:
                 self._finalize(req, RequestState.FAILED, error=f"engine error: {e}")
             return
+        _record_phase_spans()
         for i, (req, toks) in enumerate(plan):
             if req.state is RequestState.PREFILL:
                 req._fed += toks.size
@@ -373,6 +450,20 @@ class ServingScheduler:
             self._push_token(req, nxt)
             if not req.finished:
                 req._next = nxt
+
+    @staticmethod
+    def _kept_tokens(req: Request, row) -> int:
+        """How many of a decode-loop ``row``'s tokens the client will receive
+        — the device loop always runs K steps; eos / the max_new_tokens cap
+        cut the tail. Mirrors :meth:`_push_token`'s termination rules (the
+        per-token authority); keep the two in lock-step."""
+        n = 0
+        for tok in row:
+            n += 1
+            if ((req.eos_token_id is not None and int(tok) == req.eos_token_id)
+                    or len(req.tokens) + n >= req.max_new_tokens):
+                break
+        return n
 
     @staticmethod
     def _sample(req: Request, row: np.ndarray) -> int:
@@ -420,6 +511,17 @@ class ServingScheduler:
                 self._engine.flush(req.uid)  # returns KV blocks (incl. offloaded)
         req._set_state(state)
         self._counters[self._FINAL_COUNTER[state]] += 1
+        spans = self._spans  # bind once: the property re-resolves
+        if spans is not None and req.trace_id is not None:
+            # the trace's root: arrival → terminal state, with the ids every
+            # lifecycle child span parented under
+            spans.record("request", cat="serving", ts_us=req.arrival_us,
+                         dur_us=now_us() - req.arrival_us,
+                         trace_id=req.trace_id, span_id=req.root_span_id,
+                         args={"uid": req.uid, "state": state.name,
+                               "finish_reason": req.finish_reason,
+                               "prompt_tokens": int(req.prompt.size),
+                               "generated": len(req.tokens)})
         if self._metrics:
             {RequestState.DONE: self._metrics.completions,
              RequestState.CANCELLED: self._metrics.cancellations,
@@ -431,6 +533,11 @@ class ServingScheduler:
     # ------------------------------------------------------------------ loop --
     def _run(self) -> None:
         while not self._shutdown:
+            flight = telemetry.get_flight_recorder()
+            if flight is not self._flight:
+                self._attach_flight(flight)
+            if flight is not None:
+                flight.heartbeat(self._flight_channel)
             try:
                 progressed = self.step()
             except Exception:  # pragma: no cover - must never kill the thread
@@ -487,6 +594,7 @@ class ServingScheduler:
             self._finalize(self._queue.popleft(), RequestState.CANCELLED)
         if getattr(self._engine, "_serving_scheduler", None) is self:
             self._engine._serving_scheduler = None
+        self._attach_flight(None)
         self._stopped = True
 
     def __enter__(self):
@@ -504,15 +612,59 @@ class ServingScheduler:
     def n_active(self) -> int:
         return len(self._active)
 
-    def stats(self) -> dict:
-        active = list(self._active.values())
+    def _snapshot_requests(self) -> Tuple[List[Request], List[Request]]:
+        """(queued, active) request lists copied for reader threads (stats /
+        flight dumps). Prefers a brief lock so the copy is consistent with
+        admission; falls back to a lockless copy (GIL-atomic in CPython) when
+        the scheduler thread is wedged holding the lock — a flight dump of a
+        stalled loop must never block on that same loop's lock."""
+        locked = self._lock.acquire(timeout=0.2)
+        try:
+            return list(self._queue), list(self._active.values())
+        finally:
+            if locked:
+                self._lock.release()
+
+    @staticmethod
+    def _request_row(req: Request, now: float) -> dict:
         return {
-            "queue_depth": len(self._queue),
+            "uid": req.uid,
+            "state": req.state.name,
+            "prompt_tokens": int(req.prompt.size),
+            "generated": len(req.tokens),
+            "age_s": now - req.arrival_s,
+            "ttft_s": req.ttft_s,
+            "trace_id": req.trace_id,
+        }
+
+    def _latency_percentiles(self) -> Optional[dict]:
+        """p50/p95/p99 TTFT/ITL/e2e from the telemetry histograms' buckets
+        (Histogram.quantile) — None when telemetry is disabled."""
+        if not self._metrics:
+            return None
+        out = {}
+        for name, hist in (("ttft_s", self._metrics.ttft),
+                           ("itl_s", self._metrics.itl),
+                           ("e2e_s", self._metrics.e2e)):
+            out[name] = {f"p{int(q * 100)}": hist.quantile(q)
+                         for q in (0.5, 0.95, 0.99)}
+        return out
+
+    def stats(self) -> dict:
+        queued, active = self._snapshot_requests()
+        return self._stats_doc(queued, active)
+
+    def _stats_doc(self, queued: List[Request], active: List[Request]) -> dict:
+        now = time.monotonic()
+        return {
+            "queue_depth": len(queued),
             "active": {
                 "total": len(active),
                 "prefill": sum(1 for r in active if r.state is RequestState.PREFILL),
                 "decode": sum(1 for r in active if r.state is RequestState.DECODE),
             },
+            "requests": [self._request_row(r, now) for r in active],
+            "latency": self._latency_percentiles(),
             "counters": dict(self._counters),
             "engine": {
                 "free_blocks": self._engine.free_blocks,
@@ -521,3 +673,29 @@ class ServingScheduler:
             "draining": self._stopping,
             "uptime_s": time.monotonic() - self._start_s,
         }
+
+    def flight_state(self) -> dict:
+        """The flight recorder's view: ``stats()`` plus queued-request rows,
+        per-request scheduler internals and KV occupancy — everything a
+        post-mortem of a wedged loop needs."""
+        now = time.monotonic()
+        queued, active = self._snapshot_requests()
+        doc = self._stats_doc(queued, active)
+        doc["queued_requests"] = [self._request_row(r, now) for r in queued]
+        engine = self._engine
+        rows = []
+        for req in active:
+            row = self._request_row(req, now)
+            seq = engine._state_manager.get_sequence(req.uid)
+            row.update(
+                fed_tokens=req._fed,
+                deferred_ticks=req._deferred,
+                deadline_in_s=(req.deadline - now) if req.deadline is not None else None,
+                kv_blocks=seq.cur_allocated_blocks if seq is not None else 0,
+                offloaded=engine.is_offloaded(req.uid),
+            )
+            rows.append(row)
+        doc["requests"] = rows
+        doc["engine"]["capacity_blocks"] = self._capacity_blocks
+        doc["starved_ticks"] = self._starved_ticks
+        return doc

@@ -25,8 +25,15 @@ ephemeral-port support), serving the request lifecycle instead of metrics:
   stalls the handler thread until the queue drains. During shutdown new
   requests get **503**.
 
-- ``GET /v1/stats`` — scheduler + engine occupancy JSON.
+- ``GET /v1/stats`` — scheduler + engine occupancy JSON: per-request rows
+  (uid, state, age, trace id) and p50/p95/p99 TTFT/ITL/e2e when telemetry is
+  active.
 - ``GET /healthz`` — liveness (same contract as the telemetry exporter).
+
+With a telemetry session active every request is traced end-to-end: the
+``X-DSTPU-Trace-Id`` response header (both response modes) and the ``uid``/
+``trace_id`` fields of the final JSON / SSE ``done`` event let a client join
+its request against the exported Chrome trace / flight-recorder dump.
 
 ``stop()`` drains gracefully: admission stops (503), in-flight requests run to
 completion bounded by ``config.drain_timeout_s``, stragglers are CANCELLED,
@@ -47,8 +54,12 @@ from deepspeed_tpu.utils.logging import logger
 _MAX_BODY_BYTES = 8 << 20  # an 8 MiB prompt is already ~2M tokens of JSON
 
 
+TRACE_HEADER = "X-DSTPU-Trace-Id"
+
+
 def _request_doc(req: Request) -> dict:
     return {
+        "uid": req.uid,
         "tokens": list(req.tokens),
         "n_tokens": len(req.tokens),
         "state": req.state.name,
@@ -56,6 +67,7 @@ def _request_doc(req: Request) -> dict:
         "error": req.error,
         "ttft_s": req.ttft_s,
         "e2e_s": req.e2e_s,
+        "trace_id": req.trace_id,
     }
 
 
@@ -93,11 +105,13 @@ class ServingServer:
 
         class Handler(BaseHTTPRequestHandler):
 
-            def _send_json(self, code, doc):
+            def _send_json(self, code, doc, trace_id=None):
                 data = json.dumps(doc).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                if trace_id is not None:
+                    self.send_header(TRACE_HEADER, trace_id)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -155,12 +169,16 @@ class ServingServer:
                     self._stream_sse(req)
                 else:
                     req.wait()  # terminal by deadline/max_new_tokens/cancel
-                    self._send_json(200, _request_doc(req))
+                    self._send_json(200, _request_doc(req), trace_id=req.trace_id)
 
             def _stream_sse(self, req):
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
+                if req.trace_id is not None:
+                    # the trace id is known at admission, so streaming clients
+                    # get it up-front (it repeats in the final `done` event)
+                    self.send_header(TRACE_HEADER, req.trace_id)
                 self.end_headers()
                 try:
                     for i, tok in enumerate(req.stream):

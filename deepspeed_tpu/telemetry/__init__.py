@@ -14,26 +14,33 @@ Usage::
 
     from deepspeed_tpu import telemetry
     session = telemetry.configure(TelemetryConfig(enabled=True, ...))
-    telemetry.get_registry().counter("my_total").inc()
+    telemetry.get_registry().counter('my_total').inc()  # catalog new names!
     session.close()
 """
 
 import threading
 
-from deepspeed_tpu.telemetry.config import TelemetryConfig, TelemetryHTTPConfig
+from deepspeed_tpu.telemetry import compile_watch as compile_watch
+from deepspeed_tpu.telemetry.config import (FlightRecorderConfig, TelemetryConfig,
+                                            TelemetryHTTPConfig)
 from deepspeed_tpu.telemetry.exporter import (TelemetryHTTPServer, scrape_metrics,
                                               start_http_server)
+from deepspeed_tpu.telemetry.flight_recorder import FlightRecorder
 from deepspeed_tpu.telemetry.registry import (Counter, Gauge, Histogram, MetricsRegistry,
                                               parse_prometheus_text)
-from deepspeed_tpu.telemetry.spans import Span, SpanRecorder, TracingTimers, now_us
+from deepspeed_tpu.telemetry.spans import (Span, SpanRecorder, TracingTimers,
+                                           current_trace, new_span_id, new_trace_id,
+                                           now_us, trace_context)
 from deepspeed_tpu.utils.logging import logger
 
 __all__ = [
-    "TelemetryConfig", "TelemetryHTTPConfig", "MetricsRegistry", "Counter", "Gauge",
-    "Histogram", "SpanRecorder", "Span", "TracingTimers", "TelemetryHTTPServer",
-    "TelemetrySession", "configure", "shutdown", "get_registry", "get_span_recorder",
+    "TelemetryConfig", "TelemetryHTTPConfig", "FlightRecorderConfig", "MetricsRegistry",
+    "Counter", "Gauge", "Histogram", "SpanRecorder", "Span", "TracingTimers",
+    "TelemetryHTTPServer", "TelemetrySession", "FlightRecorder", "configure",
+    "shutdown", "get_registry", "get_span_recorder", "get_flight_recorder",
     "is_active", "record_comm_op", "wrap_timers", "start_http_server", "scrape_metrics",
-    "parse_prometheus_text", "state", "now_us",
+    "parse_prometheus_text", "state", "now_us", "new_trace_id", "new_span_id",
+    "trace_context", "current_trace", "compile_watch",
 ]
 
 # comm-op latencies live well under the default buckets' top decades; bytes
@@ -50,6 +57,7 @@ class _TelemetryState:
         self.registry = None
         self.spans = None
         self.session = None
+        self.flight_recorder = None
         self._lock = threading.RLock()
         self._comm_metrics = {}
 
@@ -68,6 +76,11 @@ def get_registry():
 
 def get_span_recorder():
     return state.spans
+
+
+def get_flight_recorder():
+    """The active :class:`FlightRecorder` (None unless configured)."""
+    return state.flight_recorder
 
 
 def is_active():
@@ -100,7 +113,24 @@ class TelemetrySession:
         if config.http.enabled and self.exporting:
             self.server = start_http_server(self.registry, spans=self.spans,
                                             host=config.http.host, port=config.http.port)
+        self.compile_watch = (compile_watch.install(self.registry, spans=self.spans)
+                              if config.compile_watch else None)
+        self.flight_recorder = None
+        if config.flight_recorder.enabled:
+            if config.flight_recorder.watchdog_enabled and self.compile_watch is None:
+                # without wrapped-call occupancy the watchdog cannot tell a
+                # long XLA compile from a wedged loop and will false-positive
+                logger.warning(
+                    "telemetry: flight-recorder watchdog is on but compile_watch "
+                    "is off — a loop blocked in a long XLA compile gets no stall "
+                    f"amnesty; raise watchdog_stall_s "
+                    f"(={config.flight_recorder.watchdog_stall_s}s) past your "
+                    "longest compile or re-enable compile_watch")
+            self.flight_recorder = FlightRecorder(config.flight_recorder,
+                                                  self.registry,
+                                                  spans=self.spans).install()
         state.spans = self.spans
+        state.flight_recorder = self.flight_recorder
         state.session = self
         state.active = True
 
@@ -125,13 +155,21 @@ class TelemetrySession:
         if self.server is not None:
             self.server.stop()
             self.server = None
+        if self.flight_recorder is not None:
+            self.flight_recorder.close()
+        if self.compile_watch is not None:
+            compile_watch.uninstall(self.compile_watch)
+            self.compile_watch = None
         if state.session is self:
             self.registry.close_jsonl()
             state.active = False
             state.session = None
             state.spans = None
+            if state.flight_recorder is self.flight_recorder:
+                state.flight_recorder = None
             with state._lock:
                 state._comm_metrics.clear()
+        self.flight_recorder = None
 
 
 def configure(config) -> TelemetrySession:

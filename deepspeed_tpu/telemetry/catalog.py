@@ -1,0 +1,57 @@
+"""Metric-family catalog: the single source of truth for every metric the
+codebase can register on the unified registry.
+
+Two invariants, both unit-enforced by ``tests/unit/telemetry/test_metrics_docs.py``:
+
+1. every family here appears in a README metric table (and vice versa) — a
+   new metric cannot land undocumented;
+2. every string-literal ``counter("...")``/``gauge``/``histogram`` name in the
+   source tree appears here — a new metric cannot dodge the catalog either.
+
+Keep entries grouped by owning subsystem; the value is the one-line
+description the README table should carry (wording may differ — the test
+diffs *names*, not prose).
+"""
+
+METRIC_FAMILIES = {
+    # training engine (runtime/engine.py _write_telemetry)
+    "train_loss": "last boundary-step training loss",
+    "train_lr": "current learning rate",
+    "train_samples_per_sec": "boundary-to-boundary throughput",
+    "train_grad_norm": "global gradient norm at the last step",
+    "train_skipped_steps": "overflow-skipped optimizer steps",
+    "train_global_steps": "optimizer steps taken",
+    "train_samples_total": "samples consumed",
+    # comms layer (telemetry/__init__.record_comm_op)
+    "comm_op_latency_seconds": "per-collective wall latency",
+    "comm_op_bytes": "per-collective message size",
+    "comm_ops_total": "collectives executed",
+    # v2 inference engine (inference/v2/engine_v2.py)
+    "inference_batches_total": "ragged batches executed",
+    "inference_tokens_total": "tokens scheduled into batches",
+    "inference_in_flight_tokens": "tokens in the last ragged batch",
+    "inference_kv_free_blocks": "free KV-cache blocks",
+    "inference_tracked_sequences": "sequences tracked",
+    "inference_empty_runs_total": "EP lock-step forwards with zero tokens",
+    # serving layer (serving/metrics.py)
+    "serving_queue_depth": "requests waiting for admission",
+    "serving_in_flight_requests": "requests in PREFILL or DECODE",
+    "serving_ttft_seconds": "submission to first generated token",
+    "serving_inter_token_seconds": "gap between consecutive streamed tokens",
+    "serving_e2e_latency_seconds": "submission to terminal state",
+    "serving_admissions_total": "requests accepted into the queue",
+    "serving_rejections_total": "requests rejected by backpressure",
+    "serving_completions_total": "requests finished DONE",
+    "serving_timeouts_total": "requests that hit their deadline",
+    "serving_cancellations_total": "requests cancelled mid-flight",
+    "serving_failures_total": "requests that FAILED",
+    "serving_kv_evictions_total": "idle sequences offloaded under KV pressure",
+    # compile watch (telemetry/compile_watch.py)
+    "compile_cache_misses_total": "XLA backend compiles (jit cache misses), by site",
+    "compile_seconds_total": "cumulative XLA compile wall seconds, by site",
+    "compile_cache_entries": "live jit cache entries created at each site",
+    "compile_bucket_switches_total": "ragged batches landing in a pad bucket not recently seen",
+    # flight recorder (telemetry/flight_recorder.py)
+    "flight_recorder_dumps_total": "flight-recorder dumps written, by trigger",
+    "serving_stalled_total": "watchdog detections of a stalled scheduler loop",
+}

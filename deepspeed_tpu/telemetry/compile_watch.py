@@ -1,0 +1,208 @@
+"""XLA recompilation watch.
+
+The repo's top TPU perf hazard is silent recompilation (see
+``inference/v2/ragged/ragged_wrapper.py`` — every distinct padded batch bucket
+is one compiled program, and a shape that slips past the bucketing recompiles
+the decode path mid-traffic). This module makes recompiles measurable:
+
+- A process-wide ``jax.monitoring`` duration listener catches every XLA
+  backend compile (``/jax/core/compile/backend_compile_duration``) and turns
+  it into ``compile_cache_misses_total``/``compile_seconds_total`` metrics, a
+  ``xla_compile`` span (so recompiles show up inline in traces, attributed to
+  whatever request/batch was running) and a JSONL event carrying the
+  triggering key.
+- ``wrap(site, key, fn)`` hooks a jit-cache entry at its creation site (the
+  training engine's ``_compiled`` builds, the inference model's per-bucket
+  forward/decode programs): every call through the wrapper makes the site and
+  cache key ambient, so a compile firing inside is attributed to it — including
+  shape-triggered recompiles jax performs internally on a cached callable.
+- ``note_bucket(bucket)`` hooks the ragged batch bucketing
+  (``RaggedBatchWrapper.finalize``): a batch landing in a bucket NOT seen
+  among the last few distinct buckets increments
+  ``compile_bucket_switches_total`` — shape churn that predicts (and
+  explains) cache misses. The recently-seen window matters: steady-state
+  SplitFuse traffic alternates prefill and decode buckets every batch, and
+  counting those (already-compiled) alternations would saturate the metric
+  with noise.
+
+Hot-path contract: when telemetry is disabled ``get()`` is None and every call
+site is a single global-read + None check; the monitoring listener is
+registered at most once per process and forwards nothing while disabled.
+"""
+
+import threading
+from collections import OrderedDict
+from contextvars import ContextVar
+
+from deepspeed_tpu.telemetry.spans import now_us
+
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+# ambient (site, key) while a wrapped jit callable executes
+_SITE_CTX: ContextVar = ContextVar("dstpu_compile_site", default=None)
+
+# wrapped-call occupancy BY THREAD, module-global (like _SITE_CTX) so a
+# telemetry reconfigure mid-call cannot strand the in-flight occupancy on a
+# displaced watch: the flight-recorder watchdog uses this to tell "this
+# loop's thread is blocked in a long XLA compile" apart from a genuinely
+# wedged loop — per-thread, so a co-located trainer's watched calls grant no
+# amnesty to a wedged serving loop
+_OCCUPANCY_LOCK = threading.Lock()
+_ACTIVE_THREADS = {}  # thread ident -> wrapped-call depth
+
+_WATCH = None  # the active CompileWatch, None when telemetry is disabled
+_LISTENER_LOCK = threading.Lock()
+_LISTENER_REGISTERED = False
+
+METRIC_NAMES = ("compile_cache_misses_total", "compile_seconds_total",
+                "compile_cache_entries", "compile_bucket_switches_total")
+
+
+def get():
+    """The active watch (None disabled) — the one check on hot paths."""
+    return _WATCH
+
+
+def _on_event_duration(event, duration_secs, **kwargs):
+    watch = _WATCH
+    if watch is not None and event == _BACKEND_COMPILE_EVENT:
+        watch._record_compile(duration_secs)
+
+
+def _ensure_listener():
+    """Register the jax.monitoring listener once per process (jax offers no
+    per-listener unregister; the callback is a no-op while ``_WATCH`` is
+    None, so leaving it registered is free)."""
+    global _LISTENER_REGISTERED
+    with _LISTENER_LOCK:
+        if _LISTENER_REGISTERED:
+            return
+        try:
+            import jax.monitoring
+            jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
+            _LISTENER_REGISTERED = True
+        except Exception:  # pragma: no cover - jax too old / absent: the
+            # wrap()/note_bucket() site hooks still count entries and switches
+            _LISTENER_REGISTERED = True
+
+
+class CompileWatch:
+    """Compile accounting on one registry + span recorder pair."""
+
+    def __init__(self, registry, spans=None):
+        self._registry = registry
+        self._spans = spans
+        self._lock = threading.Lock()
+        self._site_metrics = {}  # site -> (misses counter, seconds counter, entries gauge)
+        self._recent_buckets = OrderedDict()  # LRU of the last distinct buckets
+        self._bucket_switches = registry.counter(
+            "compile_bucket_switches_total",
+            "Ragged batches landing in a pad bucket not recently seen")
+
+    def _metrics_for(self, site):
+        with self._lock:
+            m = self._site_metrics.get(site)
+            if m is None:
+                labels = {"site": site}
+                m = (self._registry.counter(
+                         "compile_cache_misses_total",
+                         "XLA backend compiles (jit cache misses)", labels=labels),
+                     self._registry.counter(
+                         "compile_seconds_total",
+                         "Cumulative XLA backend compile wall seconds", labels=labels),
+                     self._registry.gauge(
+                         "compile_cache_entries",
+                         "Live jit cache entries created at this site", labels=labels))
+                self._site_metrics[site] = m
+        return m
+
+    # ------------------------------------------------------------- listener --
+    def _record_compile(self, seconds):
+        ctx = _SITE_CTX.get()
+        site, key = ctx if ctx is not None else ("other", None)
+        misses, secs, _ = self._metrics_for(site)
+        misses.inc()
+        secs.inc(seconds)
+        end = now_us()
+        dur = int(seconds * 1e6)
+        args = {"site": site}
+        if key is not None:
+            args["key"] = repr(key)
+        if self._spans is not None:
+            self._spans.record("xla_compile", cat="compile", ts_us=end - dur,
+                               dur_us=dur, args=args)
+        self._registry.event("xla_compile", seconds=seconds, **args)
+
+    # ------------------------------------------------------------ site hooks --
+    def wrap(self, site, key, fn):
+        """Wrap a fresh jit cache entry: counts it, and makes (site, key)
+        ambient during every call so compiles inside attribute here."""
+        self._metrics_for(site)[2].inc()
+
+        def watched(*args, **kwargs):
+            # check the ACTIVE watch, not the one that built this wrapper:
+            # jit-cache entries outlive telemetry sessions, and a disabled
+            # process pays one global read and nothing else (occupancy itself
+            # is module-global, so it also survives a reconfigure mid-call)
+            if _WATCH is None:
+                return fn(*args, **kwargs)
+            token = _SITE_CTX.set((site, key))
+            ident = threading.get_ident()
+            with _OCCUPANCY_LOCK:
+                _ACTIVE_THREADS[ident] = _ACTIVE_THREADS.get(ident, 0) + 1
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                with _OCCUPANCY_LOCK:
+                    depth = _ACTIVE_THREADS[ident] - 1
+                    if depth:
+                        _ACTIVE_THREADS[ident] = depth
+                    else:
+                        del _ACTIVE_THREADS[ident]
+                _SITE_CTX.reset(token)
+
+        return watched
+
+    @staticmethod
+    def in_wrapped_call(thread_ident=None) -> bool:
+        """True while a wrapped jit callable is executing — on the given
+        thread, or on any thread when ``thread_ident`` is None."""
+        if thread_ident is None:
+            return bool(_ACTIVE_THREADS)
+        return thread_ident in _ACTIVE_THREADS
+
+    # buckets tracked before a re-entry counts as churn: SplitFuse steadily
+    # alternates prefill and decode buckets (already compiled — not churn),
+    # and a serving process cycles through only a handful of live buckets
+    _RECENT_BUCKET_WINDOW = 8
+
+    def note_bucket(self, bucket):
+        """Called by RaggedBatchWrapper.finalize with the padded
+        (tokens, sequences, blocks) bucket of each batch. A bucket absent
+        from the recently-seen window counts as a switch — churn that
+        predicts a recompile — while alternating between live buckets does
+        not (the very first bucket is the baseline, not a switch)."""
+        with self._lock:
+            switched = bucket not in self._recent_buckets and bool(self._recent_buckets)
+            self._recent_buckets[bucket] = None
+            self._recent_buckets.move_to_end(bucket)
+            if len(self._recent_buckets) > self._RECENT_BUCKET_WINDOW:
+                self._recent_buckets.popitem(last=False)
+        if switched:
+            self._bucket_switches.inc()
+
+
+def install(registry, spans=None):
+    """Activate the watch (TelemetrySession does this when telemetry turns
+    on). Returns the watch; replaces any previous one."""
+    global _WATCH
+    _ensure_listener()
+    _WATCH = CompileWatch(registry, spans=spans)
+    return _WATCH
+
+
+def uninstall(watch=None):
+    """Deactivate (a no-op if ``watch`` is given and is no longer active)."""
+    global _WATCH
+    if watch is None or _WATCH is watch:
+        _WATCH = None

@@ -20,6 +20,44 @@ class TelemetryHTTPConfig(DeepSpeedConfigModel):
     """0 = ephemeral; the bound port is logged and available on the session."""
 
 
+class FlightRecorderConfig(DeepSpeedConfigModel):
+    """Crash flight recorder: signal/atexit/watchdog-triggered black-box JSON
+    dumps (last-N spans, recent events, metrics snapshot, live scheduler
+    state). See ``telemetry/flight_recorder.py`` and the README runbook."""
+
+    enabled: bool = False
+
+    dir: str = "flight_recorder"
+    """Dump directory (created on first dump; filenames carry pid + trigger)."""
+
+    max_spans: int = 4096
+    """How many of the most recent spans each dump includes."""
+
+    signal_enabled: bool = True
+    """Install a SIGUSR1 handler (``kill -USR1 <pid>`` dumps without stopping
+    the process). Requires enabling telemetry from the main thread."""
+
+    dump_on_exit: bool = False
+    """Also dump at interpreter exit (atexit)."""
+
+    watchdog_enabled: bool = True
+    """Run the heartbeat watchdog thread: components under watch (the serving
+    scheduler loop) that stop beating for ``watchdog_stall_s`` trigger one
+    dump per stall episode + the ``serving_stalled_total`` metric."""
+
+    watchdog_stall_s: float = 10.0
+    """Heartbeat age that counts as a stall."""
+
+    watchdog_hard_stall_s: float = 300.0
+    """Stall budget granted while the process is inside a watched jit call —
+    a scheduler loop blocked in a first-bucket XLA compile (routinely longer
+    than ``watchdog_stall_s``) is busy, not wedged; past this it counts as
+    stalled regardless."""
+
+    watchdog_poll_s: float = 1.0
+    """How often the watchdog checks heartbeat ages."""
+
+
 class TelemetryConfig(DeepSpeedConfigModel):
     enabled: bool = False
 
@@ -39,4 +77,14 @@ class TelemetryConfig(DeepSpeedConfigModel):
     endpoint open on process 0 only unless this is set (give each rank its
     own paths/ephemeral port when you do)."""
 
+    compile_watch: bool = True
+    """Watch XLA recompilation while telemetry is active: ``compile_*``
+    metrics + inline ``xla_compile`` spans (see telemetry/compile_watch.py).
+    Disabling it also removes the wrapped-call occupancy the flight-recorder
+    watchdog uses for its in-compile stall amnesty — raise
+    ``flight_recorder.watchdog_stall_s`` past your longest compile if you
+    turn this off with the watchdog on (configure() warns about the combo)."""
+
     http: TelemetryHTTPConfig = {}
+
+    flight_recorder: FlightRecorderConfig = {}

@@ -5,6 +5,8 @@ Serves the registry and span recorder to operators:
 - ``GET /metrics``  → Prometheus text exposition (scrape target)
 - ``GET /healthz``  → 200 ``{"status": "ok"}`` (liveness probe)
 - ``GET /trace``    → Chrome-trace JSON of the recorded spans
+- ``GET /flight``   → trigger a flight-recorder dump, return its JSON + path
+  (404 unless ``telemetry.flight_recorder.enabled``)
 
 Runs a daemon ``ThreadingHTTPServer``; ``port=0`` binds an ephemeral port
 (the bound address is on ``.address`` after ``start()``).
@@ -59,6 +61,19 @@ class TelemetryHTTPServer:
                     self._send(200, json.dumps({"status": "ok"}), "application/json")
                 elif path == "/trace" and spans is not None:
                     self._send(200, json.dumps(spans.chrome_trace()), "application/json")
+                elif path == "/flight":
+                    from deepspeed_tpu import telemetry
+                    recorder = telemetry.get_flight_recorder()
+                    if recorder is None:
+                        self._send(404, json.dumps(
+                            {"error": "flight recorder not enabled "
+                                      "(telemetry.flight_recorder.enabled)"}),
+                                   "application/json")
+                    else:
+                        dump_path, doc = recorder.dump("http", return_doc=True)
+                        self._send(200, json.dumps({"path": dump_path,
+                                                    "dump": doc}, default=str),
+                                   "application/json")
                 else:
                     self._send(404, json.dumps({"error": f"no route {path}"}),
                                "application/json")

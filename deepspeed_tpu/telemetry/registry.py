@@ -18,6 +18,11 @@ import json
 import re
 import threading
 import time
+from collections import deque
+
+# in-memory tail of recent event() records kept for the flight recorder's
+# black-box dump (bounded; independent of whether a JSONL file sink is open)
+RECENT_EVENTS_KEPT = 256
 
 # latency-flavored default buckets (seconds), Prometheus-style
 DEFAULT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
@@ -107,6 +112,30 @@ class Histogram(_Metric):
                     self.bucket_counts[i] += 1
                     break
 
+    def quantile(self, q):
+        """Bucket-based quantile estimate (the ``histogram_quantile`` a
+        Prometheus server would compute, done locally): linear interpolation
+        inside the bucket holding the q-th observation. Returns None with no
+        observations; the tail past the last finite bucket clamps to that
+        bucket's bound (its true upper edge is unknown). A read, like
+        ``samples()`` — not a counted telemetry call."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile q must be in [0, 1], got {q}")
+        with self._registry._lock:
+            count = self.count
+            bucket_counts = list(self.bucket_counts)
+        if count == 0:
+            return None
+        target = q * count
+        cum, prev_le = 0, 0.0
+        for le, n in zip(self.buckets, bucket_counts):
+            cum += n
+            if cum >= target and n > 0:
+                frac = (target - (cum - n)) / n
+                return prev_le + (le - prev_le) * min(1.0, max(0.0, frac))
+            prev_le = le
+        return float(self.buckets[-1])
+
     def samples(self):
         out, cum = [], 0
         for le, n in zip(self.buckets, self.bucket_counts):
@@ -130,6 +159,7 @@ class MetricsRegistry:
         self.api_calls = 0
         self._jsonl = None
         self._jsonl_path = None
+        self.recent_events = deque(maxlen=RECENT_EVENTS_KEPT)
 
     # ------------------------------------------------------------- creation --
     def _get_or_create(self, kind, name, help_text, labels, buckets=None):
@@ -197,10 +227,11 @@ class MetricsRegistry:
         counted telemetry call — the hot path must not reach here disabled)."""
         with self._lock:
             self.api_calls += 1
-            if self._jsonl is None:
-                return
             record = {"ts": time.time(), "event": name}
             record.update(fields)
+            self.recent_events.append(record)
+            if self._jsonl is None:
+                return
             self._jsonl.write(json.dumps(record) + "\n")
             self._jsonl.flush()
 
@@ -221,6 +252,12 @@ class MetricsRegistry:
                     for sample_name, labels, value in metric.samples():
                         lines.append(f"{sample_name}{_format_labels(labels)} {value}")
         return "\n".join(lines) + "\n"
+
+    def recent_events_snapshot(self):
+        """Copy of the recent-events ring (the flight recorder's read path —
+        a bare ``list(deque)`` would race concurrent ``event()`` appends)."""
+        with self._lock:
+            return list(self.recent_events)
 
     def snapshot(self):
         """{name: [(labels, value)]} over scalar samples (for reports/tests)."""

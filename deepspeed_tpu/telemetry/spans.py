@@ -7,15 +7,27 @@ collective) and the inference ``Tracer.record`` phases. Spans are complete
 ``"ph": "X"`` events, so the export loads directly in ``chrome://tracing`` /
 Perfetto.
 
+Distributed tracing (Dapper-style): spans optionally carry
+``trace_id``/``span_id``/``parent_id``. The serving layer assigns one trace id
+per request at admission and parents every lifecycle span (queued → prefill
+chunks → decode iterations → request) under one root, so a request's full
+timeline exports as its own correctly-ordered Perfetto track (each trace id
+maps to a dedicated ``tid`` with a named thread). A thread-safe ambient
+context (:func:`trace_context`) lets nested call sites inherit the current
+trace without plumbing ids through every signature.
+
 Memory is bounded: a ring buffer drops the oldest spans past ``max_spans``.
 """
 
+import itertools
 import json
 import os
 import threading
 import time
+import uuid
 from collections import deque
 from contextlib import contextmanager
+from contextvars import ContextVar
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -26,6 +38,40 @@ def now_us():
     return int(time.perf_counter() * 1e6)
 
 
+# --------------------------------------------------------------- trace ids --
+_SPAN_IDS = itertools.count(1)
+
+# (trace_id, span_id) ambient context; ContextVar is thread-safe and survives
+# into tasks if an event loop ever hosts the serving layer
+_TRACE_CTX: ContextVar = ContextVar("dstpu_trace_ctx", default=None)
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex-char trace id (one per request, assigned at admission)."""
+    return uuid.uuid4().hex[:16]
+
+
+def new_span_id() -> int:
+    """Process-unique span id (``itertools.count`` is GIL-atomic)."""
+    return next(_SPAN_IDS)
+
+
+def current_trace():
+    """The ambient ``(trace_id, span_id)`` pair, or None outside a trace."""
+    return _TRACE_CTX.get()
+
+
+@contextmanager
+def trace_context(trace_id: str, span_id: Optional[int] = None):
+    """Make ``trace_id`` (and optionally a parent ``span_id``) ambient for the
+    calling thread: spans recorded inside inherit them automatically."""
+    token = _TRACE_CTX.set((trace_id, span_id))
+    try:
+        yield
+    finally:
+        _TRACE_CTX.reset(token)
+
+
 @dataclass
 class Span:
     name: str
@@ -33,6 +79,20 @@ class Span:
     ts_us: int
     dur_us: int
     args: Optional[dict] = field(default=None)
+    trace_id: Optional[str] = field(default=None)
+    span_id: Optional[int] = field(default=None)
+    parent_id: Optional[int] = field(default=None)
+
+    def to_dict(self):
+        d = {"name": self.name, "cat": self.cat, "ts_us": self.ts_us,
+             "dur_us": self.dur_us}
+        if self.args:
+            d["args"] = self.args
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
+            d["span_id"] = self.span_id
+            d["parent_id"] = self.parent_id
+        return d
 
 
 class SpanRecorder:
@@ -45,41 +105,88 @@ class SpanRecorder:
     def __len__(self):
         return len(self._spans)
 
-    def record(self, name, cat="default", ts_us=None, dur_us=0, args=None):
+    def record(self, name, cat="default", ts_us=None, dur_us=0, args=None,
+               trace_id=None, span_id=None, parent_id=None):
+        if trace_id is None:
+            ctx = _TRACE_CTX.get()
+            if ctx is not None:
+                trace_id = ctx[0]
+                if parent_id is None:
+                    parent_id = ctx[1]
+        if trace_id is not None and span_id is None:
+            span_id = new_span_id()
         span = Span(name, cat, now_us() if ts_us is None else int(ts_us),
-                    int(dur_us), args)
+                    int(dur_us), args, trace_id, span_id, parent_id)
         with self._lock:
             if len(self._spans) == self._spans.maxlen:
                 self.dropped += 1
             self._spans.append(span)
+        return span
 
     @contextmanager
-    def span(self, name, cat="default", args=None):
+    def span(self, name, cat="default", args=None, trace_id=None, parent_id=None):
+        """Timed span; inside a trace the block's children parent to it (the
+        span id is allocated up-front and made ambient for the duration)."""
         t0 = now_us()
+        ctx = _TRACE_CTX.get()
+        if trace_id is None and ctx is not None:
+            trace_id = ctx[0]
+            if parent_id is None:
+                parent_id = ctx[1]
+        if trace_id is None:
+            try:
+                yield
+            finally:
+                self.record(name, cat, ts_us=t0, dur_us=now_us() - t0, args=args)
+            return
+        span_id = new_span_id()
+        token = _TRACE_CTX.set((trace_id, span_id))
         try:
             yield
         finally:
-            self.record(name, cat, ts_us=t0, dur_us=now_us() - t0, args=args)
+            _TRACE_CTX.reset(token)
+            self.record(name, cat, ts_us=t0, dur_us=now_us() - t0, args=args,
+                        trace_id=trace_id, span_id=span_id, parent_id=parent_id)
 
     def clear(self):
         with self._lock:
             self._spans.clear()
 
+    def tail(self, n: int):
+        """The most recent ``n`` spans as plain dicts (flight-recorder dump)."""
+        with self._lock:
+            spans = list(self._spans)[-n:]
+        return [s.to_dict() for s in spans]
+
     # -------------------------------------------------------------- export --
     def chrome_trace(self):
         """Chrome-trace dict: complete ("X") events sorted by ts (viewers
-        require non-decreasing timestamps within a track)."""
+        require non-decreasing timestamps within a track). Traced spans get a
+        per-trace ``tid`` (one named Perfetto track per request); their
+        trace/span/parent ids ride in ``args`` so tooling can rebuild the
+        parent chain."""
         pid = os.getpid()
         with self._lock:
             spans = sorted(self._spans, key=lambda s: s.ts_us)
         events = []
+        trace_tids = {}  # trace_id -> tid (stable by first appearance in time)
         for s in spans:
+            tid = 0
+            if s.trace_id is not None:
+                tid = trace_tids.setdefault(s.trace_id, len(trace_tids) + 1)
             ev = {"name": s.name, "cat": s.cat, "ph": "X", "ts": s.ts_us,
-                  "dur": s.dur_us, "pid": pid, "tid": 0}
-            if s.args:
-                ev["args"] = s.args
+                  "dur": s.dur_us, "pid": pid, "tid": tid}
+            args = dict(s.args) if s.args else {}
+            if s.trace_id is not None:
+                args.update(trace_id=s.trace_id, span_id=s.span_id,
+                            parent_id=s.parent_id)
+            if args:
+                ev["args"] = args
             events.append(ev)
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        meta = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                 "args": {"name": f"request {trace_id}"}}
+                for trace_id, tid in trace_tids.items()]
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
     def export_chrome_trace(self, path):
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)

@@ -35,12 +35,22 @@ from deepspeed_tpu.inference.v2.ragged.manager_configs import (AllocationMode,
 
 
 def serve_main():
-    """Persistent-server demo: overlapping streaming requests over HTTP."""
+    """Persistent-server demo: overlapping streaming requests over HTTP, each
+    traced end-to-end (X-DSTPU-Trace-Id), plus a flight-recorder dump and a
+    per-request timeline report from the exported Chrome trace."""
     import json
     import threading
     import urllib.request
 
+    from deepspeed_tpu import telemetry
     from deepspeed_tpu.serving import ServingConfig, ServingScheduler, ServingServer
+
+    trace_dir = tempfile.mkdtemp()
+    telemetry.configure(telemetry.TelemetryConfig(
+        enabled=True,
+        trace_path=os.path.join(trace_dir, "serve.trace.json"),
+        flight_recorder={"enabled": True, "dir": os.path.join(trace_dir, "flight"),
+                         "watchdog_enabled": False}))
 
     cfg = LlamaConfig.tiny(vocab_size=512, max_position_embeddings=128)
     _, params = init_params(cfg, seq_len=16)
@@ -60,14 +70,16 @@ def serve_main():
         req = urllib.request.Request(server.url + "/v1/generate", data=body,
                                      headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(req, timeout=120) as resp:
+            trace_id = resp.headers["X-DSTPU-Trace-Id"]
             for line in resp:
                 line = line.decode().strip()
                 if not line.startswith("data: "):
                     continue
                 event = json.loads(line[len("data: "):])
                 if event.get("done"):
+                    assert event["trace_id"] == trace_id
                     print(f"[{name}] done: state={event['state']} "
-                          f"tokens={event['tokens']}")
+                          f"trace={trace_id} tokens={event['tokens']}")
                 else:
                     print(f"[{name}] token {event['index']}: {event['token']}")
 
@@ -83,9 +95,22 @@ def serve_main():
     stats = json.loads(urllib.request.urlopen(server.url + "/v1/stats",
                                               timeout=10).read())
     assert stats["counters"]["completed"] == 2, stats
+    assert stats["latency"]["ttft_s"]["p50"] is not None, stats
+
+    # black-box dump on demand (same payload a SIGUSR1 would produce)
+    dump_path = telemetry.get_flight_recorder().dump("demo")
+    with open(dump_path) as f:
+        dump = json.load(f)
+    assert dump["metrics"]["serving_completions_total"][0][1] == 2
+    print(f"flight dump: {dump_path}")
+
     server.stop()  # graceful drain
     assert engine.free_blocks == 128, "KV blocks must all return to the pool"
     engine.close()
+
+    telemetry.shutdown()  # writes trace_path
+    from deepspeed_tpu.env_report import trace_report
+    assert trace_report(os.path.join(trace_dir, "serve.trace.json")) == 0
     print("OK")
 
 

@@ -1,0 +1,201 @@
+"""End-to-end request tracing through the HTTP server (ISSUE acceptance):
+every span of a served request shares one trace id, parents correctly under
+the root, and the trace id matches the response header — plus the flight
+recorder capturing live scheduler state mid-workload."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.serving import (RequestState, ServingConfig, ServingScheduler,
+                                   ServingServer)
+from deepspeed_tpu.serving.server import TRACE_HEADER
+
+
+def _post(url, doc, timeout=120):
+    req = urllib.request.Request(url + "/v1/generate", data=json.dumps(doc).encode(),
+                                 headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _trace_events(trace_id):
+    evs = telemetry.state.spans.chrome_trace()["traceEvents"]
+    return [e for e in evs if e.get("ph") == "X"
+            and e.get("args", {}).get("trace_id") == trace_id]
+
+
+@pytest.fixture
+def traced_server(make_engine, llama_setup):
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+    engine = make_engine()
+    srv = ServingServer(ServingScheduler(engine, ServingConfig())).start()
+    yield srv, llama_setup[0]
+    srv.stop(drain=False)
+
+
+def test_served_request_exports_one_parented_trace(traced_server):
+    srv, cfg = traced_server
+    prompt = (np.arange(9) % cfg.vocab_size).tolist()
+    with _post(srv.url, {"prompt": prompt, "max_new_tokens": 4}) as resp:
+        doc = json.loads(resp.read())
+        header_trace = resp.headers[TRACE_HEADER]
+
+    # the header names the trace; the body repeats it with the uid
+    assert header_trace and doc["trace_id"] == header_trace
+    assert doc["uid"] is not None and doc["state"] == "DONE"
+
+    evs = _trace_events(header_trace)
+    names = [e["name"] for e in evs]
+    # full lifecycle: QUEUED -> PREFILL -> DECODE iterations -> root closes
+    assert names.count("request") == 1
+    assert names.count("queued") == 1
+    assert names.count("prefill") >= 1
+    # the first token falls out of the final prefill chunk's logits, so
+    # decode iterations account for the remaining n_tokens - 1
+    assert names.count("decode") == doc["n_tokens"] - 1
+
+    root = next(e for e in evs if e["name"] == "request")
+    assert root["args"]["parent_id"] is None
+    assert root["args"]["uid"] == doc["uid"]
+    assert root["args"]["state"] == "DONE"
+    assert root["args"]["generated"] == doc["n_tokens"]
+    # ISSUE acceptance: the parent chain — every non-root span is a direct
+    # child of the root, and they all share the header's trace id
+    for e in evs:
+        if e["name"] != "request":
+            assert e["args"]["parent_id"] == root["args"]["span_id"]
+            assert e["args"]["uid"] == doc["uid"]
+    # one Perfetto track per request: same tid everywhere, with a name
+    assert len({e["tid"] for e in evs}) == 1
+    meta = [m for m in telemetry.state.spans.chrome_trace()["traceEvents"]
+            if m.get("ph") == "M" and m["args"]["name"] == f"request {header_trace}"]
+    assert len(meta) == 1
+    # spans nest inside the root's interval
+    t0, t1 = root["ts"], root["ts"] + root["dur"]
+    assert all(t0 <= e["ts"] and e["ts"] + e["dur"] <= t1 for e in evs)
+
+
+def test_two_requests_get_distinct_traces_and_engine_spans_link_uids(traced_server):
+    srv, cfg = traced_server
+    prompt = (np.arange(5) % cfg.vocab_size).tolist()
+    traces, uids = [], []
+    for _ in range(2):
+        with _post(srv.url, {"prompt": prompt, "max_new_tokens": 2}) as resp:
+            doc = json.loads(resp.read())
+            traces.append(resp.headers[TRACE_HEADER])
+            uids.append(doc["uid"])
+    assert len(set(traces)) == 2 and len(set(uids)) == 2
+    # the engine's batch spans carry the uids that compose each ragged batch
+    put_spans = [s for s in telemetry.state.spans.tail(10000) if s["name"] == "put"]
+    linked = {u for s in put_spans for u in s["args"].get("uids", [])}
+    assert set(uids) <= linked
+
+
+def test_sse_stream_carries_trace_header_and_done_ids(traced_server):
+    srv, cfg = traced_server
+    prompt = (np.arange(6) % cfg.vocab_size).tolist()
+    with _post(srv.url, {"prompt": prompt, "max_new_tokens": 3, "stream": True}) as resp:
+        header_trace = resp.headers[TRACE_HEADER]
+        events = [json.loads(line.decode().strip()[len("data: "):])
+                  for line in resp if line.decode().strip().startswith("data: ")]
+    *tokens, final = events
+    assert header_trace
+    assert final["done"] is True
+    assert final["trace_id"] == header_trace   # SSE metadata joins the trace
+    assert final["uid"] is not None            # ...and the engine uid
+
+
+def test_stats_rows_carry_uid_trace_and_percentiles(traced_server):
+    srv, cfg = traced_server
+    prompt = (np.arange(4) % cfg.vocab_size).tolist()
+    with _post(srv.url, {"prompt": prompt, "max_new_tokens": 2}) as resp:
+        done = json.loads(resp.read())
+    with _post(srv.url, {"prompt": prompt, "max_new_tokens": 256, "stream": True},
+               timeout=120) as resp:
+        resp.readline()  # first token: the request is live in DECODE/PREFILL
+        stats = json.loads(urllib.request.urlopen(srv.url + "/v1/stats",
+                                                  timeout=10).read())
+        rows = stats["requests"]
+        assert rows and all("uid" in r and "trace_id" in r and "state" in r
+                            for r in rows)
+        assert done["uid"] not in [r["uid"] for r in rows]  # finished left
+        lat = stats["latency"]
+        for family in ("ttft_s", "itl_s", "e2e_s"):
+            assert set(lat[family]) == {"p50", "p95", "p99"}
+        assert lat["ttft_s"]["p50"] is not None  # one request completed
+        assert (lat["ttft_s"]["p50"] <= lat["ttft_s"]["p95"]
+                <= lat["ttft_s"]["p99"])
+
+
+def test_scheduler_follows_telemetry_reconfigure(make_engine, llama_setup, tmp_path):
+    """A telemetry reconfigure mid-serve installs a new span recorder and
+    flight recorder: the live scheduler re-attaches so traces, dumps and
+    stall detection follow the new session instead of the displaced one."""
+    telemetry.configure(telemetry.TelemetryConfig(
+        enabled=True,
+        flight_recorder={"enabled": True, "dir": str(tmp_path / "f1"),
+                         "watchdog_enabled": False, "signal_enabled": False}))
+    cfg = llama_setup[0]
+    engine = make_engine()
+    scheduler = ServingScheduler(engine, ServingConfig())
+    try:
+        old_flight = telemetry.get_flight_recorder()
+        telemetry.configure(telemetry.TelemetryConfig(
+            enabled=True,
+            flight_recorder={"enabled": True, "dir": str(tmp_path / "f2"),
+                             "watchdog_enabled": False, "signal_enabled": False}))
+        new_flight = telemetry.get_flight_recorder()
+        assert new_flight is not old_flight
+        req = scheduler.submit((np.arange(6) % cfg.vocab_size).tolist(),
+                               max_new_tokens=4)
+        req.result(timeout=120)
+        # the loop re-attached: the NEW recorder dumps this scheduler's state
+        path = new_flight.dump("api")
+        with open(path) as f:
+            doc = json.load(f)
+        assert scheduler._flight_channel in doc["state"]
+        # ...and the request's spans landed in the NEW session's recorder
+        assert any(s.get("trace_id") == req.trace_id
+                   for s in telemetry.state.spans.tail(10000))
+    finally:
+        scheduler.stop(drain=False)
+
+
+def test_flight_dump_during_active_workload(make_engine, llama_setup, tmp_path):
+    """ISSUE acceptance: triggering the recorder during an active serving
+    workload captures spans, the registry snapshot and per-request scheduler
+    state."""
+    telemetry.configure(telemetry.TelemetryConfig(
+        enabled=True,
+        flight_recorder={"enabled": True, "dir": str(tmp_path / "flight"),
+                         "watchdog_enabled": False, "signal_enabled": False}))
+    cfg = llama_setup[0]
+    engine = make_engine()
+    scheduler = ServingScheduler(engine, ServingConfig())
+    try:
+        req = scheduler.submit((np.arange(6) % cfg.vocab_size).tolist(),
+                               max_new_tokens=256)
+        next(iter(req.stream))  # decoding is underway
+        path = telemetry.get_flight_recorder().dump("api")
+        with open(path) as f:
+            doc = json.load(f)
+        state = doc["state"][scheduler._flight_channel]
+        assert scheduler._flight_channel.startswith("serving_scheduler:")
+        row = next(r for r in state["requests"] if r["uid"] == req.uid)
+        assert row["state"] in ("PREFILL", "DECODE")
+        assert row["trace_id"] == req.trace_id
+        assert row["kv_blocks"] > 0 and row["offloaded"] is False
+        assert state["engine"]["capacity_blocks"] > 0
+        assert doc["metrics"]["serving_admissions_total"][0][1] == 1
+        assert any(s["name"] in ("prefill", "decode") for s in doc["spans"])
+        req.cancel()
+    finally:
+        scheduler.stop(drain=False)
+    # after stop() the provider detaches: later dumps see no scheduler state
+    path = telemetry.get_flight_recorder().dump("api")
+    with open(path) as f:
+        assert not any(k.startswith("serving_scheduler")
+                       for k in json.load(f)["state"])

@@ -2,6 +2,7 @@
 disabled-by-default zero-overhead guarantee."""
 
 import json
+import os
 
 import numpy as np
 
@@ -54,7 +55,13 @@ def test_enabled_engine_emits_jsonl_and_chrome_trace(tmp_path):
             "train_batch", "all_reduce"} <= names
     assert [e["ts"] for e in evs] == sorted(e["ts"] for e in evs)
     assert all(e["ph"] == "X" for e in evs)
-    assert {e["cat"] for e in evs} == {"engine", "comm"}
+    # "compile" spans: the compile watch records the jit builds inline — but
+    # only when jax actually backend-compiles, so a warm persistent
+    # compilation cache (JAX_COMPILATION_CACHE_DIR) legitimately omits them
+    cats = {e["cat"] for e in evs}
+    assert {"engine", "comm"} <= cats <= {"engine", "comm", "compile"}
+    if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        assert "compile" in cats
 
 
 def test_enabled_engine_populates_registry_gauges(tmp_path):

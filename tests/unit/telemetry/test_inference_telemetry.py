@@ -56,6 +56,31 @@ def test_metrics_endpoint_reports_serving_gauges(llama_setup):
         engine.close()
 
 
+def test_global_session_collects_engine_metrics_without_engine_config(llama_setup):
+    """The README serving quickstart configures telemetry process-wide and
+    builds the engine WITHOUT an engine-level telemetry block: the
+    inference_* families must still be recorded (on the global registry)."""
+    from deepspeed_tpu import telemetry
+
+    cfg, params = llama_setup
+    mgr = DSStateManagerConfig(memory_config=MemoryConfig(mode=AllocationMode.ALLOCATE, size=64),
+                               max_context=512)
+    engine_config = RaggedInferenceEngineConfig(state_manager=mgr, kv_block_size=16)
+    session = telemetry.configure({"enabled": True})
+    engine = build_engine(params, cfg, engine_config)
+    try:
+        assert engine.telemetry_session is None
+        rng = np.random.default_rng(0)
+        engine.put([0], [rng.integers(0, cfg.vocab_size, 9)])
+        reg = telemetry.get_registry()
+        assert reg.counter("inference_batches_total").value == 1.0
+        assert reg.counter("inference_tokens_total").value == 9.0
+        assert reg.gauge("inference_tracked_sequences").value == 1.0
+    finally:
+        engine.close()
+        session.close()
+
+
 def test_healthz_returns_200(llama_setup):
     cfg, params = llama_setup
     engine = _serving_engine(params, cfg)

@@ -1,0 +1,74 @@
+"""New metrics cannot land undocumented (ISSUE satellite).
+
+Three-way diff chain:
+
+1. every string-literal metric name registered anywhere in the source tree
+   must appear in ``telemetry/catalog.py``;
+2. the catalog and the README metric tables must match exactly;
+3. the families cheap to instantiate at runtime (serving, compile watch,
+   flight recorder) must register only cataloged names.
+"""
+
+import os
+import re
+
+from deepspeed_tpu.telemetry.catalog import METRIC_FAMILIES
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+SRC = os.path.join(REPO, "deepspeed_tpu")
+README = os.path.join(REPO, "README.md")
+
+# registry.counter("name", ...) / .gauge( / .histogram( with a literal name
+_REGISTER_RE = re.compile(r"\.(?:counter|gauge|histogram)\(\s*\n?\s*\"([a-z_][a-z0-9_]*)\"")
+# | `metric_name` | ... table rows
+_TABLE_ROW_RE = re.compile(r"^\|\s*`([a-z_][a-z0-9_]*)`\s*\|", re.MULTILINE)
+
+
+def _source_metric_names():
+    names = set()
+    for dirpath, _, filenames in os.walk(SRC):
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fname)) as f:
+                names.update(_REGISTER_RE.findall(f.read()))
+    return names
+
+
+def test_every_source_registered_metric_is_cataloged():
+    names = _source_metric_names()
+    assert names, "the scan found no registration sites — regex rotted?"
+    uncataloged = names - set(METRIC_FAMILIES)
+    assert not uncataloged, (
+        f"metrics registered in source but missing from telemetry/catalog.py "
+        f"(add them there AND to the README metric tables): {sorted(uncataloged)}")
+
+
+def test_readme_tables_match_catalog_exactly():
+    with open(README) as f:
+        documented = set(_TABLE_ROW_RE.findall(f.read()))
+    missing = set(METRIC_FAMILIES) - documented
+    assert not missing, f"cataloged metrics missing from README tables: {sorted(missing)}"
+    stale = documented - set(METRIC_FAMILIES)
+    assert not stale, f"README documents metrics the catalog doesn't know: {sorted(stale)}"
+
+
+def test_runtime_registration_stays_within_catalog(tmp_path):
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.serving.metrics import ServingMetrics
+    from deepspeed_tpu.telemetry.compile_watch import CompileWatch
+    from deepspeed_tpu.telemetry.config import FlightRecorderConfig
+    from deepspeed_tpu.telemetry.flight_recorder import FlightRecorder
+
+    reg = telemetry.MetricsRegistry()
+    ServingMetrics(reg)
+    watch = CompileWatch(reg)
+    watch._metrics_for("train")
+    recorder = FlightRecorder(FlightRecorderConfig(dir=str(tmp_path)), reg)
+    recorder.dump("api")
+    registered = {name for (name, _) in reg._metrics}
+    assert registered, "nothing registered — the instantiation path rotted?"
+    assert registered <= set(METRIC_FAMILIES), (
+        f"runtime-registered metrics missing from the catalog: "
+        f"{sorted(registered - set(METRIC_FAMILIES))}")

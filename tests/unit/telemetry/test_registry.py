@@ -90,6 +90,36 @@ def test_jsonl_event_sink(tmp_path):
     assert all("ts" in rec for rec in lines)
 
 
+def test_histogram_quantile_estimation():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "lat", buckets=(0.01, 0.1, 1.0))
+    assert h.quantile(0.5) is None  # no observations yet
+    # 100 observations spread 90/10 across the first two buckets
+    for _ in range(90):
+        h.observe(0.005)
+    for _ in range(10):
+        h.observe(0.05)
+    # p50 interpolates inside the first bucket (0..0.01)
+    assert 0.0 < h.quantile(0.5) < 0.01
+    # p95 lands mid-way through the second bucket (0.01..0.1)
+    assert 0.01 < h.quantile(0.95) < 0.1
+    assert h.quantile(0.95) == pytest.approx(0.055, abs=1e-9)
+    # monotone in q
+    qs = [h.quantile(q) for q in (0.1, 0.5, 0.9, 0.99, 1.0)]
+    assert qs == sorted(qs)
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_histogram_quantile_tail_clamps_to_last_bucket():
+    reg = MetricsRegistry()
+    h = reg.histogram("big_seconds", "b", buckets=(0.01, 1.0))
+    h.observe(50.0)  # beyond every finite bucket
+    assert h.quantile(0.5) == 1.0  # clamped: the true edge is unknown
+    # quantile() is a read — not a counted telemetry call
+    assert reg.api_calls == 1  # just the observe
+
+
 def test_api_call_counting():
     """The registry counts every telemetry API call — the probe the disabled-
     hot-path test relies on."""

@@ -1,0 +1,87 @@
+"""Distributed-tracing span extensions: trace/span/parent ids, the ambient
+trace context, and the per-trace Perfetto track export."""
+
+import json
+
+from deepspeed_tpu.telemetry import (SpanRecorder, current_trace, new_span_id,
+                                     new_trace_id, trace_context)
+
+
+def test_trace_and_span_ids_are_unique():
+    trace_ids = {new_trace_id() for _ in range(100)}
+    span_ids = {new_span_id() for _ in range(100)}
+    assert len(trace_ids) == 100 and len(span_ids) == 100
+    assert all(len(t) == 16 for t in trace_ids)
+
+
+def test_record_with_explicit_ids_and_parent_chain():
+    rec = SpanRecorder()
+    trace = new_trace_id()
+    root = new_span_id()
+    rec.record("request", ts_us=0, dur_us=100, trace_id=trace, span_id=root)
+    child = rec.record("queued", ts_us=0, dur_us=10, trace_id=trace, parent_id=root)
+    assert child.span_id is not None and child.span_id != root
+    assert child.parent_id == root and child.trace_id == trace
+
+
+def test_ambient_context_inherited_by_record():
+    rec = SpanRecorder()
+    assert current_trace() is None
+    trace, root = new_trace_id(), new_span_id()
+    with trace_context(trace, root):
+        assert current_trace() == (trace, root)
+        span = rec.record("inner", ts_us=5, dur_us=1)
+    assert current_trace() is None
+    assert span.trace_id == trace and span.parent_id == root
+    # outside the context nothing is inherited
+    bare = rec.record("outside", ts_us=6, dur_us=1)
+    assert bare.trace_id is None and bare.span_id is None
+
+
+def test_span_context_manager_nests_parents():
+    rec = SpanRecorder()
+    trace, root = new_trace_id(), new_span_id()
+    with trace_context(trace, root):
+        with rec.span("outer", cat="t"):
+            with rec.span("inner", cat="t"):
+                pass
+    spans = {s["name"]: s for s in rec.tail(10)}
+    assert spans["outer"]["parent_id"] == root
+    assert spans["inner"]["parent_id"] == spans["outer"]["span_id"]
+    assert spans["inner"]["trace_id"] == spans["outer"]["trace_id"] == trace
+
+
+def test_chrome_trace_gives_each_trace_its_own_track(tmp_path):
+    rec = SpanRecorder()
+    t_a, t_b = new_trace_id(), new_trace_id()
+    rec.record("request", ts_us=0, dur_us=100, trace_id=t_a, span_id=1)
+    rec.record("request", ts_us=10, dur_us=100, trace_id=t_b, span_id=2)
+    rec.record("decode", ts_us=20, dur_us=5, trace_id=t_a, parent_id=1)
+    rec.record("untraced", ts_us=30, dur_us=5)
+
+    path = rec.export_chrome_trace(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    meta = [e for e in evs if e.get("ph") == "M"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    # one named track per trace id; same-trace spans share a tid
+    assert {m["args"]["name"] for m in meta} == {f"request {t_a}", f"request {t_b}"}
+    tids = {e["name"]: e["tid"] for e in xs}
+    by_trace = {e["args"]["trace_id"]: e["tid"] for e in xs if "args" in e
+                and "trace_id" in e.get("args", {})}
+    assert by_trace[t_a] != by_trace[t_b]
+    assert tids["decode"] == by_trace[t_a]
+    assert tids["untraced"] == 0
+    # ids ride in args so tooling can rebuild the parent chain
+    decode = next(e for e in xs if e["name"] == "decode")
+    assert decode["args"]["parent_id"] == 1 and decode["args"]["trace_id"] == t_a
+    # X events still sorted by ts (viewer contract)
+    assert [e["ts"] for e in xs] == sorted(e["ts"] for e in xs)
+
+
+def test_untraced_export_has_no_metadata_events():
+    rec = SpanRecorder()
+    rec.record("plain", ts_us=0, dur_us=1)
+    evs = rec.chrome_trace()["traceEvents"]
+    assert all(e["ph"] == "X" for e in evs)

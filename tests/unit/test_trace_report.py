@@ -1,0 +1,84 @@
+"""``dstpu_report --trace``: per-request timeline report from a Chrome trace
+or a flight-recorder dump (ISSUE satellite)."""
+
+import json
+
+from deepspeed_tpu.env_report import main as report_main
+from deepspeed_tpu.env_report import trace_report
+
+
+def _chrome_trace(tmp_path):
+    trace, root = "aabbccdd00112233", 1
+    events = [
+        {"name": "request", "cat": "serving", "ph": "X", "ts": 0, "dur": 10000,
+         "pid": 1, "tid": 1,
+         "args": {"uid": 4, "state": "DONE", "finish_reason": "length",
+                  "prompt_tokens": 24, "generated": 3,
+                  "trace_id": trace, "span_id": root, "parent_id": None}},
+        {"name": "queued", "cat": "serving", "ph": "X", "ts": 0, "dur": 1000,
+         "pid": 1, "tid": 1,
+         "args": {"uid": 4, "trace_id": trace, "span_id": 2, "parent_id": root}},
+        {"name": "prefill", "cat": "serving", "ph": "X", "ts": 1000, "dur": 4000,
+         "pid": 1, "tid": 1,
+         "args": {"uid": 4, "tokens": 24, "trace_id": trace, "span_id": 3,
+                  "parent_id": root}},
+        {"name": "decode", "cat": "serving", "ph": "X", "ts": 5000, "dur": 2000,
+         "pid": 1, "tid": 1,
+         "args": {"uid": 4, "tokens": 1, "trace_id": trace, "span_id": 4,
+                  "parent_id": root}},
+        {"name": "decode", "cat": "serving", "ph": "X", "ts": 7000, "dur": 2000,
+         "pid": 1, "tid": 1,
+         "args": {"uid": 4, "tokens": 1, "trace_id": trace, "span_id": 5,
+                  "parent_id": root}},
+        {"name": "xla_compile", "cat": "compile", "ph": "X", "ts": 5500,
+         "dur": 500, "pid": 1, "tid": 0, "args": {"site": "inference_forward"}},
+        {"name": "xla_compile", "cat": "compile", "ph": "X", "ts": 90000,
+         "dur": 500, "pid": 1, "tid": 0, "args": {"site": "train"}},
+    ]
+    path = tmp_path / "trace.json"
+    path.write_text(json.dumps({"traceEvents": events}))
+    return str(path), trace
+
+
+def test_trace_report_prints_request_timeline(tmp_path, capsys):
+    path, trace = _chrome_trace(tmp_path)
+    assert report_main(["--trace", path]) == 0
+    out = capsys.readouterr().out
+    assert f"request uid=4 trace={trace} [DONE, length]" in out
+    assert "24t / 3t" in out
+    assert "1.000 ms" in out            # queued
+    assert "(1 chunks)" in out          # prefill
+    assert "(2 iterations, 2 tokens)" in out
+    # only the overlapping compile counts, not the one outside the window
+    assert "recompiles overlapped  1" in out
+
+
+def test_trace_report_reads_flight_recorder_dumps(tmp_path, capsys):
+    spans = [{"name": "request", "cat": "serving", "ts_us": 0, "dur_us": 5000,
+              "trace_id": "ff00ff00ff00ff00", "span_id": 1, "parent_id": None,
+              "args": {"uid": 9, "state": "CANCELLED", "prompt_tokens": 4,
+                       "generated": 1}},
+             {"name": "queued", "cat": "serving", "ts_us": 0, "dur_us": 500,
+              "trace_id": "ff00ff00ff00ff00", "span_id": 2, "parent_id": 1,
+              "args": {"uid": 9}}]
+    path = tmp_path / "flight_1_0001_api.json"
+    path.write_text(json.dumps({"meta": {}, "spans": spans}))
+    assert trace_report(str(path)) == 0
+    out = capsys.readouterr().out
+    assert "request uid=9 trace=ff00ff00ff00ff00 [CANCELLED]" in out
+    assert "0.500 ms" in out
+
+
+def test_trace_report_handles_traceless_and_bad_files(tmp_path, capsys):
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"traceEvents": []}))
+    assert trace_report(str(empty)) == 0
+    assert "no request traces" in capsys.readouterr().out
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"something": "else"}))
+    assert trace_report(str(bad)) == 1
+    assert trace_report(str(tmp_path / "missing.json")) == 1
+
+    assert report_main(["--trace"]) == 2  # missing operand → usage
+    capsys.readouterr()
