@@ -1,0 +1,48 @@
+"""Fleet serving: N load-balanced replicas behind one router.
+
+The serving layer (``deepspeed_tpu/serving``) operates ONE engine; this
+package is the horizontal layer above it — the PAPER §2.9 disaggregation idea
+promoted from MoE experts to whole engine roles:
+
+- :class:`ReplicaManager` — registry + lifecycle for the replica set:
+  in-process ``(InferenceEngineV2 + ServingScheduler)`` pairs (tier-1
+  CPU-testable) and/or external ``serving/server.py`` processes by URL.
+- :class:`FleetRouter` — stdlib-HTTP front-end speaking the single-replica
+  wire format: session-affinity rendezvous hashing, health/backpressure-aware
+  least-loaded dispatch, retry-on-503 failover, fleet-wide graceful drain.
+- Prefill/decode disaggregation: replicas are role-tagged; when both pools
+  exist a request prefills (plus first token) on a ``prefill`` replica and its
+  KV hands off — a portable bytes payload (``inference/v2/ragged/handoff.py``)
+  — to a ``decode`` replica, so TTFT and ITL capacity scale independently.
+  ``empty_run`` heartbeats keep idle pool members warm.
+- :class:`FleetAutoscaler` — sustained queue-depth / KV-pressure policy loop
+  that grows and drains pools through the manager, reusing the elasticity
+  subsystem's valid-size / capacity signals.
+
+Usage::
+
+    from deepspeed_tpu.fleet import FleetConfig, FleetRouter, ReplicaManager
+
+    manager = ReplicaManager(engine_factory=make_engine, config=FleetConfig())
+    for _ in range(2):
+        manager.add_local(role="prefill")
+        manager.add_local(role="decode")
+    router = FleetRouter(manager).start()   # same wire format as ServingServer
+    ...                                     # POST router.url + "/v1/generate"
+    router.stop()                           # graceful fleet-wide drain
+"""
+
+from deepspeed_tpu.fleet.config import AutoscaleConfig, FleetConfig, ReplicaRole
+from deepspeed_tpu.fleet.manager import ReplicaManager
+from deepspeed_tpu.fleet.metrics import FleetMetrics
+from deepspeed_tpu.fleet.policy import FleetAutoscaler
+from deepspeed_tpu.fleet.replica import (HttpReplica, Leg, LocalReplica, Replica,
+                                         ReplicaState, ReplicaUnavailable)
+from deepspeed_tpu.fleet.router import FleetRouter, RoutedRequest, RoutingError
+
+__all__ = [
+    "AutoscaleConfig", "FleetConfig", "ReplicaRole", "ReplicaManager",
+    "FleetMetrics", "FleetAutoscaler", "HttpReplica", "Leg", "LocalReplica",
+    "Replica", "ReplicaState", "ReplicaUnavailable", "FleetRouter",
+    "RoutedRequest", "RoutingError",
+]

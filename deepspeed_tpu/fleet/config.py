@@ -1,0 +1,100 @@
+"""Fleet config blocks.
+
+The fleet layer runs N ``(InferenceEngineV2 + ServingScheduler +
+ServingServer)`` replicas behind one router; these knobs size the router's
+dispatch behavior and the autoscaler's policy loop. Validated pydantic-style
+like the other config blocks (``serving/config.py``, ``telemetry/config.py``).
+"""
+
+from typing import Literal, Optional
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+from deepspeed_tpu.serving.config import DEFAULT_MAX_RESUME_BODY_BYTES
+
+ReplicaRole = Literal["mixed", "prefill", "decode"]
+"""``mixed`` serves whole requests; ``prefill``/``decode`` replicas form the
+disaggregated pools — a request prefills (plus first token) on a prefill-role
+replica, then its KV hands off to a decode-role replica for the rest."""
+
+
+class AutoscaleConfig(DeepSpeedConfigModel):
+    """Policy knobs for :class:`deepspeed_tpu.fleet.policy.FleetAutoscaler`."""
+
+    enabled: bool = False
+    """Run the policy loop (``FleetAutoscaler.start()``); disabled = manual
+    ``step()`` only (tests, external control loops)."""
+
+    interval_s: float = Field(1.0, gt=0)
+    """Seconds between policy observations."""
+
+    min_replicas: int = Field(1, ge=1)
+    """Never drain below this many replicas (per managed role)."""
+
+    max_replicas: int = Field(8, ge=1)
+    """Never grow beyond this many replicas (per managed role)."""
+
+    role: ReplicaRole = "mixed"
+    """Which pool the autoscaler grows and shrinks (one autoscaler per role;
+    run several for disaggregated fleets)."""
+
+    scale_up_queue_depth: float = Field(4.0, ge=0)
+    """Mean queued-requests-per-replica above which the pool is considered
+    saturated."""
+
+    scale_up_kv_pressure: float = Field(0.9, ge=0, le=1)
+    """Mean KV-pool occupancy (1 - free/capacity) above which the pool is
+    considered saturated."""
+
+    sustain_ticks: int = Field(3, ge=1)
+    """Consecutive saturated observations before a scale-up fires (guards
+    against reacting to a transient burst)."""
+
+    scale_down_idle_ticks: int = Field(10, ge=1)
+    """Consecutive fully-idle observations (zero queued, zero in-flight,
+    pressure below the threshold) before one replica is drained."""
+
+
+class FleetConfig(DeepSpeedConfigModel):
+    """Knobs for the replica manager + front-end router."""
+
+    host: str = "127.0.0.1"
+    port: int = Field(0, ge=0, le=65535)
+    """Router bind address; port 0 = ephemeral (read ``router.url`` after
+    ``start()``)."""
+
+    affinity_header: str = "X-DSTPU-Session"
+    """Request header (or JSON ``session`` field) carrying the session key for
+    rendezvous-hash affinity; absent = least-loaded dispatch."""
+
+    default_max_new_tokens: int = Field(64, ge=1)
+    """Generation budget when the request doesn't say — the router must know
+    the total to split a disaggregated request into prefill-plus-first-token
+    and decode-the-rest legs (matches ``ServingConfig.default_max_new_tokens``
+    so routed and direct requests behave alike)."""
+
+    probe_ttl_s: float = Field(0.25, ge=0)
+    """How long a replica's health/load probe is trusted before the router
+    re-probes; 0 = probe on every dispatch (tests)."""
+
+    request_timeout_s: float = Field(120.0, gt=0)
+    """Per-hop upstream timeout (a replica that blocks longer fails over or
+    errors the client request)."""
+
+    max_attempts: int = Field(3, ge=1)
+    """Dispatch attempts per request leg: a 503/429/connection error excludes
+    the replica and retries on the next candidate, up to this bound (and never
+    more than the pool size)."""
+
+    drain_timeout_s: float = Field(30.0, ge=0)
+    """Per-replica graceful-drain budget (in-flight requests get this long to
+    finish before being cancelled)."""
+
+    max_resume_body_bytes: int = Field(DEFAULT_MAX_RESUME_BODY_BYTES, gt=0)
+    """Upper bound on a client ``POST /v1/resume`` body at the router (the
+    base64 KV-handoff payload; fully buffered per handler thread — see
+    ``ServingConfig.max_resume_body_bytes``)."""
+
+    autoscale: AutoscaleConfig = AutoscaleConfig()
+    """Elastic scaling policy (``fleet/policy.py``)."""

@@ -1,0 +1,158 @@
+"""Replica manager: the fleet's registry and lifecycle authority.
+
+Owns the set of replicas the router dispatches over — in-process
+:class:`LocalReplica` pairs built from an ``engine_factory`` (the tier-1
+CPU-testable mode) and/or :class:`HttpReplica` upstreams pointing at external
+``serving/server.py`` processes. The autoscaler (``fleet/policy.py``) grows
+and shrinks pools through the same ``add_local``/``drain`` calls an operator
+would use.
+
+Per-role pools implement the prefill/decode disaggregation topology: a
+replica's role (``mixed`` | ``prefill`` | ``decode``) is fixed at
+registration; the router picks the pool per request leg.
+"""
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from deepspeed_tpu.fleet.config import FleetConfig
+from deepspeed_tpu.fleet.metrics import FleetMetrics
+from deepspeed_tpu.fleet.replica import (HttpReplica, LocalReplica, Replica,
+                                         ReplicaState)
+from deepspeed_tpu.serving import ServingConfig
+from deepspeed_tpu.utils.logging import logger
+
+
+class ReplicaManager:
+    """Registry + lifecycle for a fleet of replicas.
+
+    ``engine_factory`` is a zero-arg callable returning a fresh
+    ``InferenceEngineV2`` (identical KV geometry across calls — the handoff
+    transport validates it); required only when ``add_local`` is used.
+    """
+
+    def __init__(self, engine_factory: Optional[Callable] = None,
+                 config: Optional[FleetConfig] = None,
+                 serving_config: Optional[ServingConfig] = None):
+        self._engine_factory = engine_factory
+        self._config = config or FleetConfig()
+        self._serving_config = serving_config
+        self._metrics = FleetMetrics.maybe_create()
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, Replica] = {}
+
+    @property
+    def config(self) -> FleetConfig:
+        return self._config
+
+    # ---------------------------------------------------------------- add --
+    def add_local(self, role: str = "mixed",
+                  replica_id: Optional[str] = None) -> LocalReplica:
+        """Build one in-process replica (engine + scheduler) and register it."""
+        if self._engine_factory is None:
+            raise ValueError("ReplicaManager needs an engine_factory for add_local")
+        engine = self._engine_factory()
+        replica = LocalReplica(engine, role=role,
+                               serving_config=self._serving_config,
+                               replica_id=replica_id)
+        return self._register(replica)
+
+    def add_upstream(self, url: str, role: str = "mixed",
+                     replica_id: Optional[str] = None) -> HttpReplica:
+        """Register an external ``serving/server.py`` process by URL."""
+        replica = HttpReplica(url, role=role, replica_id=replica_id,
+                              timeout_s=self._config.request_timeout_s)
+        return self._register(replica)
+
+    def add(self, replica: Replica) -> Replica:
+        """Register an externally-constructed replica (custom
+        :class:`~deepspeed_tpu.fleet.replica.Replica` subclasses)."""
+        return self._register(replica)
+
+    def _register(self, replica: Replica) -> Replica:
+        with self._lock:
+            if replica.id in self._replicas:
+                replica.drain(timeout=0.0)
+                raise ValueError(f"replica id {replica.id} already registered")
+            self._replicas[replica.id] = replica
+        logger.info(f"fleet: replica {replica.id} (role={replica.role}) registered")
+        self._update_gauges()
+        return replica
+
+    # --------------------------------------------------------------- query --
+    def get(self, replica_id: str) -> Replica:
+        with self._lock:
+            return self._replicas[replica_id]
+
+    def replicas(self, role: Optional[str] = None,
+                 available_only: bool = False) -> List[Replica]:
+        """Snapshot of registered replicas, optionally one role's pool.
+        ``available_only`` drops DRAINING/DOWN members (the router's view)."""
+        with self._lock:
+            out = list(self._replicas.values())
+        if role is not None:
+            out = [r for r in out if r.role == role]
+        if available_only:
+            out = [r for r in out if r.available]
+        return out
+
+    def pool_size(self, role: Optional[str] = None) -> int:
+        return len(self.replicas(role=role, available_only=True))
+
+    # --------------------------------------------------------------- drain --
+    def drain(self, replica_id: str, timeout: Optional[float] = None,
+              remove: bool = True) -> None:
+        """Gracefully drain one replica: out of rotation immediately,
+        in-flight requests get up to ``timeout`` (default
+        ``config.drain_timeout_s``) to finish. ``remove`` deregisters it."""
+        replica = self.get(replica_id)
+        replica.drain(timeout=timeout if timeout is not None
+                      else self._config.drain_timeout_s)
+        if remove:
+            with self._lock:
+                self._replicas.pop(replica_id, None)
+        logger.info(f"fleet: replica {replica_id} drained")
+        self._update_gauges()
+
+    def drain_all(self, timeout: Optional[float] = None) -> None:
+        """Fleet-wide graceful drain (reverse registration order), used by
+        ``FleetRouter.stop()``."""
+        for replica in reversed(self.replicas()):
+            self.drain(replica.id, timeout=timeout, remove=False)
+
+    def close(self) -> None:
+        """Hard stop: drain with a zero budget and deregister everything."""
+        for replica in reversed(self.replicas()):
+            replica.drain(timeout=0.0)
+        with self._lock:
+            self._replicas.clear()
+        self._update_gauges()
+
+    # --------------------------------------------------------------- stats --
+    def _update_gauges(self) -> None:
+        if self._metrics:
+            self._metrics.replicas.set(
+                sum(1 for r in self.replicas() if r.state is not ReplicaState.DOWN))
+
+    def sweep_probes(self, max_age_s: Optional[float] = None) -> List[dict]:
+        """Refresh every replica's probe (bounded staleness) and push the
+        fleet-wide queue-depth / KV-pressure gauges; returns the probe docs.
+        The router calls this per dispatch pick; the autoscaler per tick."""
+        ttl = self._config.probe_ttl_s if max_age_s is None else max_age_s
+        probes = [r.probe(max_age_s=ttl) for r in self.replicas()]
+        live = [p for p in probes if p.get("healthy")]
+        if self._metrics:
+            self._metrics.queue_depth.set(sum(p["queue_depth"] for p in live))
+            if live:
+                self._metrics.kv_pressure.set(
+                    sum(1.0 - p.get("kv_free_frac", 1.0) for p in live) / len(live))
+        return probes
+
+    def stats(self) -> dict:
+        """/v1/fleet/stats body: per-replica rows + per-role pool sizes."""
+        replicas = self.replicas()
+        roles: Dict[str, int] = {}
+        for r in replicas:
+            if r.available:
+                roles[r.role] = roles.get(r.role, 0) + 1
+        return {"replicas": [r.describe() for r in replicas], "roles": roles}

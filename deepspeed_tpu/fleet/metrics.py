@@ -1,0 +1,50 @@
+"""Fleet telemetry on the unified registry (``deepspeed_tpu/telemetry``).
+
+Same zero-cost-when-disabled contract as ``serving/metrics.py``:
+``FleetMetrics.maybe_create()`` returns None unless a telemetry session is
+active, and every router/manager/policy call site is guarded by that None
+check — the disabled hot path performs no registry work.
+"""
+
+from typing import Optional
+
+# handoff payloads are KV-block dumps: kilobytes for a tiny test model,
+# hundreds of megabytes for a real one — spread the decades accordingly
+_HANDOFF_BUCKETS = (1 << 10, 16 << 10, 256 << 10, 1 << 20, 16 << 20,
+                    256 << 20, 1 << 30)
+
+
+class FleetMetrics:
+    """The fleet-layer metric family; one instance per router/manager pair."""
+
+    def __init__(self, registry):
+        self.replicas = registry.gauge(
+            "fleet_replicas", "Live (non-DOWN) replicas registered with the manager")
+        self.queue_depth = registry.gauge(
+            "fleet_queue_depth", "Fleet-wide queued requests at the last probe sweep")
+        self.kv_pressure = registry.gauge(
+            "fleet_kv_pressure", "Mean replica KV-pool occupancy (1 - free/capacity)")
+        self.requests = registry.counter(
+            "fleet_requests_total", "Client requests accepted by the router")
+        self.retries = registry.counter(
+            "fleet_dispatch_retries_total",
+            "Dispatch attempts that failed over to another replica")
+        self.failures = registry.counter(
+            "fleet_routing_failures_total",
+            "Requests that exhausted every candidate replica")
+        self.handoffs = registry.counter(
+            "fleet_handoffs_total", "Prefill→decode KV-block handoffs completed")
+        self.handoff_bytes = registry.histogram(
+            "fleet_handoff_bytes", "KV-handoff payload size",
+            buckets=_HANDOFF_BUCKETS)
+        self.scale_ups = registry.counter(
+            "fleet_scale_ups_total", "Autoscaler replica additions")
+        self.scale_downs = registry.counter(
+            "fleet_scale_downs_total", "Autoscaler replica drains")
+
+    @classmethod
+    def maybe_create(cls) -> Optional["FleetMetrics"]:
+        from deepspeed_tpu import telemetry
+        if not telemetry.is_active():
+            return None
+        return cls(telemetry.get_registry())

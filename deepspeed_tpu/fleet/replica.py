@@ -1,0 +1,367 @@
+"""Replica abstractions for the fleet layer.
+
+A *replica* is one independently-schedulable serving engine. Two kinds behind
+one dispatch interface, so the router never cares which it is talking to:
+
+- :class:`LocalReplica` — an ``(InferenceEngineV2 + ServingScheduler)`` pair
+  living in this process. The tier-1 CPU-testable formulation: a 4-replica
+  disaggregated fleet is four tiny engines and four scheduler threads, no
+  sockets between router and engine.
+- :class:`HttpReplica` — an external ``serving/server.py`` process addressed
+  by URL; dispatch is ``POST /v1/generate`` / ``POST /v1/resume`` over the
+  wire (SSE upstream, so admission errors surface before generation and
+  tokens arrive live), probing is ``GET /healthz`` + ``GET /v1/stats``.
+
+Dispatch returns a :class:`Leg` — a uniform handle the router iterates for
+live tokens and joins for the final result doc (which carries the KV-handoff
+payload as raw bytes when the leg was dispatched with ``handoff=True``).
+
+A replica that cannot admit right now (queue full, draining, connection
+refused) raises :class:`ReplicaUnavailable` at dispatch — the router's
+failover signal; client errors (bad payload geometry, invalid parameters)
+raise ``ValueError`` and are NOT retried elsewhere.
+"""
+
+import base64
+import itertools
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from enum import Enum
+from typing import Iterator, Optional
+
+from deepspeed_tpu.serving import (QueueFullError, SchedulerStopped, ServingConfig,
+                                   ServingScheduler)
+from deepspeed_tpu.serving.request import Request
+from deepspeed_tpu.serving.server import PARENT_SPAN_HEADER, TRACE_HEADER
+from deepspeed_tpu.utils.logging import logger
+
+_REPLICA_IDS = itertools.count()
+
+
+class ReplicaState(Enum):
+    UP = 0
+    DRAINING = 1
+    DOWN = 2
+
+
+class ReplicaUnavailable(RuntimeError):
+    """This replica cannot admit the request right now (429/503/unreachable);
+    the router fails over to the next candidate."""
+
+    def __init__(self, message: str, status: int = 503):
+        super().__init__(message)
+        self.status = status
+
+
+class Leg:
+    """One dispatched request leg: iterate for live tokens, ``result()`` for
+    the terminal doc (``serving/server._request_doc`` shape, with the handoff
+    payload — when requested — as raw bytes under ``"handoff"``)."""
+
+    def __iter__(self) -> Iterator[int]:
+        raise NotImplementedError
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        raise NotImplementedError
+
+    def cancel(self) -> None:
+        raise NotImplementedError
+
+
+class Replica:
+    """Base replica: identity, role, rotation state, probe caching, and the
+    router-maintained dispatch counters."""
+
+    def __init__(self, role: str = "mixed", replica_id: Optional[str] = None):
+        self.id = replica_id if replica_id else f"{role}-{next(_REPLICA_IDS)}"
+        self.role = role
+        self.state = ReplicaState.UP
+        self.dispatches = 0   # legs the router sent here (router thread)
+        self.failures = 0     # legs that raised ReplicaUnavailable here
+        self._probe_lock = threading.Lock()
+        self._probe_at = 0.0
+        self._probe_doc: Optional[dict] = None
+
+    @property
+    def available(self) -> bool:
+        """In rotation: the router only dispatches to available replicas."""
+        return self.state is ReplicaState.UP
+
+    # ------------------------------------------------------------------ probe --
+    def probe(self, max_age_s: float = 0.0) -> dict:
+        """Health + load snapshot, cached up to ``max_age_s`` (the router's
+        ``probe_ttl_s``): ``healthy`` / ``draining`` / ``queue_depth`` /
+        ``active`` / ``kv_free_frac`` / ``heartbeats``.
+
+        A ``_probe()`` against a blackholed HTTP upstream can block for its
+        full socket timeout, so a stale doc is served rather than queueing
+        every router handler thread behind the one doing the refresh — only
+        the very first probe (no doc yet) waits."""
+        doc = self._probe_doc
+        if doc is not None and time.monotonic() - self._probe_at <= max_age_s:
+            return doc
+        if not self._probe_lock.acquire(blocking=doc is None):
+            return doc  # a peer thread is refreshing; stale beats stalled
+        try:
+            now = time.monotonic()
+            if self._probe_doc is None or now - self._probe_at > max_age_s:
+                try:
+                    self._probe_doc = self._probe()
+                except Exception as e:
+                    self._probe_doc = {"healthy": False, "draining": False,
+                                       "queue_depth": 0, "active": 0,
+                                       "kv_free_frac": 0.0, "heartbeats": 0,
+                                       "error": f"{type(e).__name__}: {e}"}
+                self._probe_at = now
+            return self._probe_doc
+        finally:
+            self._probe_lock.release()
+
+    def _probe(self) -> dict:
+        raise NotImplementedError
+
+    @property
+    def load(self) -> int:
+        """Least-loaded ordering key from the last probe (queued + in-flight)."""
+        doc = self._probe_doc or {}
+        return int(doc.get("queue_depth", 0)) + int(doc.get("active", 0))
+
+    # --------------------------------------------------------------- dispatch --
+    def dispatch(self, doc: dict, resume: bool = False,
+                 trace_id: Optional[str] = None,
+                 parent_span_id: Optional[int] = None) -> Leg:
+        """Admit one request leg. ``doc`` is the client-wire JSON body
+        (``prompt`` for generate, ``payload`` bytes for resume, plus the
+        optional sampling/deadline fields and the ``handoff`` flag). Raises
+        :class:`ReplicaUnavailable` when this replica cannot admit."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------- lifecycle --
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Leave rotation, let in-flight requests finish (bounded), then stop."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        self.drain(timeout=0.0)
+
+    def describe(self) -> dict:
+        """/v1/fleet/stats row."""
+        return {"id": self.id, "role": self.role, "state": self.state.name,
+                "url": getattr(self, "url", None),
+                "dispatches": self.dispatches, "failures": self.failures,
+                "probe": self._probe_doc}
+
+
+# ---------------------------------------------------------------------------
+# in-process replica
+# ---------------------------------------------------------------------------
+class _LocalLeg(Leg):
+
+    def __init__(self, req: Request):
+        self.request = req
+
+    def __iter__(self):
+        return iter(self.request.stream)
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        req = self.request
+        if not req.wait(timeout):
+            raise TimeoutError(f"leg {req.uid} not finished within {timeout}s")
+        from deepspeed_tpu.serving.server import _request_doc
+        return _request_doc(req, raw_handoff=True)
+
+    def cancel(self) -> None:
+        self.request.cancel()
+
+
+class LocalReplica(Replica):
+    """An in-process ``engine + scheduler`` replica. The engine is owned:
+    ``drain()``/``close()`` stop the scheduler and close the engine.
+
+    ``serving_config`` defaults to heartbeating while idle (``empty_run``)
+    regardless of expert parallelism — a fleet pool member must stay warm (and,
+    under EP, in collective lock-step) while its peers take traffic.
+    """
+
+    def __init__(self, engine, role: str = "mixed",
+                 serving_config: Optional[ServingConfig] = None,
+                 replica_id: Optional[str] = None):
+        super().__init__(role=role, replica_id=replica_id)
+        self.engine = engine
+        if serving_config is None:
+            serving_config = ServingConfig(heartbeat_enabled=True)
+        elif serving_config.heartbeat_enabled is None:
+            # the pool-member warmth contract holds for custom configs too:
+            # only an explicit False opts a replica out of idle empty_run
+            serving_config = serving_config.model_copy(
+                update={"heartbeat_enabled": True})
+        self.scheduler = ServingScheduler(engine, serving_config)
+        self._capacity_blocks = engine._state_manager.kv_cache.num_blocks
+
+    def _probe(self) -> dict:
+        sched = self.scheduler
+        free = self.engine.free_blocks
+        return {
+            "healthy": self.state is ReplicaState.UP and not sched._stopping,
+            "draining": self.state is ReplicaState.DRAINING or sched._stopping,
+            "queue_depth": sched.queue_depth,
+            "active": sched.n_active,
+            "kv_free_frac": free / self._capacity_blocks if self._capacity_blocks else 0.0,
+            "heartbeats": sched._counters["heartbeats"],
+        }
+
+    def dispatch(self, doc: dict, resume: bool = False,
+                 trace_id: Optional[str] = None,
+                 parent_span_id: Optional[int] = None) -> Leg:
+        if not self.available:
+            raise ReplicaUnavailable(f"replica {self.id} is {self.state.name}")
+        kwargs = dict(max_new_tokens=doc.get("max_new_tokens"),
+                      temperature=float(doc.get("temperature") or 0.0),
+                      eos_token_id=doc.get("eos_token_id"),
+                      deadline_s=doc.get("deadline_s"),
+                      seed=int(doc.get("seed") or 0),
+                      trace_id=trace_id, parent_span_id=parent_span_id,
+                      handoff=bool(doc.get("handoff")))
+        try:
+            if resume:
+                req = self.scheduler.submit_resume(doc["payload"], **kwargs)
+            else:
+                req = self.scheduler.submit(doc["prompt"], **kwargs)
+        except QueueFullError as e:
+            raise ReplicaUnavailable(str(e), status=429) from e
+        except SchedulerStopped as e:
+            raise ReplicaUnavailable(str(e), status=503) from e
+        return _LocalLeg(req)
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        if self.state is ReplicaState.DOWN:
+            return
+        self.state = ReplicaState.DRAINING  # out of rotation immediately
+        self.scheduler.stop(drain=timeout != 0.0, timeout=timeout)
+        self.engine.close()
+        self.state = ReplicaState.DOWN
+
+
+# ---------------------------------------------------------------------------
+# HTTP upstream replica
+# ---------------------------------------------------------------------------
+class _HttpLeg(Leg):
+    """SSE leg against a ``serving/server.py`` upstream. The upstream is
+    always dispatched streaming, so admission status arrives before any
+    generation and tokens can be forwarded live; ``result()`` drains the
+    stream and returns the final ``done`` doc."""
+
+    def __init__(self, resp):
+        self._resp = resp
+        self._final: Optional[dict] = None
+        self._lock = threading.Lock()
+
+    def __iter__(self):
+        for line in self._resp:
+            line = line.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            event = json.loads(line[len("data: "):])
+            if event.get("done"):
+                if "handoff" in event:
+                    event["handoff"] = base64.b64decode(event["handoff"])
+                with self._lock:
+                    self._final = event
+                return
+            yield int(event["token"])
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        with self._lock:
+            final = self._final
+        if final is None:
+            for _ in self:  # drain to the done event
+                pass
+            with self._lock:
+                final = self._final
+        if final is None:
+            raise RuntimeError("upstream stream ended without a done event")
+        return final
+
+    def cancel(self) -> None:
+        # dropping the connection cancels upstream (serving/server.py contract)
+        try:
+            self._resp.close()
+        except Exception:  # pragma: no cover - best effort
+            pass
+
+
+class HttpReplica(Replica):
+    """An external ``serving/server.py`` process addressed by base URL."""
+
+    def __init__(self, url: str, role: str = "mixed",
+                 replica_id: Optional[str] = None, timeout_s: float = 120.0):
+        super().__init__(role=role, replica_id=replica_id)
+        self.url = url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def _get_json(self, path: str, timeout: float) -> dict:
+        with urllib.request.urlopen(self.url + path, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    def _probe(self) -> dict:
+        health = self._get_json("/healthz", timeout=min(self.timeout_s, 5.0))
+        stats = self._get_json("/v1/stats", timeout=min(self.timeout_s, 5.0))
+        engine = stats.get("engine", {})
+        capacity = engine.get("capacity_blocks") or 0
+        free = engine.get("free_blocks") or 0
+        return {
+            "healthy": health.get("status") == "ok" and self.state is ReplicaState.UP,
+            "draining": health.get("status") == "draining"
+                        or self.state is ReplicaState.DRAINING
+                        or bool(stats.get("draining")),
+            "queue_depth": int(stats.get("queue_depth", 0)),
+            "active": int(stats.get("active", {}).get("total", 0)),
+            "kv_free_frac": free / capacity if capacity else 1.0,
+            "heartbeats": int(stats.get("counters", {}).get("heartbeats", 0)),
+        }
+
+    def dispatch(self, doc: dict, resume: bool = False,
+                 trace_id: Optional[str] = None,
+                 parent_span_id: Optional[int] = None) -> Leg:
+        if not self.available:
+            raise ReplicaUnavailable(f"replica {self.id} is {self.state.name}")
+        body = dict(doc)
+        body["stream"] = True  # SSE upstream: early admission status, live tokens
+        if resume:
+            body["payload"] = base64.b64encode(doc["payload"]).decode()
+        headers = {"Content-Type": "application/json"}
+        if trace_id is not None:
+            headers[TRACE_HEADER] = trace_id
+        if parent_span_id is not None:
+            headers[PARENT_SPAN_HEADER] = str(parent_span_id)
+        path = "/v1/resume" if resume else "/v1/generate"
+        req = urllib.request.Request(self.url + path,
+                                     data=json.dumps(body).encode(),
+                                     headers=headers)
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.timeout_s)
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = json.loads(e.read()).get("error", "")
+            except Exception:
+                pass
+            if e.code in (429, 503):
+                raise ReplicaUnavailable(
+                    f"replica {self.id}: HTTP {e.code} {detail}", status=e.code) from e
+            raise ValueError(f"replica {self.id}: HTTP {e.code} {detail}") from e
+        except urllib.error.URLError as e:
+            raise ReplicaUnavailable(f"replica {self.id}: {e.reason}") from e
+        return _HttpLeg(resp)
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        # the upstream process is not ours to stop: drain = leave rotation
+        # for good (its own operator runs server.stop()). DOWN, not DRAINING —
+        # a permanently-DRAINING replica would count as live capacity in the
+        # fleet_replicas gauge and /v1/fleet/stats forever
+        if self.state is not ReplicaState.DOWN:
+            logger.info(f"fleet: upstream replica {self.id} out of rotation")
+            self.state = ReplicaState.DOWN

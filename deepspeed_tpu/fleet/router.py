@@ -1,0 +1,530 @@
+"""Front-end fleet router: one HTTP endpoint over N serving replicas.
+
+Same wire format as ``serving/server.py`` (``POST /v1/generate`` with
+optional SSE streaming, ``POST /v1/resume``, ``GET /v1/stats``,
+``GET /healthz``) plus ``GET /v1/fleet/stats`` (per-replica dispatch counts,
+roles, probes — what ``bin/dstpu_loadgen`` prints per-replica attribution
+from). A client cannot tell the router from a single replica, which is the
+point: "millions of users" is N replicas behind this process.
+
+Dispatch policy per request leg:
+
+- **session affinity**: a session key (the ``X-DSTPU-Session`` header or the
+  JSON ``session`` field) rendezvous-hashes over the healthy pool — stable
+  under replica loss: keys only move off a replica that left.
+- **least-loaded**: without a key, the replica with the fewest
+  queued+in-flight requests wins (probes cached ``probe_ttl_s``, driven by
+  the ``/healthz`` + ``/v1/stats`` surfaces for HTTP upstreams).
+- **failover**: a 429/503/unreachable replica is excluded and the next
+  candidate tried, up to ``max_attempts``.
+
+Prefill/decode disaggregation: when both a ``prefill`` and a ``decode`` pool
+exist, a generate request runs as two legs — prefill + first token on a
+prefill-role replica (``handoff=True``), then the portable KV payload
+(``ragged/handoff.py``) continues on a decode-role replica via
+``/v1/resume`` — so TTFT capacity and ITL capacity scale independently. The
+router parents both replica request spans under its own span, so the
+Perfetto track reads router → prefill replica → decode replica as one trace.
+"""
+
+import base64
+import hashlib
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Iterator, List, Optional
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.fleet.config import FleetConfig
+from deepspeed_tpu.fleet.manager import ReplicaManager
+from deepspeed_tpu.fleet.metrics import FleetMetrics
+from deepspeed_tpu.fleet.replica import Leg, Replica, ReplicaUnavailable
+from deepspeed_tpu.serving.server import TRACE_HEADER, parse_request_body
+from deepspeed_tpu.telemetry import new_span_id, new_trace_id, now_us
+from deepspeed_tpu.utils.logging import logger
+
+# request fields forwarded verbatim to a replica leg (everything else —
+# stream, session, handoff — is router-interpreted, never blind-forwarded)
+_LEG_FIELDS = ("max_new_tokens", "temperature", "eos_token_id", "deadline_s",
+               "seed")
+
+
+class RoutingError(RuntimeError):
+    """No replica could take the request (all candidates excluded or
+    unavailable); ``status`` is the HTTP code the client sees (503, or 429
+    when the last refusal was backpressure)."""
+
+    def __init__(self, message: str, status: int = 503):
+        super().__init__(message)
+        self.status = status
+
+
+def _rendezvous_score(session_key: str, replica_id: str) -> int:
+    digest = hashlib.md5(f"{session_key}\x00{replica_id}".encode()).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+class RoutedRequest:
+    """One client request in flight through the router.
+
+    The first leg is dispatched in the constructor, so admission problems
+    (everything down, fleet-wide backpressure) raise :class:`RoutingError`
+    before any response bytes are written; iterate ``tokens()`` for the live
+    cross-leg stream, then ``result()`` for the merged final doc.
+    """
+
+    def __init__(self, router: "FleetRouter", doc: dict, resume: bool,
+                 session_key: Optional[str], trace_id: Optional[str]):
+        self._router = router
+        self._doc = doc
+        self._resume = resume
+        self._session_key = session_key
+        self.trace_id = trace_id
+        self._root_span_id = new_span_id() if trace_id is not None else None
+        self._t0_us = now_us()
+        self._t0_s = time.monotonic()
+        self._final: Optional[dict] = None
+        self._current_leg: Optional[Leg] = None
+        self._legs_meta: List[dict] = []
+        self._cancelled = False
+
+        mgr = router._manager
+        prefill_pool = mgr.replicas(role="prefill", available_only=True)
+        decode_pool = mgr.replicas(role="decode", available_only=True)
+        mnt = doc.get("max_new_tokens")
+        # `is None`, not falsy-or: an explicit 0 must flow through to the
+        # replica's own 'max_new_tokens must be >= 1' 400, exactly as it
+        # would on a single server — not become a default-budget completion
+        self._n = int(router._config.default_max_new_tokens if mnt is None else mnt)
+        self._client_handoff = bool(doc.get("handoff"))
+        self._disagg = (not resume and bool(prefill_pool) and bool(decode_pool)
+                        and self._n > 1)
+        if self._disagg:
+            self._leg1 = self._dispatch(
+                self._leg_doc(prompt=doc["prompt"], max_new_tokens=1,
+                              handoff=True),
+                resume=False, pool=prefill_pool, what="prefill")
+        elif resume:
+            pool = decode_pool or mgr.replicas(available_only=True)
+            self._leg1 = self._dispatch(
+                self._leg_doc(payload=doc["payload"],
+                              handoff=self._client_handoff),
+                resume=True, pool=pool, what="resume")
+        else:
+            # whole-request serving: the mixed pool when one exists, else any
+            # available replica (a fleet missing one disaggregated side
+            # degrades to serving whole requests wherever it can)
+            pool = (mgr.replicas(role="mixed", available_only=True)
+                    or mgr.replicas(available_only=True))
+            self._leg1 = self._dispatch(
+                self._leg_doc(prompt=doc["prompt"],
+                              handoff=self._client_handoff),
+                resume=False, pool=pool, what="generate")
+        self._iter = self._run()
+
+    def tokens(self) -> Iterator[int]:
+        return self._iter
+
+    def result(self) -> dict:
+        for _ in self._iter:  # drain whatever the caller didn't consume
+            pass
+        assert self._final is not None
+        return self._final
+
+    def cancel(self) -> None:
+        """Client went away: cancel the active leg so its KV frees upstream."""
+        self._cancelled = True
+        leg = self._current_leg
+        if leg is not None:
+            leg.cancel()
+
+    # ---------------------------------------------------------------- legs --
+    def _dispatch(self, doc: dict, resume: bool, pool: List[Replica],
+                  what: str) -> Leg:
+        """Failover dispatch over ``pool``: an unavailable replica (429/503/
+        unreachable) is excluded and the next candidate tried; the chosen
+        replica's request root parents under a per-hop router span."""
+        router = self._router
+        cfg = router._config
+        exclude = set()
+        last: Optional[ReplicaUnavailable] = None
+        for _ in range(min(cfg.max_attempts, max(1, len(pool)))):
+            candidates = router._healthy(pool, exclude)
+            if not candidates:
+                break
+            replica = router._pick(candidates, self._session_key)
+            hop_span = new_span_id() if self.trace_id is not None else None
+            t0 = now_us()
+            with router._counter_lock:  # handler threads race on attribution
+                replica.dispatches += 1
+            try:
+                leg = replica.dispatch(doc, resume=resume,
+                                       trace_id=self.trace_id,
+                                       parent_span_id=hop_span)
+            except ReplicaUnavailable as e:
+                with router._counter_lock:
+                    replica.failures += 1
+                exclude.add(replica.id)
+                last = e
+                if router._metrics:
+                    router._metrics.retries.inc()
+                logger.info(f"fleet: {what} leg failed over from {replica.id}: {e}")
+                continue
+            spans = telemetry.get_span_recorder()
+            if spans is not None and self.trace_id is not None:
+                # the hop span is recorded up-front (instant event): its id
+                # must exist in the trace for the replica's request root —
+                # recorded at the replica's own finalize — to parent under
+                spans.record(f"dispatch:{what}", cat="fleet", ts_us=t0,
+                             trace_id=self.trace_id, span_id=hop_span,
+                             parent_id=self._root_span_id,
+                             args={"replica": replica.id, "role": replica.role,
+                                   "excluded": sorted(exclude)})
+            self._current_leg = leg
+            self._last_replica_id = replica.id
+            return leg
+        if router._metrics:
+            router._metrics.failures.inc()
+        status = last.status if last is not None else 503
+        raise RoutingError(
+            f"no replica available for {what} leg "
+            f"({len(pool)} in pool, {len(exclude)} excluded): {last}", status)
+
+    def _leg_doc(self, **overrides) -> dict:
+        doc = {k: self._doc[k] for k in _LEG_FIELDS if self._doc.get(k) is not None}
+        doc.update(overrides)
+        return doc
+
+    def _leg_meta(self, kind: str, final: dict) -> None:
+        self._legs_meta.append({"replica": self._last_replica_id, "kind": kind,
+                                "uid": final.get("uid"),
+                                "n_tokens": final.get("n_tokens")})
+
+    # --------------------------------------------------------------- route --
+    def _run(self) -> Iterator[int]:
+        router = self._router
+        if not self._disagg:
+            for tok in self._leg1:
+                yield tok
+            final = dict(self._leg1.result())
+            self._leg_meta("resume" if self._resume else "serve", final)
+            if not self._client_handoff:
+                final.pop("handoff", None)
+        else:
+            # --- leg 1 result: prefill + first token
+            final1 = self._leg1.result()
+            for tok in final1["tokens"]:
+                yield tok
+            self._leg_meta("prefill", final1)
+            payload = final1.get("handoff")
+            continuable = (final1.get("state") == "DONE"
+                           and final1.get("finish_reason") == "length"
+                           and payload is not None and not self._cancelled)
+            if not continuable:
+                if (payload is None and not self._cancelled and self._n > 1
+                        and final1.get("state") == "DONE"
+                        and final1.get("finish_reason") == "length"):
+                    # the donor stopped at the handoff point but exported no
+                    # payload (export failed replica-side): returning leg 1
+                    # verbatim would silently truncate the request to one
+                    # token dressed up as a clean completion
+                    raise RoutingError(
+                        f"prefill replica produced no handoff payload for "
+                        f"uid {final1.get('uid')}", status=502)
+                # eos on the first token, cancel, or a failed prefill: the
+                # first leg's outcome IS the request's outcome
+                final = dict(final1)
+                final.pop("handoff", None)  # internal transport, not client data
+            else:
+                # --- leg 2: decode continuation on the decode pool
+                remaining = None
+                if self._doc.get("deadline_s") is not None:
+                    remaining = max(0.001, float(self._doc["deadline_s"])
+                                    - (time.monotonic() - self._t0_s))
+                decode_pool = router._manager.replicas(role="decode",
+                                                       available_only=True)
+                leg2 = self._dispatch(
+                    self._leg_doc(payload=payload,
+                                  max_new_tokens=self._n - 1,
+                                  handoff=self._client_handoff,
+                                  deadline_s=remaining),
+                    resume=True, pool=decode_pool, what="decode")
+                if router._metrics:
+                    router._metrics.handoffs.inc()
+                    router._metrics.handoff_bytes.observe(len(payload))
+                for tok in leg2:
+                    yield tok
+                final2 = leg2.result()
+                self._leg_meta("decode", final2)
+                tokens = list(final1["tokens"]) + list(final2["tokens"])
+                final = {
+                    "uid": final2.get("uid"),
+                    "tokens": tokens,
+                    "n_tokens": len(tokens),
+                    "state": final2.get("state"),
+                    "finish_reason": final2.get("finish_reason"),
+                    "error": final2.get("error"),
+                    "ttft_s": final1.get("ttft_s"),
+                    "e2e_s": time.monotonic() - self._t0_s,
+                }
+                if "handoff" in final2:  # the CLIENT asked for a payload
+                    final["handoff"] = final2["handoff"]
+
+        final["trace_id"] = self.trace_id
+        final["legs"] = self._legs_meta
+        spans = telemetry.get_span_recorder()
+        if spans is not None and self.trace_id is not None:
+            spans.record("route", cat="fleet", ts_us=self._t0_us,
+                         dur_us=now_us() - self._t0_us,
+                         trace_id=self.trace_id, span_id=self._root_span_id,
+                         args={"disaggregated": self._disagg,
+                               "state": final.get("state"),
+                               "legs": [m["replica"] for m in self._legs_meta]})
+        self._final = final
+
+
+class FleetRouter:
+    """The fleet front-end: routing core + stdlib HTTP listener."""
+
+    def __init__(self, manager: ReplicaManager, config: Optional[FleetConfig] = None):
+        self._manager = manager
+        self._config = config or manager.config
+        self._metrics = FleetMetrics.maybe_create()
+        self._counters = {"requests": 0}
+        self._counter_lock = threading.Lock()
+        self._server = None
+        self._thread = None
+        self._draining = threading.Event()
+
+    @property
+    def manager(self) -> ReplicaManager:
+        return self._manager
+
+    # ------------------------------------------------------------- dispatch --
+    def _healthy(self, pool: List[Replica], exclude) -> List[Replica]:
+        ttl = self._config.probe_ttl_s
+        out = []
+        for replica in pool:
+            if replica.id in exclude or not replica.available:
+                continue
+            probe = replica.probe(max_age_s=ttl)
+            if probe.get("healthy") and not probe.get("draining"):
+                out.append(replica)
+        return out
+
+    def _pick(self, candidates: List[Replica], session_key: Optional[str]) -> Replica:
+        """Affinity (rendezvous hash) when a session key rides the request,
+        least-loaded otherwise; candidates are already healthy-filtered."""
+        if session_key:
+            return max(candidates,
+                       key=lambda r: _rendezvous_score(session_key, r.id))
+        return min(candidates, key=lambda r: (r.load, r.id))
+
+    def route(self, doc: dict, resume: bool = False,
+              session_key: Optional[str] = None,
+              trace_id: Optional[str] = None) -> RoutedRequest:
+        """Admit one client request; the first leg is dispatched before this
+        returns (admission failures raise :class:`RoutingError`).
+        ``trace_id`` adopts an upstream trace (minted otherwise when
+        telemetry is active); the router span parents both replica legs."""
+        if self._draining.is_set():
+            raise RoutingError("router is draining", status=503)
+        with self._counter_lock:
+            self._counters["requests"] += 1
+        if self._metrics:
+            self._metrics.requests.inc()
+        # no fleet-wide probe sweep here: _healthy probes the candidate pool
+        # (TTL-cached) during dispatch; a dead upstream elsewhere in the fleet
+        # must not add its probe timeout to THIS request's latency. The
+        # fleet-wide gauges are pushed by stats()/the autoscaler tick instead.
+        if trace_id is None and telemetry.get_span_recorder() is not None:
+            trace_id = new_trace_id()
+        return RoutedRequest(self, doc, resume, session_key, trace_id)
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Fleet-wide graceful drain: stop admitting (503), then drain every
+        replica bounded by ``drain_timeout_s`` each."""
+        self._draining.set()
+        self._manager.drain_all(timeout=timeout)
+
+    # ---------------------------------------------------------------- stats --
+    def fleet_stats(self) -> dict:
+        doc = self._manager.stats()
+        with self._counter_lock:
+            doc["router"] = dict(self._counters)
+        doc["router"]["draining"] = self._draining.is_set()
+        return doc
+
+    def stats(self) -> dict:
+        """Aggregate ``/v1/stats`` (single-replica wire shape, fleet-wide
+        numbers) so loadgen-style clients work unchanged through the router."""
+        probes = self._manager.sweep_probes()
+        live = [p for p in probes if p.get("healthy")]
+        with self._counter_lock:
+            counters = dict(self._counters)
+        return {
+            "queue_depth": sum(p["queue_depth"] for p in live),
+            "active": {"total": sum(p["active"] for p in live)},
+            "replicas": len(probes),
+            "draining": self._draining.is_set(),
+            "counters": counters,
+        }
+
+    # ----------------------------------------------------------------- HTTP --
+    @property
+    def address(self):
+        return self._server.server_address if self._server else None
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FleetRouter":
+        router, config, draining = self, self._config, self._draining
+
+        class Handler(BaseHTTPRequestHandler):
+
+            def _send_json(self, code, doc, trace_id=None):
+                data = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                if trace_id is not None:
+                    self.send_header(TRACE_HEADER, trace_id)
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if path == "/v1/fleet/stats":
+                    self._send_json(200, router.fleet_stats())
+                elif path == "/v1/stats":
+                    self._send_json(200, router.stats())
+                elif path == "/healthz":
+                    self._send_json(200, {"status": "draining" if draining.is_set()
+                                          else "ok"})
+                else:
+                    self._send_json(404, {"error": f"no route {path}"})
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path not in ("/v1/generate", "/v1/resume"):
+                    self._send_json(404, {"error": f"no route {path}"})
+                    return
+                if draining.is_set():
+                    self._send_json(503, {"error": "router is draining"})
+                    return
+                resume = path == "/v1/resume"
+                try:
+                    # the single wire-format authority, shared with
+                    # serving/server.py: a client cannot tell the router
+                    # from one replica
+                    doc = parse_request_body(
+                        self, resume=resume,
+                        max_bytes=config.max_resume_body_bytes if resume else None)
+                except (KeyError, ValueError, TypeError) as e:
+                    self._send_json(400, {"error": str(e)})
+                    return
+                session_key = (self.headers.get(config.affinity_header)
+                               or doc.get("session") or None)
+                upstream_trace = self.headers.get(TRACE_HEADER) or None
+                try:
+                    routed = router.route(doc, resume=resume,
+                                          session_key=session_key,
+                                          trace_id=upstream_trace)
+                except RoutingError as e:
+                    self._send_json(e.status, {"error": str(e)})
+                    return
+                except (ValueError, TypeError) as e:
+                    self._send_json(400, {"error": str(e)})
+                    return
+                try:
+                    if doc.get("stream"):
+                        self._stream_sse(routed)
+                    else:
+                        final = dict(routed.result())
+                        self._encode_handoff(final)
+                        self._send_json(200, final, trace_id=routed.trace_id)
+                except RoutingError as e:
+                    # mid-route failure (e.g. the decode pool vanished after
+                    # the prefill leg): non-stream mode can still say why
+                    routed.cancel()
+                    self._send_json(e.status, {"error": str(e)})
+                except (ValueError, TypeError) as e:
+                    routed.cancel()
+                    self._send_json(400, {"error": str(e)})
+                except RuntimeError as e:
+                    # a replica died mid-leg (e.g. an upstream SSE ended with
+                    # no done event): answer 502, free the surviving leg's KV
+                    routed.cancel()
+                    self._send_json(502, {"error": str(e)})
+
+            @staticmethod
+            def _encode_handoff(doc):
+                if isinstance(doc.get("handoff"), (bytes, bytearray)):
+                    doc["handoff"] = base64.b64encode(doc["handoff"]).decode()
+
+            def _stream_sse(self, routed):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                if routed.trace_id is not None:
+                    self.send_header(TRACE_HEADER, routed.trace_id)
+                self.end_headers()
+                try:
+                    for i, tok in enumerate(routed.tokens()):
+                        self.wfile.write(
+                            f"data: {json.dumps({'token': tok, 'index': i})}\n\n".encode())
+                        self.wfile.flush()
+                    final = dict(routed.result())
+                    self._encode_handoff(final)
+                    self.wfile.write(
+                        f"data: {json.dumps({'done': True, **final})}\n\n".encode())
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    routed.cancel()  # client went away: free KV upstream
+                except (RoutingError, RuntimeError, ValueError, TypeError) as e:
+                    # mid-stream routing failure, a replica dying mid-leg, or a
+                    # malformed upstream event: the SSE headers are already on
+                    # the wire, so the ONLY valid reaction is a terminal error
+                    # event — never a second HTTP status line.
+                    # Free the surviving leg's KV, best-effort error event
+                    routed.cancel()
+                    try:
+                        self.wfile.write(
+                            f"data: {json.dumps({'done': True, 'state': 'FAILED', 'error': str(e)})}\n\n".encode())
+                        self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
+
+            def log_message(self, fmt, *args):
+                ...  # routing must not spam the serving log
+
+        self._server = ThreadingHTTPServer((self._config.host, self._config.port),
+                                           Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="dstpu-fleet-router", daemon=True)
+        self._thread.start()
+        logger.info(f"fleet router: /v1/generate /v1/resume /v1/stats "
+                    f"/v1/fleet/stats /healthz on {self.url}")
+        return self
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """Graceful fleet shutdown: 503 new requests, drain every replica,
+        close the listener. Idempotent."""
+        self.drain(timeout=(timeout if timeout is not None
+                            else self._config.drain_timeout_s) if drain else 0.0)
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+            self._thread = None
+
+    def __enter__(self):
+        return self.start() if self._server is None else self
+
+    def __exit__(self, *exc):
+        self.stop(drain=False)

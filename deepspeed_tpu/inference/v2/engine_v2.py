@@ -405,6 +405,32 @@ class InferenceEngineV2:
     def is_offloaded(self, uid: int) -> bool:
         return self._state_manager.is_offloaded(uid)
 
+    # ------------------------------------------------------------- kv handoff --
+    def export_sequence(self, uid: int, tokens=(), extra: Optional[dict] = None,
+                        seen_tokens: Optional[int] = None) -> bytes:
+        """Snapshot ``uid`` as a portable bytes payload — token history, KV-block
+        contents and caller ``extra`` state — for :meth:`import_sequence` on
+        ANOTHER engine: the fleet prefill→decode KV-block handoff transport,
+        built on the same gather/scatter machinery as
+        :meth:`offload_sequence`/``restore_sequence`` but serializable across a
+        process or network boundary. ``seen_tokens`` caps the committed count
+        the recipient adopts (chunked decode feeds ahead of the kept history;
+        the recipient deterministically recomputes the trimmed tail). The
+        sequence stays tracked here; ``flush(uid)`` once the recipient has
+        taken over."""
+        from deepspeed_tpu.inference.v2.ragged.handoff import pack_sequence
+        return pack_sequence(self._state_manager, uid, tokens, extra=extra,
+                             seen_tokens=seen_tokens)
+
+    def import_sequence(self, payload: bytes, uid: Optional[int] = None) -> Tuple[int, dict]:
+        """Recreate an exported sequence from a :meth:`export_sequence` payload
+        under ``uid`` (default: the donor's uid); the next put/decode_loop
+        continues it exactly where the donor stopped. Returns ``(uid, header)``
+        — the header carries the token history and the exporter's ``extra``
+        generation state."""
+        from deepspeed_tpu.inference.v2.ragged.handoff import import_payload
+        return import_payload(self._state_manager, payload, uid=uid)
+
     def flush_all(self) -> None:
         """Recycle every tracked sequence's KV blocks (hybrid-engine post-
         generation cleanup; reference release_inference_cache role)."""

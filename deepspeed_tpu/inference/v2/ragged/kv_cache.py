@@ -88,6 +88,54 @@ class BlockedKVCache:
     def free(self, blocks):
         self._allocator.free(blocks)
 
+    def gather_blocks(self, blocks) -> np.ndarray:
+        """Device→host copy of ``blocks``' contents (every layer, K and V)
+        WITHOUT freeing them — the read half of :meth:`offload`, reused by the
+        fleet KV-handoff exporter (``ragged/handoff.py``), where the donor
+        keeps its blocks until the recipient has taken over."""
+        import jax
+        import jax.numpy as jnp
+
+        blocks = np.atleast_1d(np.asarray(blocks)).astype(np.int64)
+        return np.asarray(jax.device_get(self._cache[:, :, jnp.asarray(blocks)]))
+
+    def scatter_blocks(self, data) -> np.ndarray:
+        """Allocate fresh device blocks and write ``data`` (a
+        :meth:`gather_blocks`/offload-shaped payload
+        ``[layers, 2, n, kv_heads, block_size, head_dim]``) into them; returns
+        the new block ids — the write half of :meth:`restore`, reused by the
+        fleet KV-handoff importer. A failed allocation or write consumes
+        nothing."""
+        data = np.asarray(data)
+        num_layers, kv_heads, head_dim = self._config.cache_shape
+        expect = (num_layers, 2, kv_heads, self._config.block_size, head_dim)
+        got = data.shape[:2] + data.shape[3:] if data.ndim == 6 else None
+        if got != expect:
+            raise ValueError(
+                f"scatter_blocks: payload shape {data.shape} does not fit this "
+                f"cache's geometry [layers=2x{num_layers}, n, kv_heads={kv_heads}, "
+                f"block_size={self._config.block_size}, head_dim={head_dim}]")
+        new_blocks = self._allocator.allocate(data.shape[2])
+        try:
+            self._write_blocks(data, new_blocks)
+        except Exception:
+            self._allocator.free(new_blocks)
+            raise
+        return new_blocks
+
+    def _write_blocks(self, data, block_ids) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if self._restore_fn is None:
+            self._restore_fn = jax.jit(
+                lambda cache, payload, ids: cache.at[:, :, ids].set(payload.astype(cache.dtype)),
+                donate_argnums=(0, ))
+        new_cache = self._restore_fn(self._cache, jnp.asarray(data),
+                                     jnp.asarray(block_ids))
+        jax.block_until_ready(new_cache)
+        self._cache = new_cache
+
     def offload(self, blocks) -> int:
         """Move ``blocks``' contents (every layer, K and V) to the host tier
         and free the device blocks for reuse. Returns a handle for
@@ -101,11 +149,8 @@ class BlockedKVCache:
         functional-array formulation: the cache is an immutable jax array, so
         "parking" data in place has no meaning.
         """
-        import jax
-        import jax.numpy as jnp
-
         blocks = np.atleast_1d(np.asarray(blocks)).astype(np.int64)
-        data = np.asarray(jax.device_get(self._cache[:, :, jnp.asarray(blocks)]))
+        data = self.gather_blocks(blocks)
         handle = self._next_handle
         self._next_handle += 1
         if self._offload_path is not None:
@@ -122,33 +167,24 @@ class BlockedKVCache:
     def restore(self, handle: int) -> np.ndarray:
         """Allocate fresh device blocks, write the offloaded contents back,
         and return the new block ids (see :meth:`offload` on id stability)."""
-        import jax
-        import jax.numpy as jnp
-
         entry = self._host_pool[handle]
-        n = entry[2][2] if entry[0] == "nvme" else entry[1].shape[2]
-        new_blocks = self._allocator.allocate(n)  # may raise; nothing consumed yet
-        try:
-            if entry[0] == "nvme":
-                _, path, shape, dtype = entry
-                buf = np.empty(int(np.prod(shape)) * dtype.itemsize, np.uint8)
-                self._aio_handle().sync_pread(buf, path)
-                data = buf.view(dtype).reshape(shape)
-            else:
-                data = entry[1]
-            if self._restore_fn is None:
-                self._restore_fn = jax.jit(
-                    lambda cache, payload, ids: cache.at[:, :, ids].set(payload.astype(cache.dtype)),
-                    donate_argnums=(0, ))
-            new_cache = self._restore_fn(self._cache, jnp.asarray(data),
-                                         jnp.asarray(new_blocks))
-            jax.block_until_ready(new_cache)
-        except Exception:
-            # the payload stays in the pool (and on disk): the caller's
-            # evict-and-retry contract depends on it surviving a failed restore
-            self._allocator.free(new_blocks)
-            raise
-        self._cache = new_cache
+        needed = entry[2][2] if entry[0] == "nvme" else entry[1].shape[2]
+        if needed > self._allocator.free_blocks:
+            # fail before touching disk: the caller's evict-and-retry loop
+            # must not pay a full payload read per failed attempt
+            raise ValueError(
+                f"Allocator has {self._allocator.free_blocks} free blocks, "
+                f"but {needed} were requested")
+        if entry[0] == "nvme":
+            _, path, shape, dtype = entry
+            buf = np.empty(int(np.prod(shape)) * dtype.itemsize, np.uint8)
+            self._aio_handle().sync_pread(buf, path)
+            data = buf.view(dtype).reshape(shape)
+        else:
+            data = entry[1]
+        # on failure the payload stays in the pool (and on disk): the caller's
+        # evict-and-retry contract depends on it surviving a failed restore
+        new_blocks = self.scatter_blocks(data)
         del self._host_pool[handle]
         if entry[0] == "nvme":
             os.unlink(entry[1])

@@ -88,6 +88,52 @@ class DSStateManager:
             raise
         self._seqs[uid].replace_kv_blocks(new_blocks)
 
+    # ------------------------------------------------------------ kv handoff --
+    def export_sequence(self, uid: int) -> dict:
+        """Portable snapshot of a tracked sequence — committed-token count plus
+        KV-block contents — for :meth:`import_sequence` on another manager (the
+        fleet prefill→decode handoff; bytes framing lives in
+        ``ragged/handoff.py``). An offloaded sequence is restored first (its
+        payload is already host-side, but export must observe one canonical
+        path). The sequence stays tracked and resident here; the caller
+        flushes once the recipient has taken over."""
+        seq = self._seqs.get(uid)
+        if seq is None:
+            raise ValueError(f"export_sequence: unknown uid {uid}")
+        if seq.in_flight_tokens:
+            raise RuntimeError(f"export_sequence: uid {uid} has in-flight tokens")
+        if uid in self._offloaded:
+            self.restore_sequence(uid)
+        kv = (self._kv_cache.gather_blocks(seq.kv_blocks)
+              if seq.cur_allocated_blocks > 0 else None)
+        return {"uid": uid, "seen_tokens": seq.seen_tokens, "kv": kv}
+
+    def import_sequence(self, snapshot: dict, uid: Optional[int] = None) -> int:
+        """Recreate an exported sequence under ``uid`` (default: the donor's
+        uid): fresh device blocks, contents written back, committed-token
+        count restored. Raises without consuming anything when the uid is
+        already tracked, the payload's geometry doesn't fit this cache, or
+        the device pool can't hold it (evict and retry)."""
+        uid = int(snapshot["uid"] if uid is None else uid)
+        if uid in self._seqs:
+            raise ValueError(f"import_sequence: uid {uid} already tracked")
+        kv = snapshot["kv"]
+        seq = self._create_sequence(uid)
+        try:
+            if kv is not None:
+                if kv.shape[2] > seq.max_blocks:
+                    raise ValueError(
+                        f"import_sequence: payload holds {kv.shape[2]} blocks; "
+                        f"this manager caps sequences at {seq.max_blocks} "
+                        f"(max_context={self._config.max_context})")
+                seq.extend_kv_cache(self._kv_cache.scatter_blocks(kv))
+            seq.pre_forward(int(snapshot["seen_tokens"]))
+            seq.post_forward()
+        except Exception:
+            del self._seqs[uid]  # scatter freed its blocks on failure
+            raise
+        return uid
+
     @property
     def tracked_sequences(self) -> Dict[int, DSSequenceDescriptor]:
         return self._seqs

@@ -12,6 +12,11 @@ from pydantic import Field, field_validator
 
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 
+DEFAULT_MAX_RESUME_BODY_BYTES = 2 << 30
+"""One authority for the ``/v1/resume`` body bound — shared by
+``ServingConfig``, ``FleetConfig`` and ``serving/server.py`` so the router
+and a replica can never disagree on whether the same payload is admissible."""
+
 
 class ServingConfig(DeepSpeedConfigModel):
     """Knobs for the request scheduler + HTTP front-end."""
@@ -61,6 +66,13 @@ class ServingConfig(DeepSpeedConfigModel):
     port: int = Field(0, ge=0, le=65535)
     """Bind address for ``ServingServer``; port 0 = ephemeral (the bound
     address is on ``server.address`` after ``start()``)."""
+
+    max_resume_body_bytes: int = Field(DEFAULT_MAX_RESUME_BODY_BYTES, gt=0)
+    """Upper bound on a ``POST /v1/resume`` body (the base64 KV-handoff
+    payload; real-model KV runs to hundreds of MB and base64 adds 4/3). The
+    body is fully buffered per handler thread, so operators whose resume
+    endpoint is reachable beyond fleet-internal traffic should lower this to
+    their largest expected payload."""
 
     @field_validator("default_deadline_s")
     @classmethod

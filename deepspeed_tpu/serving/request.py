@@ -117,9 +117,21 @@ class Request:
         self.uid: Optional[int] = None  # assigned at admission by the scheduler
         # distributed-tracing identity: the scheduler assigns both when a
         # telemetry session is active; every lifecycle span parents under
-        # root_span_id and the HTTP layer returns trace_id to the client
+        # root_span_id and the HTTP layer returns trace_id to the client.
+        # A request arriving through the fleet router inherits its trace_id
+        # and parents its root under the router's span (parent_span_id).
         self.trace_id: Optional[str] = None
         self.root_span_id: Optional[int] = None
+        self.parent_span_id: Optional[int] = None
+        # fleet KV handoff: a handoff-requested request exports its engine
+        # state as a portable payload when it finishes DONE (prefill role);
+        # a resume request carries a peer's payload in and enters DECODE
+        # directly once the scheduler imports it (decode role)
+        self.handoff_requested = False
+        self.handoff_payload: Optional[bytes] = None
+        self._resume_payload: Optional[bytes] = None
+        self._resume_header: Optional[dict] = None
+        self._resume_kv = None  # parsed KV view into _resume_payload
         self.tokens: List[int] = []
         self.stream = TokenStream()
         self.error: Optional[str] = None

@@ -85,6 +85,10 @@ class ServingScheduler:
         self._not_full = threading.Condition(self._lock)
         self._queue: deque = deque()
         self._active: Dict[int, Request] = {}  # uid -> Request, admission order
+        # the request _admit popped but has not yet activated (a resume KV
+        # import runs in this window, off the lock): it is neither queued nor
+        # active, but drain and load accounting must still see it
+        self._admitting: Optional[Request] = None
         self._uids = itertools.count()
         self._counters = {k: 0 for k in
                           ("submitted", "rejected", "completed", "cancelled",
@@ -143,12 +147,22 @@ class ServingScheduler:
                temperature: float = 0.0,
                eos_token_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               seed: int = 0) -> Request:
+               seed: int = 0,
+               trace_id: Optional[str] = None,
+               parent_span_id: Optional[int] = None,
+               handoff: bool = False) -> Request:
         """Enqueue a generation request (any thread). Returns the live
         :class:`Request`; stream tokens from ``request.stream`` or block on
         ``request.result()``. Backpressure per ``config.backpressure``:
         ``reject`` raises :class:`QueueFullError`, ``block`` stalls until the
-        queue has room."""
+        queue has room.
+
+        ``trace_id``/``parent_span_id`` adopt an upstream trace (the fleet
+        router's) instead of minting a fresh one, so router → replica shows as
+        one parented Perfetto track. ``handoff`` marks a prefill-role request:
+        when it finishes DONE its engine state is exported as a portable
+        KV-handoff payload (``request.handoff_payload``) for
+        :meth:`submit_resume` on a decode-role peer."""
         req = Request(prompt,
                       max_new_tokens=max_new_tokens if max_new_tokens is not None
                       else self._config.default_max_new_tokens,
@@ -157,11 +171,66 @@ class ServingScheduler:
                       deadline_s=deadline_s if deadline_s is not None
                       else self._config.default_deadline_s,
                       seed=seed)
+        return self._enqueue(req, trace_id, parent_span_id, handoff)
+
+    def submit_resume(self,
+                      payload: bytes,
+                      max_new_tokens: Optional[int] = None,
+                      temperature: float = 0.0,
+                      eos_token_id: Optional[int] = None,
+                      deadline_s: Optional[float] = None,
+                      seed: int = 0,
+                      trace_id: Optional[str] = None,
+                      parent_span_id: Optional[int] = None,
+                      handoff: bool = False) -> Request:
+        """Admit a handed-off sequence for decode continuation: ``payload`` is
+        an ``engine.export_sequence`` product from a prefill-role peer. The
+        scheduler imports it into its engine at admission (on the scheduler
+        thread — the engine is not thread-safe) and the request enters DECODE
+        directly; its ``prompt`` is the full token history so context,
+        deadline and stats accounting match a locally-prefilled request.
+        Generation state (next input token, sampler RNG state) rides in the
+        payload's ``extra`` block, so greedy AND sampled continuations are
+        token-identical to the single-engine run. ``request.tokens`` holds
+        only the tokens generated HERE; the caller merges with the prefill
+        leg's."""
+        from deepspeed_tpu.inference.v2.ragged.handoff import unpack
+        payload = bytes(payload)
+        header, kv = unpack(payload)  # validate framing before queueing
+        extra = header.get("extra") or {}
+        if "next_token" not in extra:
+            raise ValueError(
+                "handoff payload carries no next_token (the donor request must "
+                "finish with finish_reason='length' to be continuable)")
+        req = Request(header["tokens"],
+                      max_new_tokens=max_new_tokens if max_new_tokens is not None
+                      else self._config.default_max_new_tokens,
+                      temperature=temperature,
+                      eos_token_id=eos_token_id,
+                      deadline_s=deadline_s if deadline_s is not None
+                      else self._config.default_deadline_s,
+                      seed=seed)
+        req._resume_payload = payload
+        req._resume_header = header
+        req._resume_kv = kv  # zero-copy view into payload; parsed exactly once
+        req._next = int(extra["next_token"])
+        rng_state = extra.get("rng_state")
+        if rng_state is not None:
+            # exact sampler continuation: the donor's PCG64 state, not a
+            # reseed — sampled handoffs stay token-identical
+            req._rng = np.random.default_rng()
+            req._rng.bit_generator.state = rng_state
+        return self._enqueue(req, trace_id, parent_span_id, handoff)
+
+    def _enqueue(self, req: Request, trace_id: Optional[str],
+                 parent_span_id: Optional[int], handoff: bool) -> Request:
+        req.handoff_requested = bool(handoff)
         if self._spans is not None:
             # trace identity is assigned at admission so the HTTP layer can
             # hand the id back in response headers before streaming begins
-            req.trace_id = new_trace_id()
+            req.trace_id = trace_id if trace_id else new_trace_id()
             req.root_span_id = new_span_id()
+            req.parent_span_id = parent_span_id
         with self._not_full:
             if self._stopping:
                 raise SchedulerStopped("scheduler is stopping; not admitting requests")
@@ -220,10 +289,17 @@ class ServingScheduler:
 
     def _admit(self, now: float) -> None:
         max_active = self._engine._config.state_manager.max_tracked_sequences
-        with self._not_full:
-            while self._queue and len(self._active) < max_active:
+        while True:
+            # the queue condition guards ONLY the pop: engine work below (a
+            # resume import scatters hundreds of MB of KV and may evict) must
+            # never run under the lock submit()'s handler threads block on
+            with self._not_full:
+                if not self._queue or len(self._active) >= max_active:
+                    break
                 req = self._queue.popleft()
+                self._admitting = req  # visible to _has_work/load while popped
                 self._not_full.notify()
+            try:
                 if req.cancel_requested:
                     self._finalize(req, RequestState.CANCELLED)
                     continue
@@ -235,24 +311,84 @@ class ServingScheduler:
                     self._finalize(req, RequestState.FAILED, error=infeasible)
                     continue
                 req.uid = next(self._uids)
-                req._set_state(RequestState.PREFILL)
-                self._active[req.uid] = req
-                spans = self._spans  # bind once: the property re-resolves
-                if spans is not None:
-                    spans.record("queued", cat="serving", ts_us=req.arrival_us,
-                                 dur_us=now_us() - req.arrival_us,
-                                 trace_id=req.trace_id,
-                                 parent_id=req.root_span_id,
-                                 args={"uid": req.uid})
-            if self._metrics:
-                self._metrics.queue_depth.set(len(self._queue))
-                self._metrics.in_flight.set(len(self._active))
+                if req._resume_payload is not None:
+                    outcome = self._import_resume(req)
+                    if outcome is None:
+                        # the pool can't hold the handed-off KV right now and
+                        # nothing was evictable: put it back, retry next tick
+                        req.uid = None
+                        with self._not_full:
+                            self._queue.appendleft(req)
+                        break
+                    if outcome != "ok":
+                        self._finalize(req, RequestState.FAILED, error=outcome)
+                        continue
+                req._set_state(RequestState.DECODE if req._resume_header is not None
+                               else RequestState.PREFILL)
+                with self._not_full:
+                    self._active[req.uid] = req
+            finally:
+                self._admitting = None
+            spans = self._spans  # bind once: the property re-resolves
+            if spans is not None:
+                spans.record("queued", cat="serving", ts_us=req.arrival_us,
+                             dur_us=now_us() - req.arrival_us,
+                             trace_id=req.trace_id,
+                             parent_id=req.root_span_id,
+                             args={"uid": req.uid})
+        if self._metrics:
+            with self._not_full:
+                queue_depth = len(self._queue)
+            self._metrics.queue_depth.set(queue_depth)
+            self._metrics.in_flight.set(len(self._active))
+
+    def _import_resume(self, req: Request) -> Optional[str]:
+        """Import a handed-off sequence under the request's uid (scheduler
+        thread — the engine is not thread-safe), evicting cold idle sequences
+        under KV pressure. ``"ok"`` = imported, the engine owns the state;
+        ``None`` = the pool is full and nothing was evictable (retry next
+        tick); any other string = the import failed with the pool able to
+        hold the payload — NOT capacity, the request can never land (FAIL it
+        rather than retry the queue head forever). Known-permanent problems
+        (geometry, payload > pool or > per-sequence cap) were already
+        rejected by :meth:`_permanently_infeasible`."""
+        kv_meta = (req._resume_header or {}).get("kv")
+        needed = int(kv_meta["shape"][2]) if kv_meta else 0
+        # the manager-level import reuses the header/KV parsed once at
+        # submit_resume (compatibility was checked by _permanently_infeasible)
+        # rather than re-unpacking the full payload on every retry
+        snapshot = {"uid": req.uid,
+                    "seen_tokens": req._resume_header["seen_tokens"],
+                    "kv": req._resume_kv}
+        while True:
+            try:
+                self._engine._state_manager.import_sequence(snapshot, uid=req.uid)
+            except Exception as e:
+                if self._engine.free_blocks >= needed:
+                    return f"handoff import failed: {e}"
+                if self._evict_one({req.uid}):
+                    continue
+                return None
+            req._resume_payload = None  # imported; the engine owns the KV now
+            req._resume_kv = None
+            req._fed = req.prompt.size  # the whole history is already prefilled
+            return "ok"
 
     def _permanently_infeasible(self, req: Request) -> Optional[str]:
         """A reason this request can NEVER be scheduled, or None. Failing at
         admission beats starving it forever against budgets that will not
         change (generate()'s old 'no sequence schedulable' RuntimeError)."""
         sm = self._engine._config.state_manager
+        if req._resume_header is not None:
+            from deepspeed_tpu.inference.v2.ragged.handoff import compatibility_error
+            err = compatibility_error(self._engine._state_manager, req._resume_header)
+            if err:
+                return err
+            if int(req._resume_header["seen_tokens"]) + 1 > sm.max_context:
+                return (f"handed-off sequence has "
+                        f"{req._resume_header['seen_tokens']} committed tokens; "
+                        f"max_context={sm.max_context} leaves no room to decode")
+            return None
         if req.prompt.size + 1 > sm.max_context:
             return (f"prompt of {req.prompt.size} tokens exceeds max_context="
                     f"{sm.max_context} (room for at least one generated token "
@@ -499,6 +635,28 @@ class ServingScheduler:
     _FINAL_COUNTER = {RequestState.DONE: "completed", RequestState.CANCELLED: "cancelled",
                       RequestState.TIMED_OUT: "timed_out", RequestState.FAILED: "failed"}
 
+    def _export_handoff(self, req: Request) -> bytes:
+        """Portable continuation payload for a DONE handoff-requested request:
+        full token history, KV blocks, next decode input and the sampler's
+        exact RNG state — everything :meth:`submit_resume` on a decode-role
+        peer needs to continue token-identically. Runs on the scheduler
+        thread, before the sequence's KV is flushed."""
+        extra = {"generated": len(req.tokens)}
+        if req.finish_reason == "length" and req.tokens:
+            # an eos/context finish is not continuable; length means the donor
+            # stopped at ITS cap with the last kept token as the next input
+            extra["next_token"] = int(req.tokens[-1])
+        if req._rng is not None:
+            extra["rng_state"] = req._rng.bit_generator.state
+        tokens = [int(t) for t in req.prompt.tolist()] + [int(t) for t in req.tokens]
+        # chunked greedy decode feeds the device ahead of the kept history (a
+        # mid-chunk cap leaves the last kept token — and discarded over-run —
+        # already committed). Export seen = history-1 so the recipient re-feeds
+        # the last token: deterministic, same KV values into the same slot,
+        # and the continuation stays exactly aligned.
+        return self._engine.export_sequence(req.uid, tokens=tokens, extra=extra,
+                                            seen_tokens=len(tokens) - 1)
+
     def _finalize(self, req: Request, state: RequestState, error: Optional[str] = None) -> None:
         """Terminal transition on the scheduler thread: free engine state
         (tracked OR offloaded KV), close the stream, account."""
@@ -508,20 +666,35 @@ class ServingScheduler:
         if req.uid is not None:
             self._active.pop(req.uid, None)
             if self._engine._state_manager.get_sequence(req.uid) is not None:
+                if (state is RequestState.DONE and req.handoff_requested
+                        and req.finish_reason == "length" and req.tokens):
+                    # export BEFORE flushing: the payload reads the sequence's
+                    # live KV blocks (fleet prefill→decode handoff). An eos/
+                    # context finish is not continuable — exporting it would
+                    # device_get the whole KV only for the router to discard it
+                    try:
+                        req.handoff_payload = self._export_handoff(req)
+                    except Exception:  # pragma: no cover - defensive: a failed
+                        # export degrades to a non-continuable response
+                        logger.exception(f"serving: handoff export failed for "
+                                         f"uid {req.uid}")
                 self._engine.flush(req.uid)  # returns KV blocks (incl. offloaded)
         req._set_state(state)
         self._counters[self._FINAL_COUNTER[state]] += 1
         spans = self._spans  # bind once: the property re-resolves
         if spans is not None and req.trace_id is not None:
             # the trace's root: arrival → terminal state, with the ids every
-            # lifecycle child span parented under
+            # lifecycle child span parented under; a routed request's root
+            # itself parents under the fleet router's span
             spans.record("request", cat="serving", ts_us=req.arrival_us,
                          dur_us=now_us() - req.arrival_us,
                          trace_id=req.trace_id, span_id=req.root_span_id,
+                         parent_id=req.parent_span_id,
                          args={"uid": req.uid, "state": state.name,
                                "finish_reason": req.finish_reason,
                                "prompt_tokens": int(req.prompt.size),
-                               "generated": len(req.tokens)})
+                               "generated": len(req.tokens),
+                               "resumed": req._resume_header is not None})
         if self._metrics:
             {RequestState.DONE: self._metrics.completions,
              RequestState.CANCELLED: self._metrics.cancellations,
@@ -561,7 +734,8 @@ class ServingScheduler:
 
     # ------------------------------------------------------------------ stop --
     def _has_work(self) -> bool:
-        return bool(self._queue) or bool(self._active)
+        return (bool(self._queue) or bool(self._active)
+                or self._admitting is not None)
 
     def stop(self, drain: bool = True, timeout: Optional[float] = None) -> None:
         """Stop the scheduler: no further admissions; with ``drain`` in-flight
@@ -606,7 +780,9 @@ class ServingScheduler:
     # ----------------------------------------------------------------- stats --
     @property
     def queue_depth(self) -> int:
-        return len(self._queue)
+        # an in-admission request (popped, importing) still counts as pending
+        # work: drain budgets and least-loaded dispatch must not miss it
+        return len(self._queue) + (1 if self._admitting is not None else 0)
 
     @property
     def n_active(self) -> int:
@@ -668,6 +844,7 @@ class ServingScheduler:
             "counters": dict(self._counters),
             "engine": {
                 "free_blocks": self._engine.free_blocks,
+                "capacity_blocks": self._capacity_blocks,
                 "tracked_sequences": self._engine._state_manager.n_tracked_sequences,
             },
             "draining": self._stopping,
@@ -696,6 +873,5 @@ class ServingScheduler:
             )
             rows.append(row)
         doc["requests"] = rows
-        doc["engine"]["capacity_blocks"] = self._capacity_blocks
         doc["starved_ticks"] = self._starved_ticks
         return doc

@@ -25,6 +25,14 @@ ephemeral-port support), serving the request lifecycle instead of metrics:
   stalls the handler thread until the queue drains. During shutdown new
   requests get **503**.
 
+- ``POST /v1/resume`` — fleet decode-role continuation: the body carries a
+  base64 ``payload`` (a peer engine's ``export_sequence`` product) instead of
+  a prompt; the sequence enters DECODE directly and streams/returns exactly
+  like ``/v1/generate``. Both POST routes accept a ``handoff`` flag (export
+  this request's state at DONE; the base64 payload is returned in the final
+  JSON / SSE ``done`` event) and adopt an upstream trace from the
+  ``X-DSTPU-Trace-Id`` / ``X-DSTPU-Parent-Span`` request headers, so the
+  fleet router's hop parents the replica's request track.
 - ``GET /v1/stats`` — scheduler + engine occupancy JSON: per-request rows
   (uid, state, age, trace id) and p50/p95/p99 TTFT/ITL/e2e when telemetry is
   active.
@@ -40,25 +48,60 @@ completion bounded by ``config.drain_timeout_s``, stragglers are CANCELLED,
 then the listener shuts down.
 """
 
+import base64
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from deepspeed_tpu.serving.config import ServingConfig
+from deepspeed_tpu.serving.config import (DEFAULT_MAX_RESUME_BODY_BYTES,
+                                          ServingConfig)
 from deepspeed_tpu.serving.request import Request
 from deepspeed_tpu.serving.scheduler import (QueueFullError, SchedulerStopped,
                                              ServingScheduler)
 from deepspeed_tpu.utils.logging import logger
 
 _MAX_BODY_BYTES = 8 << 20  # an 8 MiB prompt is already ~2M tokens of JSON
+# a resume body carries a base64 KV-handoff payload — real-model KV runs to
+# hundreds of MB (the fleet handoff histogram buckets reach 1 GiB) and base64
+# adds 4/3, so the prompt cap would 400 every non-toy handoff
+_MAX_RESUME_BODY_BYTES = DEFAULT_MAX_RESUME_BODY_BYTES
 
 
 TRACE_HEADER = "X-DSTPU-Trace-Id"
+# the fleet router's span id: a replica's request root parents under it so
+# router → prefill replica → decode replica renders as ONE Perfetto track
+PARENT_SPAN_HEADER = "X-DSTPU-Parent-Span"
 
 
-def _request_doc(req: Request) -> dict:
-    return {
+def parse_request_body(handler, resume: bool, max_bytes: Optional[int] = None) -> dict:
+    """Read + validate a ``/v1/generate`` | ``/v1/resume`` JSON body from an
+    http.server request handler — the single wire-format authority, shared by
+    :class:`ServingServer` and the fleet router (whose contract is that a
+    client cannot tell it from a single replica). Returns the parsed doc,
+    with ``doc["payload"]`` base64-decoded to bytes for resume. Raises
+    ``ValueError``/``KeyError``/``TypeError`` on malformed input (callers
+    answer 400)."""
+    if max_bytes is None:
+        max_bytes = _MAX_RESUME_BODY_BYTES if resume else _MAX_BODY_BYTES
+    length = int(handler.headers.get("Content-Length", 0))
+    if not 0 < length <= max_bytes:
+        raise ValueError(f"body length {length} out of bounds")
+    doc = json.loads(handler.rfile.read(length))
+    if resume:
+        # fleet decode-role continuation: the body carries a peer engine's
+        # export_sequence payload instead of a prompt
+        doc["payload"] = base64.b64decode(doc["payload"])
+    else:
+        prompt = doc["prompt"]
+        if (not isinstance(prompt, list) or not prompt
+                or not all(isinstance(t, int) for t in prompt)):
+            raise ValueError("'prompt' must be a non-empty list of token ids")
+    return doc
+
+
+def _request_doc(req: Request, raw_handoff: bool = False) -> dict:
+    doc = {
         "uid": req.uid,
         "tokens": list(req.tokens),
         "n_tokens": len(req.tokens),
@@ -69,6 +112,13 @@ def _request_doc(req: Request) -> dict:
         "e2e_s": req.e2e_s,
         "trace_id": req.trace_id,
     }
+    if req.handoff_payload is not None:
+        # fleet prefill→decode handoff: the exported KV/generation state, for
+        # POST /v1/resume on a decode-role peer. Bytes ride JSON as base64;
+        # an in-process leg (fleet LocalReplica) keeps them raw.
+        doc["handoff"] = (req.handoff_payload if raw_handoff else
+                          base64.b64encode(req.handoff_payload).decode())
+    return doc
 
 
 class ServingServer:
@@ -102,6 +152,7 @@ class ServingServer:
     # ----------------------------------------------------------------- start --
     def start(self) -> "ServingServer":
         scheduler, draining = self._scheduler, self._draining
+        cfg: ServingConfig = scheduler._config
 
         class Handler(BaseHTTPRequestHandler):
 
@@ -125,34 +176,50 @@ class ServingServer:
                 else:
                     self._send_json(404, {"error": f"no route {path}"})
 
+            def _upstream_trace(self):
+                """(trace_id, parent_span_id) from the request headers — the
+                fleet router's trace context, adopted so router → replica
+                renders as one parented Perfetto track."""
+                trace_id = self.headers.get(TRACE_HEADER) or None
+                parent = self.headers.get(PARENT_SPAN_HEADER)
+                try:
+                    parent_span_id = int(parent) if parent else None
+                except ValueError:
+                    parent_span_id = None
+                return trace_id, parent_span_id
+
             def do_POST(self):
                 path = self.path.split("?", 1)[0].rstrip("/")
-                if path != "/v1/generate":
+                if path not in ("/v1/generate", "/v1/resume"):
                     self._send_json(404, {"error": f"no route {path}"})
                     return
                 if draining.is_set():
                     self._send_json(503, {"error": "server is draining"})
                     return
+                trace_id, parent_span_id = self._upstream_trace()
+                resume = path == "/v1/resume"
                 try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    if not 0 < length <= _MAX_BODY_BYTES:
-                        raise ValueError(f"body length {length} out of bounds")
-                    doc = json.loads(self.rfile.read(length))
-                    prompt = doc["prompt"]
-                    if (not isinstance(prompt, list) or not prompt
-                            or not all(isinstance(t, int) for t in prompt)):
-                        raise ValueError("'prompt' must be a non-empty list of token ids")
-                except (KeyError, ValueError, json.JSONDecodeError) as e:
+                    doc = parse_request_body(
+                        self, resume=resume,
+                        max_bytes=cfg.max_resume_body_bytes if resume else None)
+                except (KeyError, ValueError, TypeError) as e:
                     self._send_json(400, {"error": str(e)})
                     return
                 try:
-                    req = scheduler.submit(
-                        prompt,
-                        max_new_tokens=doc.get("max_new_tokens"),
-                        temperature=float(doc.get("temperature") or 0.0),
-                        eos_token_id=doc.get("eos_token_id"),
-                        deadline_s=doc.get("deadline_s"),
-                        seed=int(doc.get("seed") or 0))
+                    # wrongly-typed optional fields (string temperature, ...)
+                    # raise here and fall through to the 400 below
+                    common = dict(max_new_tokens=doc.get("max_new_tokens"),
+                                  temperature=float(doc.get("temperature") or 0.0),
+                                  eos_token_id=doc.get("eos_token_id"),
+                                  deadline_s=doc.get("deadline_s"),
+                                  seed=int(doc.get("seed") or 0),
+                                  trace_id=trace_id,
+                                  parent_span_id=parent_span_id,
+                                  handoff=bool(doc.get("handoff")))
+                    if path == "/v1/resume":
+                        req = scheduler.submit_resume(doc["payload"], **common)
+                    else:
+                        req = scheduler.submit(doc["prompt"], **common)
                 except QueueFullError as e:
                     self._send_json(429, {"error": str(e),
                                           "queue_depth": scheduler.queue_depth})
@@ -201,7 +268,7 @@ class ServingServer:
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="dstpu-serving-http", daemon=True)
         self._thread.start()
-        logger.info(f"serving: /v1/generate /v1/stats /healthz on {self.url}")
+        logger.info(f"serving: /v1/generate /v1/resume /v1/stats /healthz on {self.url}")
         return self
 
     # ------------------------------------------------------------------ stop --

@@ -54,4 +54,15 @@ METRIC_FAMILIES = {
     # flight recorder (telemetry/flight_recorder.py)
     "flight_recorder_dumps_total": "flight-recorder dumps written, by trigger",
     "serving_stalled_total": "watchdog detections of a stalled scheduler loop",
+    # fleet layer (fleet/metrics.py)
+    "fleet_replicas": "live (non-DOWN) replicas registered with the manager",
+    "fleet_queue_depth": "fleet-wide queued requests at the last probe sweep",
+    "fleet_kv_pressure": "mean replica KV-pool occupancy (1 - free/capacity)",
+    "fleet_requests_total": "client requests accepted by the router",
+    "fleet_dispatch_retries_total": "dispatch attempts that failed over to another replica",
+    "fleet_routing_failures_total": "requests that exhausted every candidate replica",
+    "fleet_handoffs_total": "prefill-to-decode KV-block handoffs completed",
+    "fleet_handoff_bytes": "KV-handoff payload size",
+    "fleet_scale_ups_total": "autoscaler replica additions",
+    "fleet_scale_downs_total": "autoscaler replica drains",
 }

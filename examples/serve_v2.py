@@ -10,6 +10,12 @@ Server mode (``DSTPU_SERVE_MODE=server``): start the persistent serving layer
 — ServingScheduler + ServingServer on an ephemeral port — submit two
 overlapping SSE streaming requests over HTTP, and print tokens as they
 arrive; then drain gracefully.
+
+Fleet mode (``DSTPU_SERVE_MODE=fleet``): a disaggregated 4-replica fleet — two
+prefill-role and two decode-role in-process replicas behind the FleetRouter.
+Each request prefills (plus first token) on a prefill replica, hands its KV
+off as a portable payload, and finishes decoding on a decode replica; the
+final SSE event shows both legs. Then a fleet-wide graceful drain.
 """
 
 import os
@@ -114,6 +120,79 @@ def serve_main():
     print("OK")
 
 
+def fleet_main():
+    """Disaggregated-fleet demo: 2 prefill + 2 decode in-process replicas
+    behind the router; each request's KV hands off between pools mid-request
+    and the final event carries the per-leg replica attribution."""
+    import json
+    import threading
+    import urllib.request
+
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.fleet import FleetRouter, ReplicaManager
+    from deepspeed_tpu.serving import ServingConfig
+
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+
+    cfg = LlamaConfig.tiny(vocab_size=512, max_position_embeddings=128)
+    _, params = init_params(cfg, seq_len=16)
+    engine_config = RaggedInferenceEngineConfig(
+        state_manager=DSStateManagerConfig(
+            memory_config=MemoryConfig(mode=AllocationMode.ALLOCATE, size=128),
+            max_context=128, max_ragged_batch_size=256, max_ragged_sequence_count=8),
+        kv_block_size=16)
+
+    manager = ReplicaManager(engine_factory=lambda: build_engine(params, cfg, engine_config),
+                             serving_config=ServingConfig(decode_chunk=4))
+    for _ in range(2):
+        manager.add_local(role="prefill")
+        manager.add_local(role="decode")
+    router = FleetRouter(manager).start()
+    print(f"fleet router on {router.url} (pools: "
+          f"{manager.pool_size('prefill')} prefill, {manager.pool_size('decode')} decode)")
+
+    def stream_one(name, prompt, n):
+        body = json.dumps({"prompt": prompt, "max_new_tokens": n,
+                           "stream": True, "session": name}).encode()
+        req = urllib.request.Request(router.url + "/v1/generate", data=body,
+                                     headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            trace_id = resp.headers["X-DSTPU-Trace-Id"]
+            for line in resp:
+                line = line.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                event = json.loads(line[len("data: "):])
+                if event.get("done"):
+                    legs = [(leg["kind"], leg["replica"]) for leg in event["legs"]]
+                    assert [k for k, _ in legs] == ["prefill", "decode"], legs
+                    assert event["trace_id"] == trace_id
+                    print(f"[{name}] done: state={event['state']} legs={legs} "
+                          f"tokens={event['tokens']}")
+                else:
+                    print(f"[{name}] token {event['index']}: {event['token']}")
+
+    rng = np.random.default_rng(0)
+    threads = [threading.Thread(target=stream_one,
+                                args=(name, rng.integers(0, cfg.vocab_size, n).tolist(), 8))
+               for name, n in (("A", 24), ("B", 9))]
+    for t in threads:
+        t.start()  # both requests cross the prefill->decode boundary concurrently
+    for t in threads:
+        t.join()
+
+    stats = json.loads(urllib.request.urlopen(router.url + "/v1/fleet/stats",
+                                              timeout=10).read())
+    assert stats["roles"] == {"prefill": 2, "decode": 2}, stats
+    dispatches = {row["id"]: row["dispatches"] for row in stats["replicas"]}
+    assert sum(dispatches.values()) >= 4, dispatches  # 2 requests x 2 legs
+    print(f"per-replica dispatches: {dispatches}")
+
+    router.stop()  # fleet-wide graceful drain (schedulers stopped, engines closed)
+    telemetry.shutdown()
+    print("OK")
+
+
 def main():
     cfg = LlamaConfig.tiny(vocab_size=512, max_position_embeddings=128)
     _, params = init_params(cfg, seq_len=16)
@@ -165,7 +244,10 @@ def main():
 
 
 if __name__ == "__main__":
-    if os.environ.get("DSTPU_SERVE_MODE") == "server":
+    mode = os.environ.get("DSTPU_SERVE_MODE")
+    if mode == "server":
         serve_main()
+    elif mode == "fleet":
+        fleet_main()
     else:
         main()

@@ -1,0 +1,78 @@
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.fleet import FleetConfig, ReplicaManager
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.engine_factory import build_engine
+from deepspeed_tpu.inference.v2.ragged.manager_configs import (AllocationMode,
+                                                               DSStateManagerConfig,
+                                                               MemoryConfig)
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+from deepspeed_tpu.serving import ServingConfig
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    """Telemetry state is process-global (same contract as tests/unit/serving)."""
+    telemetry.shutdown()
+    telemetry.state.registry = None
+    yield
+    telemetry.shutdown()
+    telemetry.state.registry = None
+
+
+@pytest.fixture(scope="module")
+def llama_setup():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = {"model": model.init(jax.random.PRNGKey(0), ids)["params"]}
+    return cfg, model, params
+
+
+@pytest.fixture
+def make_engine(llama_setup):
+    """Engine factory with identical KV geometry across calls (the handoff
+    transport's requirement); every engine is closed at teardown unless a
+    replica drain already closed it."""
+    cfg, _, params = llama_setup
+    engines = []
+
+    def _make(num_blocks=64, block_size=16, **mgr_kw):
+        mgr_kw.setdefault("max_context", 512)
+        mgr = DSStateManagerConfig(
+            memory_config=MemoryConfig(mode=AllocationMode.ALLOCATE, size=num_blocks),
+            **mgr_kw)
+        engine = build_engine(params, cfg,
+                              RaggedInferenceEngineConfig(state_manager=mgr,
+                                                          kv_block_size=block_size))
+        engines.append(engine)
+        return engine
+
+    yield _make
+    for engine in engines:
+        engine.close()
+
+
+@pytest.fixture
+def make_fleet(make_engine):
+    """Fleet factory: a ReplicaManager over the shared engine factory, with
+    probe caching off (probe_ttl_s=0: every dispatch sees fresh state — the
+    deterministic formulation for tests). Managers are closed at teardown."""
+    managers = []
+
+    def _make(roles=("mixed",), config=None, serving_config=None, **engine_kw):
+        manager = ReplicaManager(
+            engine_factory=lambda: make_engine(**engine_kw),
+            config=config or FleetConfig(probe_ttl_s=0.0, drain_timeout_s=10.0),
+            serving_config=serving_config or ServingConfig())
+        for role in roles:
+            manager.add_local(role=role)
+        managers.append(manager)
+        return manager
+
+    yield _make
+    for manager in managers:
+        manager.close()

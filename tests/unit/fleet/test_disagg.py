@@ -1,0 +1,259 @@
+"""Prefill/decode disaggregation: the two-leg KV-handoff path must be
+indistinguishable from single-engine serving — token-identical output (greedy
+AND sampled), correct fallbacks, and a mid-stream drain the client never
+notices."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.fleet import FleetRouter
+from deepspeed_tpu.serving import ServingConfig
+
+
+def _prompt(n, vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, vocab, n).tolist()
+
+
+def _route_tokens(router, doc, **kw):
+    routed = router.route(dict(doc), **kw)
+    streamed = list(routed.tokens())
+    final = routed.result()
+    assert final["tokens"] == streamed, "stream and final doc must agree"
+    return final
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_disaggregated_output_token_identical(make_fleet, temperature):
+    """The acceptance bar: same request through a mixed fleet (single engine,
+    no handoff) and through a disaggregated 2-prefill/2-decode fleet yields
+    the same tokens — greedy and sampled (the RNG state rides the payload)."""
+    doc = {"prompt": _prompt(21), "max_new_tokens": 8,
+           "temperature": temperature, "seed": 1234}
+
+    single = make_fleet(roles=("mixed",))
+    ref = _route_tokens(FleetRouter(single), doc)
+    assert ref["state"] == "DONE" and len(ref["tokens"]) == 8
+
+    disagg = make_fleet(roles=("prefill", "prefill", "decode", "decode"))
+    got = _route_tokens(FleetRouter(disagg), doc)
+    assert got["state"] == "DONE"
+    assert [leg["kind"] for leg in got["legs"]] == ["prefill", "decode"]
+    assert got["legs"][0]["replica"] != got["legs"][1]["replica"]
+    assert got["tokens"] == ref["tokens"]
+    # KV is fully handed off: nothing lingers on the prefill side
+    for replica in disagg.replicas():
+        assert replica.engine._state_manager.n_tracked_sequences == 0
+
+
+def test_single_token_request_skips_the_handoff(make_fleet):
+    """max_new_tokens=1 has no decode remainder — one leg, no payload."""
+    fleet = make_fleet(roles=("prefill", "decode"))
+    got = _route_tokens(FleetRouter(fleet), {"prompt": _prompt(9),
+                                             "max_new_tokens": 1})
+    assert got["state"] == "DONE" and len(got["tokens"]) == 1
+    assert [leg["kind"] for leg in got["legs"]] == ["serve"]
+
+
+def test_missing_decode_pool_degrades_to_whole_request(make_fleet):
+    fleet = make_fleet(roles=("prefill", "prefill"))
+    got = _route_tokens(FleetRouter(fleet), {"prompt": _prompt(9),
+                                             "max_new_tokens": 4})
+    assert got["state"] == "DONE" and len(got["tokens"]) == 4
+    assert [leg["kind"] for leg in got["legs"]] == ["serve"]
+
+
+def test_eos_on_first_token_ends_with_one_leg(make_fleet, monkeypatch):
+    fleet = make_fleet(roles=("prefill", "decode"))
+    router = FleetRouter(fleet)
+    prompt = _prompt(15)
+    # learn what the first greedy token will be, then demand it as eos
+    probe = _route_tokens(router, {"prompt": prompt, "max_new_tokens": 1})
+    first = probe["tokens"][0]
+    got = _route_tokens(router, {"prompt": prompt, "max_new_tokens": 8,
+                                 "eos_token_id": first})
+    assert got["finish_reason"] == "eos" and got["tokens"] == [first]
+    assert [leg["kind"] for leg in got["legs"]] == ["prefill"]
+
+
+def test_drain_mid_stream_completes_and_reroutes(make_fleet):
+    """The acceptance drill: drain the decode replica while it is streaming.
+    The in-flight stream runs to DONE (drain is graceful), the replica leaves
+    rotation, and the next request lands on the surviving decode replica."""
+    fleet = make_fleet(roles=("prefill", "decode", "decode"),
+                       serving_config=ServingConfig(decode_chunk=1))
+    router = FleetRouter(fleet)
+    routed = router.route({"prompt": _prompt(21), "max_new_tokens": 24})
+    it = routed.tokens()
+    tokens = [next(it) for _ in range(3)]  # stream is live, leg 2 underway
+
+    victim_id = routed._last_replica_id
+    assert fleet.get(victim_id).role == "decode"
+
+    drainer = threading.Thread(target=fleet.drain, args=(victim_id,))
+    drainer.start()
+    tokens += list(it)
+    final = routed.result()
+    drainer.join(timeout=30)
+    assert not drainer.is_alive()
+    assert final["state"] == "DONE" and len(tokens) == 24
+    assert final["tokens"] == tokens
+
+    # the drained replica is gone; new requests route to the survivor
+    after = _route_tokens(router, {"prompt": _prompt(9), "max_new_tokens": 4})
+    assert after["state"] == "DONE"
+    assert after["legs"][1]["replica"] != victim_id
+
+
+def test_chunked_decode_handoff_stays_aligned(make_engine):
+    """Review regression: decode_chunk>1 feeds the device ahead of the kept
+    history (a mid-chunk 'length' finish leaves the last kept token already
+    committed). The export trims seen_tokens so the continuation is still
+    token-identical."""
+    from deepspeed_tpu.serving import ServingConfig, ServingScheduler
+    prompt = _prompt(13)
+
+    ref = ServingScheduler(make_engine(), ServingConfig(decode_chunk=4))
+    full = ref.submit(prompt, max_new_tokens=8).result(timeout=120)
+    ref.stop(drain=False)
+    assert len(full) == 8
+
+    donor = ServingScheduler(make_engine(), ServingConfig(decode_chunk=4))
+    head_req = donor.submit(prompt, max_new_tokens=4, handoff=True)
+    head = head_req.result(timeout=120)
+    payload = head_req.handoff_payload
+    donor.stop(drain=False)
+    assert head == full[:4]
+    assert head_req.finish_reason == "length" and payload is not None
+
+    recipient = ServingScheduler(make_engine(), ServingConfig(decode_chunk=4))
+    tail = recipient.submit_resume(payload, max_new_tokens=4).result(timeout=120)
+    recipient.stop(drain=False)
+    assert head + tail == full, "mid-chunk handoff must stay aligned"
+
+
+def test_malformed_resume_payload_is_a_400_not_a_crash(make_fleet):
+    """Review regression: truncated frames, bad magic, and schema-invalid
+    headers are client errors — never handler crashes or hung requests."""
+    import base64
+    import json
+    import struct
+    import urllib.error
+    import urllib.request
+
+    from deepspeed_tpu.inference.v2.ragged.handoff import MAGIC
+
+    bad_header = json.dumps({"version": 1}).encode()  # frame ok, schema not
+    payloads = (
+        MAGIC + b"\x00",                                     # truncated length
+        b"NOTMAGIC" + b"x" * 16,                             # bad magic
+        MAGIC + struct.pack("<I", 999999) + b"{}",           # truncated header
+        MAGIC + struct.pack("<I", len(bad_header)) + bad_header,
+        b"",                                                 # empty
+    )
+    fleet = make_fleet(roles=("mixed",))
+    router = FleetRouter(fleet).start()
+    try:
+        for payload in payloads:
+            body = json.dumps({"payload": base64.b64encode(payload).decode(),
+                               "max_new_tokens": 2}).encode()
+            req = urllib.request.Request(router.url + "/v1/resume", data=body,
+                                         headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=30)
+            assert err.value.code == 400, payload[:16]
+        # the fleet still serves after the garbage barrage
+        got = _route_tokens(router, {"prompt": _prompt(9), "max_new_tokens": 2})
+        assert got["state"] == "DONE"
+    finally:
+        router.stop(drain=False)
+
+
+def test_permanent_import_failure_fails_fast(make_engine, monkeypatch):
+    """Review regression: an import that fails with the pool able to hold the
+    payload is NOT capacity — the request FAILs instead of wedging the queue
+    head in an evict/retry loop forever."""
+    from deepspeed_tpu.serving import ServingConfig, ServingScheduler
+    donor = ServingScheduler(make_engine(), ServingConfig())
+    head_req = donor.submit(_prompt(9), max_new_tokens=2, handoff=True)
+    head_req.result(timeout=120)
+    payload = head_req.handoff_payload
+    donor.stop(drain=False)
+
+    engine = make_engine()
+    monkeypatch.setattr(engine._state_manager, "import_sequence",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            ValueError("corrupt state")))
+    sched = ServingScheduler(engine, ServingConfig())
+    try:
+        req = sched.submit_resume(payload, max_new_tokens=4)
+        with pytest.raises(RuntimeError, match="handoff import failed"):
+            req.result(timeout=30)
+        # the scheduler loop is alive and the queue is clear
+        follow = sched.submit(_prompt(9), max_new_tokens=2)
+        assert follow.result(timeout=120) is not None
+    finally:
+        sched.stop(drain=False)
+
+
+def test_client_resume_through_router(make_fleet):
+    """POST /v1/resume wire path: a client-requested handoff payload from one
+    fleet continues on another (cross-fleet migration)."""
+    import json
+    import urllib.request
+
+    src = make_fleet(roles=("mixed",))
+    dst = make_fleet(roles=("decode",))
+    src_router = FleetRouter(src).start()
+    dst_router = FleetRouter(dst).start()
+    try:
+        body = json.dumps({"prompt": _prompt(13), "max_new_tokens": 3,
+                           "handoff": True}).encode()
+        req = urllib.request.Request(src_router.url + "/v1/generate", data=body,
+                                     headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            doc = json.loads(resp.read())
+        assert doc["finish_reason"] == "length" and "handoff" in doc
+
+        body = json.dumps({"payload": doc["handoff"],
+                           "max_new_tokens": 4}).encode()
+        req = urllib.request.Request(dst_router.url + "/v1/resume", data=body,
+                                     headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            cont = json.loads(resp.read())
+        assert cont["state"] == "DONE" and len(cont["tokens"]) == 4
+        assert cont["legs"][0]["kind"] == "resume"
+    finally:
+        src_router.stop(drain=False)
+        dst_router.stop(drain=False)
+
+
+def test_failed_export_surfaces_an_error_not_truncation(make_fleet, monkeypatch):
+    """Review regression: a prefill leg whose handoff export failed replica-
+    side (payload None, but DONE/length) must NOT be returned as a clean
+    1-token completion — the router raises a 502 RoutingError."""
+    from deepspeed_tpu.fleet.router import RoutingError
+
+    fleet = make_fleet(roles=("prefill", "decode"))
+    for replica in fleet.replicas(role="prefill"):
+        monkeypatch.setattr(
+            replica.scheduler, "_export_handoff",
+            lambda req: (_ for _ in ()).throw(RuntimeError("export boom")))
+    router = FleetRouter(fleet)
+    routed = router.route({"prompt": _prompt(9), "max_new_tokens": 4})
+    with pytest.raises(RoutingError, match="no handoff payload") as err:
+        list(routed.tokens())
+        routed.result()
+    assert err.value.status == 502
+
+
+def test_explicit_zero_max_new_tokens_rejected_like_a_replica(make_fleet):
+    """Review regression: max_new_tokens=0 must surface the replica's own
+    'must be >= 1' error through a disaggregated router — not be swallowed
+    by a falsy-or into a default-budget 64-token completion."""
+    fleet = make_fleet(roles=("prefill", "decode"))
+    router = FleetRouter(fleet)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        router.route({"prompt": _prompt(5), "max_new_tokens": 0})

@@ -1,0 +1,116 @@
+"""Replica abstractions: local + HTTP upstream behind one dispatch interface."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.fleet import (LocalReplica, ReplicaState, ReplicaUnavailable)
+from deepspeed_tpu.serving import ServingConfig, ServingScheduler, ServingServer
+
+
+def _prompt(n=9, vocab=64):
+    return (np.arange(n) % vocab).tolist()
+
+
+class TestLocalReplica:
+
+    def test_dispatch_streams_and_resolves(self, make_engine):
+        replica = LocalReplica(make_engine(), role="mixed")
+        try:
+            leg = replica.dispatch({"prompt": _prompt(), "max_new_tokens": 4})
+            tokens = list(leg)
+            doc = leg.result(timeout=60)
+            assert doc["state"] == "DONE" and doc["tokens"] == tokens
+            assert len(tokens) == 4 or doc["finish_reason"] == "eos"
+        finally:
+            replica.drain(timeout=0.0)
+
+    def test_probe_shape_and_load(self, make_engine):
+        replica = LocalReplica(make_engine(num_blocks=32), role="prefill")
+        try:
+            doc = replica.probe()
+            assert doc["healthy"] and not doc["draining"]
+            assert doc["queue_depth"] == 0 and doc["active"] == 0
+            assert doc["kv_free_frac"] == 1.0
+            assert replica.load == 0
+        finally:
+            replica.drain(timeout=0.0)
+
+    def test_handoff_payload_rides_result(self, make_engine):
+        replica = LocalReplica(make_engine(), role="prefill")
+        try:
+            leg = replica.dispatch({"prompt": _prompt(), "max_new_tokens": 1,
+                                    "handoff": True})
+            doc = leg.result(timeout=60)
+            assert doc["finish_reason"] == "length"
+            assert isinstance(doc["handoff"], bytes)  # raw bytes in-process
+        finally:
+            replica.drain(timeout=0.0)
+
+    def test_drained_replica_refuses_dispatch(self, make_engine):
+        replica = LocalReplica(make_engine())
+        replica.drain(timeout=0.0)
+        assert replica.state is ReplicaState.DOWN and not replica.available
+        with pytest.raises(ReplicaUnavailable):
+            replica.dispatch({"prompt": _prompt()})
+
+    def test_backpressure_maps_to_unavailable(self, make_engine, monkeypatch):
+        """QueueFullError -> 429, SchedulerStopped -> 503: the router's two
+        failover signals, distinguished so the client's terminal status is
+        right when every replica refuses."""
+        from deepspeed_tpu.serving import QueueFullError, SchedulerStopped
+        replica = LocalReplica(make_engine())
+        try:
+            monkeypatch.setattr(replica.scheduler, "submit",
+                                lambda *a, **k: (_ for _ in ()).throw(
+                                    QueueFullError("queue full")))
+            with pytest.raises(ReplicaUnavailable) as err:
+                replica.dispatch({"prompt": _prompt()})
+            assert err.value.status == 429
+            monkeypatch.setattr(replica.scheduler, "submit",
+                                lambda *a, **k: (_ for _ in ()).throw(
+                                    SchedulerStopped("stopping")))
+            with pytest.raises(ReplicaUnavailable) as err:
+                replica.dispatch({"prompt": _prompt()})
+            assert err.value.status == 503
+        finally:
+            replica.drain(timeout=0.0)
+
+
+class TestHttpReplica:
+
+    @pytest.fixture
+    def upstream(self, make_engine):
+        srv = ServingServer(ServingScheduler(make_engine(), ServingConfig())).start()
+        yield srv
+        srv.stop(drain=False)
+
+    def test_probe_reads_health_and_stats(self, upstream, make_fleet):
+        manager = make_fleet(roles=())
+        replica = manager.add_upstream(upstream.url, role="decode")
+        doc = replica.probe()
+        assert doc["healthy"] and not doc["draining"]
+        assert doc["kv_free_frac"] == 1.0  # capacity_blocks rides /v1/stats now
+
+    def test_dispatch_streams_over_the_wire(self, upstream, make_fleet):
+        manager = make_fleet(roles=())
+        replica = manager.add_upstream(upstream.url)
+        leg = replica.dispatch({"prompt": _prompt(), "max_new_tokens": 3})
+        tokens = list(leg)
+        doc = leg.result(timeout=60)
+        assert doc["state"] == "DONE" and doc["tokens"] == tokens
+
+    def test_unreachable_upstream_is_unavailable(self, make_fleet):
+        manager = make_fleet(roles=())
+        replica = manager.add_upstream("http://127.0.0.1:9")  # discard port
+        assert replica.probe()["healthy"] is False
+        with pytest.raises(ReplicaUnavailable):
+            replica.dispatch({"prompt": _prompt()})
+
+    def test_drain_leaves_rotation_without_stopping_upstream(self, upstream, make_fleet):
+        manager = make_fleet(roles=())
+        replica = manager.add_upstream(upstream.url)
+        manager.drain(replica.id, remove=False)
+        # DOWN (not a forever-DRAINING zombie counted as live capacity) ...
+        assert replica.state is ReplicaState.DOWN and not replica.available
+        # ... but the external process is not ours to stop: it still answers
+        assert upstream.scheduler.queue_depth == 0

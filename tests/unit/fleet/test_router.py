@@ -1,0 +1,222 @@
+"""Router dispatch policy + HTTP surface: affinity stability under replica
+loss, least-loaded picks, 503/429 failover, fleet-wide drain."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.fleet import (FleetRouter, ReplicaUnavailable, RoutingError)
+from deepspeed_tpu.fleet.router import _rendezvous_score
+from deepspeed_tpu.serving.server import TRACE_HEADER
+
+
+def _prompt(n=9, vocab=64):
+    return (np.arange(n) % vocab).tolist()
+
+
+def _post(url, doc, headers=None, timeout=120):
+    req = urllib.request.Request(url, data=json.dumps(doc).encode(),
+                                 headers={"Content-Type": "application/json",
+                                          **(headers or {})})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# dispatch policy (no HTTP)
+# ---------------------------------------------------------------------------
+def test_affinity_same_key_same_replica(make_fleet):
+    manager = make_fleet(roles=("mixed", "mixed", "mixed"))
+    router = FleetRouter(manager)
+    picks = set()
+    for _ in range(4):
+        routed = router.route({"prompt": _prompt(), "max_new_tokens": 2},
+                              session_key="user-42")
+        routed.result()
+        picks.add(routed._legs_meta[0]["replica"])
+    assert len(picks) == 1, f"affinity must be sticky, saw {picks}"
+
+
+def test_affinity_stable_under_replica_loss(make_fleet):
+    """Rendezvous property: draining one replica only moves the keys that
+    lived on it — every other key keeps its replica."""
+    manager = make_fleet(roles=("mixed", "mixed", "mixed"))
+    replicas = manager.replicas()
+    ids = [r.id for r in replicas]
+    keys = [f"session-{i}" for i in range(60)]
+    before = {k: max(ids, key=lambda rid: _rendezvous_score(k, rid)) for k in keys}
+    victim = ids[0]
+    manager.drain(victim)
+    survivors = [rid for rid in ids if rid != victim]
+    after = {k: max(survivors, key=lambda rid: _rendezvous_score(k, rid)) for k in keys}
+    moved = [k for k in keys if before[k] != after[k]]
+    assert all(before[k] == victim for k in moved), \
+        "only keys on the drained replica may move"
+    assert any(before[k] != victim for k in keys)  # the stable majority
+
+    # and the live router agrees with the pure-function prediction
+    router = FleetRouter(manager)
+    k = next(k for k in keys if before[k] != victim)
+    routed = router.route({"prompt": _prompt(), "max_new_tokens": 2}, session_key=k)
+    routed.result()
+    assert routed._legs_meta[0]["replica"] == after[k] == before[k]
+
+
+def test_least_loaded_prefers_idle_replica(make_fleet, monkeypatch):
+    manager = make_fleet(roles=("mixed", "mixed"))
+    busy, idle = manager.replicas()
+    monkeypatch.setattr(type(busy), "load", property(
+        lambda self: 5 if self is busy else 0))
+    router = FleetRouter(manager)
+    routed = router.route({"prompt": _prompt(), "max_new_tokens": 2})
+    routed.result()
+    assert routed._legs_meta[0]["replica"] == idle.id
+
+
+def test_failover_excludes_unavailable_replica(make_fleet, monkeypatch):
+    manager = make_fleet(roles=("mixed", "mixed"))
+    bad, good = manager.replicas()
+    original = type(bad).dispatch
+
+    def flaky(self, *args, **kwargs):
+        if self is bad:
+            raise ReplicaUnavailable("injected 503", status=503)
+        return original(self, *args, **kwargs)
+
+    monkeypatch.setattr(type(bad), "dispatch", flaky)
+    # force the bad replica to be picked first
+    monkeypatch.setattr(type(bad), "load", property(
+        lambda self: 0 if self is bad else 1))
+    router = FleetRouter(manager)
+    routed = router.route({"prompt": _prompt(), "max_new_tokens": 2})
+    doc = routed.result()
+    assert doc["state"] == "DONE"
+    assert routed._legs_meta[0]["replica"] == good.id
+    assert bad.failures == 1 and bad.dispatches == 1
+
+
+def test_all_replicas_down_is_routing_error(make_fleet):
+    manager = make_fleet(roles=("mixed",))
+    manager.drain(manager.replicas()[0].id)
+    router = FleetRouter(manager)
+    with pytest.raises(RoutingError) as err:
+        router.route({"prompt": _prompt()})
+    assert err.value.status == 503
+
+
+def test_fleet_backpressure_surfaces_429(make_fleet, monkeypatch):
+    manager = make_fleet(roles=("mixed",))
+    replica = manager.replicas()[0]
+    monkeypatch.setattr(type(replica), "dispatch",
+                        lambda self, *a, **k: (_ for _ in ()).throw(
+                            ReplicaUnavailable("full", status=429)))
+    router = FleetRouter(manager)
+    with pytest.raises(RoutingError) as err:
+        router.route({"prompt": _prompt()})
+    assert err.value.status == 429  # the last refusal was backpressure
+
+
+def test_router_drain_stops_admission(make_fleet):
+    manager = make_fleet(roles=("mixed",))
+    router = FleetRouter(manager)
+    router.drain(timeout=5.0)
+    with pytest.raises(RoutingError) as err:
+        router.route({"prompt": _prompt()})
+    assert err.value.status == 503
+    assert all(not r.available for r in manager.replicas())
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def http_router(make_fleet):
+    manager = make_fleet(roles=("mixed", "mixed"))
+    router = FleetRouter(manager).start()
+    yield router
+    router.stop(drain=False)
+
+
+def test_http_generate_roundtrip(http_router):
+    with _post(http_router.url + "/v1/generate",
+               {"prompt": _prompt(), "max_new_tokens": 3}) as resp:
+        doc = json.loads(resp.read())
+    assert doc["state"] == "DONE" and doc["n_tokens"] == len(doc["tokens"])
+    assert doc["legs"][0]["kind"] == "serve"
+    assert "handoff" not in doc  # internal transport never leaks to clients
+
+
+def test_http_sse_stream_and_session_header(http_router):
+    with _post(http_router.url + "/v1/generate",
+               {"prompt": _prompt(), "max_new_tokens": 3, "stream": True},
+               headers={"X-DSTPU-Session": "abc"}) as resp:
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        events = [json.loads(line.decode().strip()[len("data: "):])
+                  for line in resp if line.decode().strip().startswith("data: ")]
+    done = events[-1]
+    assert done["done"] and done["state"] == "DONE"
+    assert [e["token"] for e in events[:-1]] == done["tokens"]
+
+
+def test_http_fleet_stats_and_healthz(http_router):
+    with _post(http_router.url + "/v1/generate",
+               {"prompt": _prompt(), "max_new_tokens": 2}) as resp:
+        resp.read()
+    stats = json.loads(urllib.request.urlopen(
+        http_router.url + "/v1/fleet/stats", timeout=10).read())
+    assert stats["roles"] == {"mixed": 2}
+    assert sum(r["dispatches"] for r in stats["replicas"]) == 1
+    assert stats["router"]["requests"] == 1
+    health = json.loads(urllib.request.urlopen(
+        http_router.url + "/healthz", timeout=10).read())
+    assert health["status"] == "ok"
+    # single-replica wire shape for loadgen-style clients
+    agg = json.loads(urllib.request.urlopen(
+        http_router.url + "/v1/stats", timeout=10).read())
+    assert agg["replicas"] == 2 and "queue_depth" in agg
+
+
+def test_http_bad_request_400_and_unknown_route_404(http_router):
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(http_router.url + "/v1/generate", {"prompt": []})
+    assert err.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(http_router.url + "/v1/nope", {})
+    assert err.value.code == 404
+
+
+def test_http_trace_header_adopted(http_router):
+    from deepspeed_tpu import telemetry
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+    try:
+        with _post(http_router.url + "/v1/generate",
+                   {"prompt": _prompt(), "max_new_tokens": 2},
+                   headers={TRACE_HEADER: "deadbeef01"}) as resp:
+            doc = json.loads(resp.read())
+            assert resp.headers[TRACE_HEADER] == "deadbeef01"
+        assert doc["trace_id"] == "deadbeef01"
+    finally:
+        telemetry.shutdown()
+
+
+def test_loadgen_through_router_prints_replica_attribution(http_router, llama_setup):
+    """The ISSUE satellite: percentiles measured through the router, plus
+    per-replica request counts from /v1/fleet/stats."""
+    import os
+    import subprocess
+    import sys
+    cfg = llama_setup[0]
+    bin_dir = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))), "bin")
+    r = subprocess.run(
+        [sys.executable, os.path.join(bin_dir, "dstpu_loadgen"),
+         "--target", http_router.url, "--target", http_router.url,
+         "--requests", "4", "--concurrency", "2", "--prompt-len", "8",
+         "--max-new-tokens", "3", "--vocab-size", str(cfg.vocab_size)],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "ok=4 err=0" in r.stdout
+    assert f"# fleet {http_router.url}" in r.stdout
+    assert r.stdout.count("replica mixed-") == 2  # one row per replica

@@ -1,0 +1,67 @@
+"""Cross-replica tracing (ISSUE satellite): one routed request — prefill on
+one replica, decode on another — renders as a SINGLE parented trace:
+route → dispatch:prefill → replica request, dispatch:decode → replica request."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.fleet import FleetRouter
+from deepspeed_tpu.serving.server import TRACE_HEADER
+
+
+def _events(trace_id):
+    evs = telemetry.state.spans.chrome_trace()["traceEvents"]
+    return [e for e in evs if e.get("args", {}).get("trace_id") == trace_id
+            and e.get("ph") == "X"]
+
+
+def test_disaggregated_request_is_one_parented_trace(make_fleet):
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+    fleet = make_fleet(roles=("prefill", "decode"))
+    router = FleetRouter(fleet).start()
+    try:
+        prompt = (np.arange(15) % 64).tolist()
+        body = json.dumps({"prompt": prompt, "max_new_tokens": 5}).encode()
+        req = urllib.request.Request(router.url + "/v1/generate", data=body,
+                                     headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            doc = json.loads(resp.read())
+            trace_id = resp.headers[TRACE_HEADER]
+    finally:
+        router.stop(drain=False)
+
+    assert doc["state"] == "DONE" and doc["trace_id"] == trace_id
+    evs = _events(trace_id)
+    by_name = {}
+    for e in evs:
+        by_name.setdefault(e["name"], []).append(e)
+
+    # the router's root covers the whole request
+    (route, ) = by_name["route"]
+    assert route["args"]["disaggregated"] is True
+    assert len(route["args"]["legs"]) == 2
+
+    # one dispatch hop per leg, parented under the route span
+    (hop_prefill, ) = by_name["dispatch:prefill"]
+    (hop_decode, ) = by_name["dispatch:decode"]
+    for hop in (hop_prefill, hop_decode):
+        assert hop["args"]["parent_id"] == route["args"]["span_id"]
+    assert hop_prefill["args"]["role"] == "prefill"
+    assert hop_decode["args"]["role"] == "decode"
+
+    # each replica's request root parents under ITS dispatch hop — the
+    # Perfetto track reads router -> prefill replica -> decode replica
+    requests = by_name["request"]
+    assert len(requests) == 2
+    parents = {r["args"]["parent_id"] for r in requests}
+    assert parents == {hop_prefill["args"]["span_id"],
+                       hop_decode["args"]["span_id"]}
+    resumed = {r["args"]["resumed"] for r in requests}
+    assert resumed == {True, False}
+
+    # every lifecycle span of both replica legs shares the one trace id
+    names = {e["name"] for e in evs}
+    assert {"queued", "prefill", "decode"} <= names

@@ -1,0 +1,216 @@
+"""Portable KV-block handoff payloads (ISSUE satellite): a sequence exported
+from one engine continues token-identically on ANOTHER engine — the fleet
+prefill→decode transport — plus framing/geometry/capacity failure modes."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.engine_factory import build_engine
+from deepspeed_tpu.inference.v2.ragged import handoff
+from deepspeed_tpu.inference.v2.ragged.manager_configs import (AllocationMode,
+                                                               DSStateManagerConfig,
+                                                               MemoryConfig)
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+
+@pytest.fixture(scope="module")
+def llama_setup():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = {"model": model.init(jax.random.PRNGKey(0), ids)["params"]}
+    return cfg, params
+
+
+@pytest.fixture
+def make_engine(llama_setup):
+    cfg, params = llama_setup
+    engines = []
+
+    def _make(num_blocks=32, block_size=16, **mgr_kw):
+        mgr_kw.setdefault("max_context", 256)
+        mgr = DSStateManagerConfig(
+            memory_config=MemoryConfig(mode=AllocationMode.ALLOCATE, size=num_blocks),
+            **mgr_kw)
+        engine = build_engine(params, cfg,
+                              RaggedInferenceEngineConfig(state_manager=mgr,
+                                                          kv_block_size=block_size))
+        engines.append(engine)
+        return engine
+
+    yield _make
+    for engine in engines:
+        engine.close()
+
+
+def _greedy(logits_row) -> int:
+    return int(np.argmax(np.asarray(logits_row)))
+
+
+def _decode(engine, uid, first, n):
+    toks = engine.decode_loop([uid], [np.asarray([first], np.int32)], n)
+    return np.asarray(toks)[0].tolist()
+
+
+def test_two_engine_continuation_token_identical(make_engine):
+    """Prefill + a few decode steps on engine A, export, import on engine B,
+    continue — the split run equals the single-engine run token for token."""
+    a, b = make_engine(), make_engine()
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 64, 21).astype(np.int32)
+
+    # reference: one engine, one decode_loop
+    first = _greedy(np.asarray(a.put([1], [prompt]))[0])
+    ref = _decode(a, 1, first, 6)
+
+    # split run: same prefill on A under another uid, 3 steps, hand off to B
+    first2 = _greedy(np.asarray(a.put([7], [prompt]))[0])
+    assert first2 == first
+    head = _decode(a, 7, first, 3)
+    assert head == ref[:3]
+    tokens = prompt.tolist() + [first] + head
+    payload = a.export_sequence(7, tokens=tokens, extra={"next_token": head[-1]})
+    assert isinstance(payload, bytes)
+    a.flush(7)  # the recipient owns the state now
+
+    uid, header = b.import_sequence(payload)
+    assert uid == 7
+    assert header["tokens"] == tokens
+    assert header["extra"]["next_token"] == head[-1]
+    tail = _decode(b, 7, head[-1], 3)
+    assert head + tail == ref, "handoff must not change the sampled tokens"
+    b.flush(7)
+
+
+def test_import_under_new_uid_and_uid_collision(make_engine):
+    a, b = make_engine(), make_engine()
+    prompt = np.arange(9, dtype=np.int32)
+    a.put([3], [prompt])
+    payload = a.export_sequence(3, tokens=prompt.tolist())
+
+    uid, _ = b.import_sequence(payload, uid=11)
+    assert uid == 11
+    # donor's uid is free on B, so the default lands too
+    uid2, _ = b.import_sequence(payload)
+    assert uid2 == 3
+    with pytest.raises(ValueError, match="already tracked"):
+        b.import_sequence(payload, uid=11)
+
+
+def test_export_restores_offloaded_sequence(make_engine):
+    a, b = make_engine(), make_engine()
+    prompt = np.arange(17, dtype=np.int32)
+    a.put([5], [prompt])
+    a.offload_sequence(5)
+    assert a.is_offloaded(5)
+    payload = a.export_sequence(5, tokens=prompt.tolist())
+    header, kv = handoff.unpack(payload)
+    assert header["seen_tokens"] == 17 and kv is not None
+    b.import_sequence(payload)
+    assert b._state_manager.get_sequence(5).seen_tokens == 17
+
+
+def test_framing_rejects_corruption(make_engine):
+    a = make_engine()
+    a.put([2], [np.arange(8, dtype=np.int32)])
+    payload = a.export_sequence(2, tokens=list(range(8)))
+
+    with pytest.raises(ValueError, match="bad magic"):
+        handoff.unpack(b"NOTMAGIC" + payload[8:])
+    with pytest.raises(ValueError, match="truncated"):
+        handoff.unpack(payload[:-3])
+    with pytest.raises(ValueError, match="must be bytes"):
+        handoff.unpack({"not": "bytes"})
+    # version check
+    bad = bytearray(payload)
+    hdr = handoff.unpack(payload)[0]
+    assert hdr["version"] == handoff.VERSION
+
+
+def test_seen_tokens_must_be_covered_by_shipped_kv(make_engine):
+    """A crafted header claiming more committed tokens than the payload's KV
+    blocks can hold is rejected at the framing layer — it must never reach a
+    scheduler batch where it would attend over unallocated blocks."""
+    import json
+    import struct
+
+    a = make_engine()
+    a.put([8], [np.arange(20, dtype=np.int32)])
+    payload = a.export_sequence(8, tokens=list(range(20)))
+    header, _ = handoff.unpack(payload)
+    (hdr_len, ) = struct.unpack_from("<I", payload, len(handoff.MAGIC))
+    raw = payload[len(handoff.MAGIC) + 4 + hdr_len:]
+
+    def reframe(hdr_doc):
+        hdr = json.dumps(hdr_doc).encode()
+        return handoff.MAGIC + struct.pack("<I", len(hdr)) + hdr + raw
+
+    bad = dict(header)
+    bad["seen_tokens"] = (header["kv"]["shape"][2] * header["cache"]["block_size"]) + 1
+    with pytest.raises(ValueError, match="KV coverage"):
+        handoff.unpack(reframe(bad))
+
+    # committed tokens with no KV shipped at all is just as inconsistent
+    no_kv = dict(header)
+    no_kv["kv"] = None
+    with pytest.raises(ValueError, match="KV coverage"):
+        handoff.unpack(handoff.MAGIC
+                       + struct.pack("<I", len(json.dumps(no_kv).encode()))
+                       + json.dumps(no_kv).encode())
+
+
+def test_geometry_mismatch_is_permanent(make_engine):
+    a = make_engine(block_size=16)
+    b = make_engine(block_size=8)
+    a.put([4], [np.arange(10, dtype=np.int32)])
+    payload = a.export_sequence(4, tokens=list(range(10)))
+    header, _ = handoff.unpack(payload)
+    err = handoff.compatibility_error(b._state_manager, header)
+    assert err is not None and "does not match" in err
+    with pytest.raises(ValueError, match="does not match"):
+        b.import_sequence(payload)
+
+
+def test_oversized_payload_is_permanent_small_pool_is_not(make_engine):
+    a = make_engine(num_blocks=32)
+    tiny = make_engine(num_blocks=2)
+    prompt = np.arange(60, dtype=np.int32)  # 4 blocks of 16
+    a.put([6], [prompt])
+    payload = a.export_sequence(6, tokens=prompt.tolist())
+    header, _ = handoff.unpack(payload)
+    # 4 blocks can never fit a 2-block pool: permanent, reported before import
+    assert "whole pool" in (handoff.compatibility_error(tiny._state_manager, header) or "")
+
+    # a pool that is big enough but currently full raises the allocator's
+    # capacity error and consumes nothing (evict-and-retry contract)
+    b = make_engine(num_blocks=8, max_ragged_sequence_count=4)
+    b.put([1], [np.arange(90, dtype=np.int32)])  # 6 of 8 blocks
+    free_before = b.free_blocks
+    with pytest.raises(Exception):
+        b.import_sequence(payload)
+    assert b.free_blocks == free_before
+    assert b._state_manager.get_sequence(6) is None
+
+
+def test_export_unknown_or_in_flight_uid(make_engine):
+    a = make_engine()
+    with pytest.raises(ValueError, match="unknown uid"):
+        a.export_sequence(99, tokens=[])
+
+
+def test_kv_dtype_is_part_of_the_cache_signature(make_engine):
+    """Review regression: importing into a different-dtype cache would
+    silently cast the KV and break token-identical continuation — the dtype
+    rides the signature and mismatches are permanent."""
+    a, b = make_engine(), make_engine()
+    a.put([12], [np.arange(9, dtype=np.int32)])
+    payload = a.export_sequence(12, tokens=list(range(9)))
+    header, _ = handoff.unpack(payload)
+    assert header["cache"]["dtype"] == "float32"  # these engines run fp32 KV
+    tampered = dict(header, cache=dict(header["cache"], dtype="bfloat16"))
+    err = handoff.compatibility_error(b._state_manager, tampered)
+    assert err is not None and "does not match" in err

@@ -42,6 +42,16 @@ def test_serve_v2_server_mode():
     assert "[A] done: state=DONE" in r.stdout and "[B] done: state=DONE" in r.stdout
 
 
+def test_serve_v2_fleet_mode():
+    """serve_v2.py DSTPU_SERVE_MODE=fleet: 2 prefill + 2 decode in-process
+    replicas behind the FleetRouter; both SSE requests cross the
+    prefill→decode KV handoff and report per-leg replica attribution."""
+    r = _run_example("serve_v2.py", extra_env={"DSTPU_SERVE_MODE": "fleet"})
+    assert "[A] done: state=DONE" in r.stdout and "[B] done: state=DONE" in r.stdout
+    assert "legs=[('prefill', " in r.stdout  # the handoff actually happened
+    assert "per-replica dispatches:" in r.stdout
+
+
 def test_train_zero3_with_telemetry(tmp_path):
     _run_example("train_zero3.py", extra_env={"DSTPU_TELEMETRY_DIR": str(tmp_path)})
 
