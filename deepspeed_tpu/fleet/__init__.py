@@ -18,6 +18,13 @@ promoted from MoE experts to whole engine roles:
 - :class:`FleetAutoscaler` — sustained queue-depth / KV-pressure policy loop
   that grows and drains pools through the manager, reusing the elasticity
   subsystem's valid-size / capacity signals.
+- Fault tolerance: :class:`ReplicaSupervisor` (``fleet/supervisor.py``) owns
+  replica lifecycle — spawn (``bin/dstpu_replica`` processes or in-process
+  replicas), ``/healthz``-gated registration, exit/hang detection, backoff
+  restarts, crash-loop quarantine; every replica carries a
+  :class:`CircuitBreaker` (``fleet/breaker.py``) fed by probes and dispatch
+  outcomes; :class:`FaultInjector` (``fleet/faults.py``) drives every
+  recovery path deterministically from a seed.
 
 Usage::
 
@@ -32,17 +39,26 @@ Usage::
     router.stop()                           # graceful fleet-wide drain
 """
 
-from deepspeed_tpu.fleet.config import AutoscaleConfig, FleetConfig, ReplicaRole
+from deepspeed_tpu.fleet.breaker import (BreakerConfig, BreakerState,
+                                         CircuitBreaker, backoff_delay)
+from deepspeed_tpu.fleet.config import (AutoscaleConfig, FleetConfig,
+                                        ReplicaRole, SupervisorConfig)
+from deepspeed_tpu.fleet.faults import FaultConfig, FaultInjector
 from deepspeed_tpu.fleet.manager import ReplicaManager
 from deepspeed_tpu.fleet.metrics import FleetMetrics
 from deepspeed_tpu.fleet.policy import FleetAutoscaler
 from deepspeed_tpu.fleet.replica import (HttpReplica, Leg, LocalReplica, Replica,
-                                         ReplicaState, ReplicaUnavailable)
+                                         ReplicaDied, ReplicaState,
+                                         ReplicaUnavailable)
 from deepspeed_tpu.fleet.router import FleetRouter, RoutedRequest, RoutingError
+from deepspeed_tpu.fleet.supervisor import ReplicaSlot, ReplicaSupervisor, SlotState
 
 __all__ = [
-    "AutoscaleConfig", "FleetConfig", "ReplicaRole", "ReplicaManager",
-    "FleetMetrics", "FleetAutoscaler", "HttpReplica", "Leg", "LocalReplica",
-    "Replica", "ReplicaState", "ReplicaUnavailable", "FleetRouter",
-    "RoutedRequest", "RoutingError",
+    "AutoscaleConfig", "BreakerConfig", "BreakerState", "CircuitBreaker",
+    "FaultConfig", "FaultInjector", "FleetConfig", "ReplicaRole",
+    "SupervisorConfig", "ReplicaManager", "FleetMetrics", "FleetAutoscaler",
+    "HttpReplica", "Leg", "LocalReplica", "Replica", "ReplicaDied",
+    "ReplicaState", "ReplicaUnavailable", "FleetRouter", "RoutedRequest",
+    "RoutingError", "ReplicaSlot", "ReplicaSupervisor", "SlotState",
+    "backoff_delay",
 ]

@@ -10,6 +10,8 @@ from typing import Literal, Optional
 
 from pydantic import Field
 
+from deepspeed_tpu.fleet.breaker import BreakerConfig
+from deepspeed_tpu.fleet.faults import FaultConfig
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 from deepspeed_tpu.serving.config import DEFAULT_MAX_RESUME_BODY_BYTES
 
@@ -56,6 +58,43 @@ class AutoscaleConfig(DeepSpeedConfigModel):
     pressure below the threshold) before one replica is drained."""
 
 
+class SupervisorConfig(DeepSpeedConfigModel):
+    """Knobs for :class:`deepspeed_tpu.fleet.supervisor.ReplicaSupervisor`."""
+
+    poll_interval_s: float = Field(0.25, gt=0)
+    """Monitor-loop cadence: exit/hang checks and restart scheduling."""
+
+    ready_timeout_s: float = Field(120.0, gt=0)
+    """How long a freshly-spawned replica gets to answer a healthy probe
+    before the launch counts as a crash (registration is gated on readiness —
+    an unready replica is never dispatched to)."""
+
+    probe_hang_failures: int = Field(4, ge=1)
+    """Consecutive failed liveness probes of a READY replica before it is
+    declared hung, killed, and restarted (exits are detected immediately;
+    this catches the wedged-but-alive case)."""
+
+    restart_backoff_base_s: float = Field(0.5, ge=0)
+    restart_backoff_multiplier: float = Field(2.0, ge=1)
+    restart_backoff_cap_s: float = Field(30.0, gt=0)
+    restart_jitter_frac: float = Field(0.1, ge=0, le=1)
+    """Exponential restart backoff (shared ``breaker.backoff_delay`` formula):
+    crash *k* in the crash window waits ``base * multiplier**(k-1)`` (capped,
+    ± jitter) before respawning."""
+
+    max_crashes: int = Field(3, ge=1)
+    """Crash-loop budget: this many crashes within ``crash_window_s``
+    quarantines the slot — no further respawns until ``reset()`` — instead of
+    silently burning CPU on a persistent crasher forever."""
+
+    crash_window_s: float = Field(60.0, gt=0)
+    """Sliding window for the crash-loop budget (also the backoff exponent's
+    memory: crashes aging out of the window reset the schedule)."""
+
+    seed: int = 0
+    """Restart-jitter determinism (chaos runs replay the same schedule)."""
+
+
 class FleetConfig(DeepSpeedConfigModel):
     """Knobs for the replica manager + front-end router."""
 
@@ -79,13 +118,36 @@ class FleetConfig(DeepSpeedConfigModel):
     re-probes; 0 = probe on every dispatch (tests)."""
 
     request_timeout_s: float = Field(120.0, gt=0)
-    """Per-hop upstream timeout (a replica that blocks longer fails over or
+    """Whole-leg upstream budget (a replica that blocks longer fails over or
     errors the client request)."""
+
+    connect_timeout_s: float = Field(5.0, gt=0)
+    """Upstream TCP-connect budget, separate from the read budget: a
+    black-holed upstream costs a dispatch thread this much, not the full
+    ``request_timeout_s``."""
+
+    read_timeout_s: float = Field(30.0, gt=0)
+    """Per-read upstream budget (headers, and the gap between SSE events): a
+    replica that stops producing bytes mid-leg dies as a
+    :class:`~deepspeed_tpu.fleet.replica.ReplicaDied` — a breaker signal —
+    within this bound."""
 
     max_attempts: int = Field(3, ge=1)
     """Dispatch attempts per request leg: a 503/429/connection error excludes
     the replica and retries on the next candidate, up to this bound (and never
     more than the pool size)."""
+
+    retry_backoff_base_s: float = Field(0.02, ge=0)
+    retry_backoff_cap_s: float = Field(0.5, gt=0)
+    retry_jitter_frac: float = Field(0.25, ge=0, le=1)
+    """Bounded-jitter backoff between failover attempts of one leg (the
+    shared ``breaker.backoff_delay`` policy; 0 base = retry immediately —
+    the deterministic test formulation). Failed *probes* reuse the same
+    formula at probe scale: a replica whose probe raised is not re-probed
+    before an exponentially-growing fraction of ``probe_backoff_cap_s``."""
+
+    probe_backoff_cap_s: float = Field(10.0, gt=0)
+    """Cap on the failed-probe re-probe backoff."""
 
     drain_timeout_s: float = Field(30.0, ge=0)
     """Per-replica graceful-drain budget (in-flight requests get this long to
@@ -98,3 +160,15 @@ class FleetConfig(DeepSpeedConfigModel):
 
     autoscale: AutoscaleConfig = AutoscaleConfig()
     """Elastic scaling policy (``fleet/policy.py``)."""
+
+    breaker: BreakerConfig = BreakerConfig()
+    """Per-replica circuit breaker (``fleet/breaker.py``); every registered
+    replica gets one, fed by probe failures and dispatch refusals."""
+
+    supervisor: SupervisorConfig = SupervisorConfig()
+    """Replica process supervision (``fleet/supervisor.py``)."""
+
+    faults: FaultConfig = FaultConfig()
+    """Deterministic fault injection (``fleet/faults.py``); disabled by
+    default — the ``DSTPU_FAULTS`` env var (JSON ``FaultConfig`` body) can
+    arm it without touching code."""

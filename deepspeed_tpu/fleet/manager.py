@@ -15,12 +15,17 @@ registration; the router picks the pool per request leg.
 import threading
 from typing import Callable, Dict, List, Optional
 
+from deepspeed_tpu.fleet.breaker import BreakerState, CircuitBreaker
 from deepspeed_tpu.fleet.config import FleetConfig
 from deepspeed_tpu.fleet.metrics import FleetMetrics
 from deepspeed_tpu.fleet.replica import (HttpReplica, LocalReplica, Replica,
                                          ReplicaState)
 from deepspeed_tpu.serving import ServingConfig
 from deepspeed_tpu.utils.logging import logger
+
+# states that count as absent capacity: never probed, never pooled, never in
+# the fleet_replicas gauge — only visible as stats rows
+_ABSENT_STATES = (ReplicaState.DOWN, ReplicaState.QUARANTINED)
 
 
 class ReplicaManager:
@@ -40,6 +45,7 @@ class ReplicaManager:
         self._metrics = FleetMetrics.maybe_create()
         self._lock = threading.Lock()
         self._replicas: Dict[str, Replica] = {}
+        self._supervisor = None  # ReplicaSupervisor attaches itself (stats)
 
     @property
     def config(self) -> FleetConfig:
@@ -61,7 +67,9 @@ class ReplicaManager:
                      replica_id: Optional[str] = None) -> HttpReplica:
         """Register an external ``serving/server.py`` process by URL."""
         replica = HttpReplica(url, role=role, replica_id=replica_id,
-                              timeout_s=self._config.request_timeout_s)
+                              timeout_s=self._config.request_timeout_s,
+                              connect_timeout_s=self._config.connect_timeout_s,
+                              read_timeout_s=self._config.read_timeout_s)
         return self._register(replica)
 
     def add(self, replica: Replica) -> Replica:
@@ -70,6 +78,13 @@ class ReplicaManager:
         return self._register(replica)
 
     def _register(self, replica: Replica) -> Replica:
+        if replica.breaker is None:
+            replica.breaker = CircuitBreaker(
+                self._config.breaker,
+                on_transition=self._make_breaker_observer(replica))
+        replica.probe_backoff_cap_s = self._config.probe_backoff_cap_s
+        replica.probe_jitter_frac = self._config.retry_jitter_frac
+        replica.probe_backoff_base_s = max(self._config.probe_ttl_s, 0.25)
         with self._lock:
             if replica.id in self._replicas:
                 replica.drain(timeout=0.0)
@@ -78,6 +93,25 @@ class ReplicaManager:
         logger.info(f"fleet: replica {replica.id} (role={replica.role}) registered")
         self._update_gauges()
         return replica
+
+    def _make_breaker_observer(self, replica: Replica):
+        """Breaker transitions land in the ``fleet_breaker_*`` metrics and the
+        serving log — an operator must see open/close cycles without a
+        debugger attached."""
+
+        def observe(breaker, old, new):
+            logger.warning(f"fleet: breaker[{replica.id}] {old.name} -> {new.name}")
+            if self._metrics:
+                if new is BreakerState.OPEN:
+                    self._metrics.breaker_opens.inc()
+                elif old is BreakerState.HALF_OPEN and new is BreakerState.CLOSED:
+                    self._metrics.breaker_closes.inc()
+                self._metrics.breaker_open_replicas.set(sum(
+                    1 for r in self.replicas()
+                    if r.breaker is not None
+                    and r.breaker.state is BreakerState.OPEN))
+
+        return observe
 
     # --------------------------------------------------------------- query --
     def get(self, replica_id: str) -> Replica:
@@ -99,6 +133,18 @@ class ReplicaManager:
     def pool_size(self, role: Optional[str] = None) -> int:
         return len(self.replicas(role=role, available_only=True))
 
+    def pending_replicas(self, role: Optional[str] = None) -> int:
+        """Replicas a supervisor is actively bringing (back) up — STARTING or
+        in restart BACKOFF. Capacity in flight: the autoscaler must not
+        double-fill a hole whose restart is already scheduled (only a
+        QUARANTINED slot is a durable hole)."""
+        if self._supervisor is None:
+            return 0
+        from deepspeed_tpu.fleet.supervisor import SlotState
+        return sum(1 for slot in self._supervisor.slots()
+                   if (role is None or slot.role == role)
+                   and slot.state in (SlotState.STARTING, SlotState.BACKOFF))
+
     # --------------------------------------------------------------- drain --
     def drain(self, replica_id: str, timeout: Optional[float] = None,
               remove: bool = True) -> None:
@@ -113,6 +159,15 @@ class ReplicaManager:
                 self._replicas.pop(replica_id, None)
         logger.info(f"fleet: replica {replica_id} drained")
         self._update_gauges()
+
+    def remove(self, replica_id: str) -> Optional[Replica]:
+        """Deregister without drain — the supervisor's dead-replica path (the
+        process is already gone; there is nothing to drain)."""
+        with self._lock:
+            replica = self._replicas.pop(replica_id, None)
+        if replica is not None:
+            self._update_gauges()
+        return replica
 
     def drain_all(self, timeout: Optional[float] = None) -> None:
         """Fleet-wide graceful drain (reverse registration order), used by
@@ -131,15 +186,22 @@ class ReplicaManager:
     # --------------------------------------------------------------- stats --
     def _update_gauges(self) -> None:
         if self._metrics:
+            # a QUARANTINED (crash-looping) replica is absent capacity — the
+            # autoscaler must see a hole to fill, not an unhealthy-but-live
+            # member to oscillate around
             self._metrics.replicas.set(
-                sum(1 for r in self.replicas() if r.state is not ReplicaState.DOWN))
+                sum(1 for r in self.replicas() if r.state not in _ABSENT_STATES))
 
     def sweep_probes(self, max_age_s: Optional[float] = None) -> List[dict]:
-        """Refresh every replica's probe (bounded staleness) and push the
+        """Refresh every live replica's probe (bounded staleness) and push the
         fleet-wide queue-depth / KV-pressure gauges; returns the probe docs.
+        DOWN/QUARANTINED replicas are skipped — absent capacity is not probed
+        (a quarantined process's socket would eat a connect timeout per sweep
+        for a replica that is by definition not coming back on its own).
         The router calls this per dispatch pick; the autoscaler per tick."""
         ttl = self._config.probe_ttl_s if max_age_s is None else max_age_s
-        probes = [r.probe(max_age_s=ttl) for r in self.replicas()]
+        probes = [r.probe(max_age_s=ttl) for r in self.replicas()
+                  if r.state not in _ABSENT_STATES]
         live = [p for p in probes if p.get("healthy")]
         if self._metrics:
             self._metrics.queue_depth.set(sum(p["queue_depth"] for p in live))
@@ -149,10 +211,17 @@ class ReplicaManager:
         return probes
 
     def stats(self) -> dict:
-        """/v1/fleet/stats body: per-replica rows + per-role pool sizes."""
+        """/v1/fleet/stats body: per-replica rows (quarantined ones included —
+        surfacing persistent crashers is the point), per-role pool sizes, and
+        the supervisor's slot table when one is attached."""
         replicas = self.replicas()
         roles: Dict[str, int] = {}
         for r in replicas:
             if r.available:
                 roles[r.role] = roles.get(r.role, 0) + 1
-        return {"replicas": [r.describe() for r in replicas], "roles": roles}
+        doc = {"replicas": [r.describe() for r in replicas], "roles": roles,
+               "quarantined": sum(1 for r in replicas
+                                  if r.state is ReplicaState.QUARANTINED)}
+        if self._supervisor is not None:
+            doc["supervisor"] = self._supervisor.describe()
+        return doc

@@ -41,6 +41,30 @@ class FleetMetrics:
             "fleet_scale_ups_total", "Autoscaler replica additions")
         self.scale_downs = registry.counter(
             "fleet_scale_downs_total", "Autoscaler replica drains")
+        self.breaker_opens = registry.counter(
+            "fleet_breaker_opens_total",
+            "Circuit-breaker transitions into OPEN (replica taken out of dispatch)")
+        self.breaker_closes = registry.counter(
+            "fleet_breaker_closes_total",
+            "Circuit-breaker recoveries (HALF_OPEN trial succeeded, CLOSED again)")
+        self.breaker_open_replicas = registry.gauge(
+            "fleet_breaker_open_replicas",
+            "Replicas currently behind an OPEN breaker")
+        self.breaker_short_circuits = registry.counter(
+            "fleet_breaker_short_circuits_total",
+            "Dispatch candidates skipped because their breaker was open")
+        self.restarts = registry.counter(
+            "fleet_restarts_total", "Supervised replica restarts after a crash/hang")
+        self.quarantines = registry.counter(
+            "fleet_restart_quarantines_total",
+            "Supervised replicas quarantined after exhausting the crash-loop budget")
+        self.degraded = registry.counter(
+            "fleet_degraded_requests_total",
+            "Requests served monolithically because a disaggregated pool was "
+            "entirely unavailable")
+        self.faults_injected = registry.counter(
+            "fleet_faults_injected_total",
+            "Faults injected by the chaos harness (all points)")
 
     @classmethod
     def maybe_create(cls) -> Optional["FleetMetrics"]:

@@ -110,7 +110,13 @@ class FleetAutoscaler:
         else:
             smaller = [v for v in valid if v < current]
             target = max(smaller) if smaller else None
-        if target is None or not cfg.min_replicas <= target <= cfg.max_replicas:
+        if target is None:
+            return None
+        # scale-up is bounded by max only (a step from below min TOWARD min —
+        # replacing a quarantined member — is legal); scale-down by min only
+        if direction > 0 and target > cfg.max_replicas:
+            return None
+        if direction < 0 and target < cfg.min_replicas:
             return None
         if direction > 0 and self._capacity_fn is not None \
                 and target > self._capacity_fn():
@@ -123,6 +129,19 @@ class FleetAutoscaler:
         scale event fired, None otherwise."""
         cfg = self._config
         obs = self.observe()
+        # below the floor — a drained/quarantined member left a hole
+        # (QUARANTINED counts as *absent*, not unhealthy-but-live, so a
+        # crash-looper is replaced instead of oscillated around): replace
+        # immediately, no sustain window. A supervised slot mid-restart
+        # (STARTING/BACKOFF) is capacity already in flight, not a hole —
+        # filling it too would overshoot the pool on every crash.
+        pending = self._manager.pending_replicas(role=self._role)
+        if obs["replicas"] + pending < cfg.min_replicas:
+            target = self._next_size(obs["replicas"], +1)
+            if target is not None:
+                self._scale_up(obs, target)
+                self._saturated_ticks = 0
+                return "up"
         saturated = (obs["queue_per_replica"] >= cfg.scale_up_queue_depth
                      or obs["kv_pressure"] >= cfg.scale_up_kv_pressure)
         idle = (obs["healthy"] > 0 and obs["queued"] == 0 and obs["active"] == 0

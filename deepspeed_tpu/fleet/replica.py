@@ -11,30 +11,52 @@ one dispatch interface, so the router never cares which it is talking to:
   by URL; dispatch is ``POST /v1/generate`` / ``POST /v1/resume`` over the
   wire (SSE upstream, so admission errors surface before generation and
   tokens arrive live), probing is ``GET /healthz`` + ``GET /v1/stats``.
+  Upstream sockets carry **separate connect and read budgets** — a
+  black-holed upstream costs a dispatch thread ``connect_timeout_s``, and a
+  stalled stream dies after ``read_timeout_s``, never the whole-leg budget.
 
 Dispatch returns a :class:`Leg` — a uniform handle the router iterates for
 live tokens and joins for the final result doc (which carries the KV-handoff
 payload as raw bytes when the leg was dispatched with ``handoff=True``).
 
-A replica that cannot admit right now (queue full, draining, connection
-refused) raises :class:`ReplicaUnavailable` at dispatch — the router's
-failover signal; client errors (bad payload geometry, invalid parameters)
-raise ``ValueError`` and are NOT retried elsewhere.
+Failure taxonomy (the breaker's food groups):
+
+- :class:`ReplicaUnavailable` at dispatch — cannot admit right now (429/503/
+  unreachable/connect-timeout); the router's failover signal. Status 429 is
+  backpressure, not breakage — it never feeds the circuit breaker.
+- :class:`ReplicaDied` mid-leg — the replica went away under an admitted
+  request (stream ended without a terminal event, read timeout, or the
+  request carries the scheduler's ``replica killed`` disposition). The router
+  re-dispatches a decode leg once (the handoff payload is still buffered)
+  and counts the death against the replica's breaker.
+- ``ValueError`` — client errors (bad payload geometry, invalid parameters);
+  never retried blindly (the router retries a *router-packed* resume payload
+  once, suspecting transit corruption).
+
+Every registered replica carries a :class:`~deepspeed_tpu.fleet.breaker.
+CircuitBreaker` (attached by the manager) fed here by probe outcomes and by
+the router per dispatch; a ``QUARANTINED`` replica (a supervised crash-looper)
+stays visible in ``/v1/fleet/stats`` but counts as absent capacity — never
+probed, never dispatched.
 """
 
 import base64
+import http.client
 import itertools
 import json
+import random
+import socket
 import threading
 import time
-import urllib.error
-import urllib.request
+import urllib.parse
 from enum import Enum
 from typing import Iterator, Optional
 
+from deepspeed_tpu.fleet.breaker import CircuitBreaker, backoff_delay
 from deepspeed_tpu.serving import (QueueFullError, SchedulerStopped, ServingConfig,
                                    ServingScheduler)
 from deepspeed_tpu.serving.request import Request
+from deepspeed_tpu.serving.scheduler import KILLED_ERROR_PREFIX
 from deepspeed_tpu.serving.server import PARENT_SPAN_HEADER, TRACE_HEADER
 from deepspeed_tpu.utils.logging import logger
 
@@ -45,6 +67,9 @@ class ReplicaState(Enum):
     UP = 0
     DRAINING = 1
     DOWN = 2
+    QUARANTINED = 3
+    """A supervised crash-looper: registered (visible in stats) but absent
+    capacity — not dispatched, not probed, not counted in pool sizes."""
 
 
 class ReplicaUnavailable(RuntimeError):
@@ -54,6 +79,21 @@ class ReplicaUnavailable(RuntimeError):
     def __init__(self, message: str, status: int = 503):
         super().__init__(message)
         self.status = status
+
+
+class ReplicaDied(RuntimeError):
+    """The replica went away under an admitted leg (process death, stream
+    truncation, read timeout, injected kill): the leg's tokens so far are
+    valid, its terminal doc never arrived. A breaker-grade failure; the
+    router may re-dispatch a decode leg whose handoff payload it still holds."""
+
+
+def _raise_if_killed(doc: dict) -> None:
+    """A terminal doc carrying the scheduler's kill disposition is a replica
+    death, not a semantic request failure — surface it as such."""
+    if (doc.get("state") == "FAILED"
+            and str(doc.get("error") or "").startswith(KILLED_ERROR_PREFIX)):
+        raise ReplicaDied(str(doc["error"]))
 
 
 class Leg:
@@ -72,18 +112,26 @@ class Leg:
 
 
 class Replica:
-    """Base replica: identity, role, rotation state, probe caching, and the
+    """Base replica: identity, role, rotation state, probe caching with
+    failed-probe backoff, the manager-attached circuit breaker, and the
     router-maintained dispatch counters."""
 
     def __init__(self, role: str = "mixed", replica_id: Optional[str] = None):
         self.id = replica_id if replica_id else f"{role}-{next(_REPLICA_IDS)}"
         self.role = role
         self.state = ReplicaState.UP
+        self.breaker: Optional[CircuitBreaker] = None  # attached at register
         self.dispatches = 0   # legs the router sent here (router thread)
         self.failures = 0     # legs that raised ReplicaUnavailable here
         self._probe_lock = threading.Lock()
         self._probe_at = 0.0
         self._probe_doc: Optional[dict] = None
+        self._probe_fails = 0  # consecutive raising probes (backoff driver)
+        # failed-probe re-probe backoff (manager overrides from FleetConfig);
+        # the shared bounded-jitter policy at probe scale
+        self.probe_backoff_base_s = 0.25
+        self.probe_backoff_cap_s = 10.0
+        self.probe_jitter_frac = 0.25
 
     @property
     def available(self) -> bool:
@@ -99,23 +147,39 @@ class Replica:
         A ``_probe()`` against a blackholed HTTP upstream can block for its
         full socket timeout, so a stale doc is served rather than queueing
         every router handler thread behind the one doing the refresh — only
-        the very first probe (no doc yet) waits."""
+        the very first probe (no doc yet) waits. A probe that *raised* backs
+        off exponentially (shared ``backoff_delay`` policy) before the next
+        refresh, and feeds the circuit breaker; a healthy answer closes a
+        HALF_OPEN breaker."""
         doc = self._probe_doc
-        if doc is not None and time.monotonic() - self._probe_at <= max_age_s:
+        ttl = max_age_s
+        if self._probe_fails:
+            ttl = max(ttl, backoff_delay(self._probe_fails - 1,
+                                         max(self.probe_backoff_base_s, max_age_s),
+                                         self.probe_backoff_cap_s,
+                                         self.probe_jitter_frac, random.random()))
+        if doc is not None and time.monotonic() - self._probe_at <= ttl:
             return doc
         if not self._probe_lock.acquire(blocking=doc is None):
             return doc  # a peer thread is refreshing; stale beats stalled
         try:
-            now = time.monotonic()
-            if self._probe_doc is None or now - self._probe_at > max_age_s:
+            if self._probe_doc is None or time.monotonic() - self._probe_at > ttl:
                 try:
                     self._probe_doc = self._probe()
+                    self._probe_fails = 0
+                    if self.breaker is not None and self._probe_doc.get("healthy"):
+                        self.breaker.record_probe_success()
                 except Exception as e:
+                    self._probe_fails += 1
                     self._probe_doc = {"healthy": False, "draining": False,
                                        "queue_depth": 0, "active": 0,
                                        "kv_free_frac": 0.0, "heartbeats": 0,
                                        "error": f"{type(e).__name__}: {e}"}
-                self._probe_at = now
+                    if self.breaker is not None:
+                        self.breaker.record_failure(trial=False)
+                # stamped AFTER the refresh: a slow failing probe (its whole
+                # point is bounding those) must not eat its own backoff window
+                self._probe_at = time.monotonic()
             return self._probe_doc
         finally:
             self._probe_lock.release()
@@ -152,7 +216,28 @@ class Replica:
         return {"id": self.id, "role": self.role, "state": self.state.name,
                 "url": getattr(self, "url", None),
                 "dispatches": self.dispatches, "failures": self.failures,
+                "breaker": self.breaker.describe() if self.breaker else None,
                 "probe": self._probe_doc}
+
+
+class QuarantinedReplica(Replica):
+    """Placeholder the supervisor registers for a crash-looping slot whose
+    launch never produced a live replica: visible in stats, inert otherwise."""
+
+    def __init__(self, role: str = "mixed", replica_id: Optional[str] = None):
+        super().__init__(role=role, replica_id=replica_id)
+        self.state = ReplicaState.QUARANTINED
+
+    def _probe(self) -> dict:
+        return {"healthy": False, "draining": False, "queue_depth": 0,
+                "active": 0, "kv_free_frac": 0.0, "heartbeats": 0,
+                "error": "quarantined"}
+
+    def dispatch(self, doc, resume=False, trace_id=None, parent_span_id=None):
+        raise ReplicaUnavailable(f"replica {self.id} is QUARANTINED")
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        self.state = ReplicaState.DOWN
 
 
 # ---------------------------------------------------------------------------
@@ -171,7 +256,9 @@ class _LocalLeg(Leg):
         if not req.wait(timeout):
             raise TimeoutError(f"leg {req.uid} not finished within {timeout}s")
         from deepspeed_tpu.serving.server import _request_doc
-        return _request_doc(req, raw_handoff=True)
+        doc = _request_doc(req, raw_handoff=True)
+        _raise_if_killed(doc)
+        return doc
 
     def cancel(self) -> None:
         self.request.cancel()
@@ -205,7 +292,8 @@ class LocalReplica(Replica):
         sched = self.scheduler
         free = self.engine.free_blocks
         return {
-            "healthy": self.state is ReplicaState.UP and not sched._stopping,
+            "healthy": (self.state is ReplicaState.UP and not sched._stopping
+                        and sched.ready),
             "draining": self.state is ReplicaState.DRAINING or sched._stopping,
             "queue_depth": sched.queue_depth,
             "active": sched.n_active,
@@ -236,6 +324,20 @@ class LocalReplica(Replica):
             raise ReplicaUnavailable(str(e), status=503) from e
         return _LocalLeg(req)
 
+    def kill(self, reason: str = "injected fault") -> None:
+        """Abrupt replica death (the chaos harness / supervisor test path):
+        the scheduler's kill disposition fails every in-flight request with
+        the ``replica killed`` marker, KV returns to the pool, the engine
+        closes, and the replica leaves rotation as DOWN — exactly what a
+        process SIGKILL looks like from the router's side, minus the leaked
+        file descriptors."""
+        if self.state is ReplicaState.DOWN:
+            return
+        logger.warning(f"fleet: replica {self.id} killed ({reason})")
+        self.state = ReplicaState.DOWN
+        self.scheduler.kill(reason)
+        self.engine.close()
+
     def drain(self, timeout: Optional[float] = None) -> None:
         if self.state is ReplicaState.DOWN:
             return
@@ -252,26 +354,53 @@ class _HttpLeg(Leg):
     """SSE leg against a ``serving/server.py`` upstream. The upstream is
     always dispatched streaming, so admission status arrives before any
     generation and tokens can be forwarded live; ``result()`` drains the
-    stream and returns the final ``done`` doc."""
+    stream and returns the final ``done`` doc. Transport failures mid-leg
+    (reset, read timeout, truncation) surface as :class:`ReplicaDied`.
 
-    def __init__(self, resp):
+    Liveness vs progress: the upstream emits SSE keepalive comments while it
+    has no token (queue wait, long prefill), so the per-read budget measures
+    process death, never load — but keepalives do NOT reset the *progress*
+    clock: ``progress_timeout_s`` (the whole-leg ``timeout_s``) without a
+    single new token means a live-but-wedged upstream, also a
+    :class:`ReplicaDied`."""
+
+    def __init__(self, conn, resp, replica_id: str,
+                 progress_timeout_s: float = 120.0):
+        self._conn = conn
         self._resp = resp
+        self._replica_id = replica_id
+        self._progress_timeout_s = progress_timeout_s
+        self._last_progress = time.monotonic()
         self._final: Optional[dict] = None
         self._lock = threading.Lock()
 
     def __iter__(self):
-        for line in self._resp:
-            line = line.decode().strip()
-            if not line.startswith("data: "):
-                continue
-            event = json.loads(line[len("data: "):])
-            if event.get("done"):
-                if "handoff" in event:
-                    event["handoff"] = base64.b64decode(event["handoff"])
-                with self._lock:
-                    self._final = event
-                return
-            yield int(event["token"])
+        try:
+            for line in self._resp:
+                line = line.decode().strip()
+                if not line.startswith("data: "):
+                    # keepalive/blank: proves the process lives, not that the
+                    # request progresses
+                    if (time.monotonic() - self._last_progress
+                            > self._progress_timeout_s):
+                        self.cancel()
+                        raise ReplicaDied(
+                            f"replica {self._replica_id}: no token progress in "
+                            f"{self._progress_timeout_s}s (alive but wedged)")
+                    continue
+                event = json.loads(line[len("data: "):])
+                self._last_progress = time.monotonic()
+                if event.get("done"):
+                    if "handoff" in event:
+                        event["handoff"] = base64.b64decode(event["handoff"])
+                    with self._lock:
+                        self._final = event
+                    return
+                yield int(event["token"])
+        except (socket.timeout, http.client.HTTPException, OSError) as e:
+            raise ReplicaDied(
+                f"replica {self._replica_id} stream died mid-leg: "
+                f"{type(e).__name__}: {e}") from e
 
     def result(self, timeout: Optional[float] = None) -> dict:
         with self._lock:
@@ -282,33 +411,92 @@ class _HttpLeg(Leg):
             with self._lock:
                 final = self._final
         if final is None:
-            raise RuntimeError("upstream stream ended without a done event")
+            raise ReplicaDied(f"replica {self._replica_id} stream ended "
+                              f"without a terminal event")
+        _raise_if_killed(final)
         return final
 
     def cancel(self) -> None:
         # dropping the connection cancels upstream (serving/server.py contract)
         try:
-            self._resp.close()
+            self._conn.close()
         except Exception:  # pragma: no cover - best effort
             pass
 
 
 class HttpReplica(Replica):
-    """An external ``serving/server.py`` process addressed by base URL."""
+    """An external ``serving/server.py`` process addressed by base URL.
+
+    ``connect_timeout_s`` bounds TCP establishment (a black-holed upstream),
+    ``read_timeout_s`` bounds every subsequent socket read (headers and the
+    gap between SSE events); ``timeout_s`` is kept as the legacy whole-leg
+    spelling and caps the read budget."""
 
     def __init__(self, url: str, role: str = "mixed",
-                 replica_id: Optional[str] = None, timeout_s: float = 120.0):
+                 replica_id: Optional[str] = None, timeout_s: float = 120.0,
+                 connect_timeout_s: float = 5.0, read_timeout_s: float = 30.0):
         super().__init__(role=role, replica_id=replica_id)
         self.url = url.rstrip("/")
         self.timeout_s = timeout_s
+        self.connect_timeout_s = connect_timeout_s
+        self.read_timeout_s = min(read_timeout_s, timeout_s)
+        split = urllib.parse.urlsplit(self.url)
+        self._https = split.scheme == "https"
+        self._host, self._port = split.hostname, split.port
+        self._base_path = split.path.rstrip("/")  # proxied base-URL prefix
+
+    # ------------------------------------------------------------- transport --
+    def _request(self, method: str, path: str, body: Optional[bytes] = None,
+                 headers: Optional[dict] = None,
+                 read_timeout: Optional[float] = None):
+        """Open a connection under the connect budget, issue one request,
+        return ``(conn, resp)`` with the read budget armed. Connect/send/
+        header-read failures are admission-time → :class:`ReplicaUnavailable`
+        (the failover + breaker signal)."""
+        conn_cls = (http.client.HTTPSConnection if self._https
+                    else http.client.HTTPConnection)
+        conn = conn_cls(self._host, self._port,
+                        timeout=self.connect_timeout_s)
+        path = self._base_path + path
+        try:
+            conn.connect()
+        except socket.timeout as e:
+            conn.close()
+            raise ReplicaUnavailable(
+                f"replica {self.id}: connect timeout after "
+                f"{self.connect_timeout_s}s", status=0) from e
+        except OSError as e:
+            conn.close()
+            raise ReplicaUnavailable(f"replica {self.id}: {e}", status=0) from e
+        try:
+            # connected: the per-read budget takes over (SSE gaps, headers)
+            conn.sock.settimeout(read_timeout if read_timeout is not None
+                                 else self.read_timeout_s)
+            conn.request(method, path, body=body, headers=headers or {})
+            resp = conn.getresponse()
+        except socket.timeout as e:
+            conn.close()
+            raise ReplicaUnavailable(
+                f"replica {self.id}: read timeout before response headers",
+                status=0) from e
+        except (http.client.HTTPException, OSError) as e:
+            conn.close()
+            raise ReplicaUnavailable(f"replica {self.id}: {e}", status=0) from e
+        return conn, resp
 
     def _get_json(self, path: str, timeout: float) -> dict:
-        with urllib.request.urlopen(self.url + path, timeout=timeout) as resp:
+        conn, resp = self._request("GET", path,
+                                   read_timeout=min(self.read_timeout_s, timeout))
+        try:
+            if resp.status != 200:
+                raise RuntimeError(f"GET {path} -> HTTP {resp.status}")
             return json.loads(resp.read())
+        finally:
+            conn.close()
 
     def _probe(self) -> dict:
-        health = self._get_json("/healthz", timeout=min(self.timeout_s, 5.0))
-        stats = self._get_json("/v1/stats", timeout=min(self.timeout_s, 5.0))
+        health = self._get_json("/healthz", timeout=5.0)
+        stats = self._get_json("/v1/stats", timeout=5.0)
         engine = stats.get("engine", {})
         capacity = engine.get("capacity_blocks") or 0
         free = engine.get("free_blocks") or 0
@@ -317,6 +505,7 @@ class HttpReplica(Replica):
             "draining": health.get("status") == "draining"
                         or self.state is ReplicaState.DRAINING
                         or bool(stats.get("draining")),
+            "starting": health.get("status") == "starting",
             "queue_depth": int(stats.get("queue_depth", 0)),
             "active": int(stats.get("active", {}).get("total", 0)),
             "kv_free_frac": free / capacity if capacity else 1.0,
@@ -338,24 +527,21 @@ class HttpReplica(Replica):
         if parent_span_id is not None:
             headers[PARENT_SPAN_HEADER] = str(parent_span_id)
         path = "/v1/resume" if resume else "/v1/generate"
-        req = urllib.request.Request(self.url + path,
-                                     data=json.dumps(body).encode(),
-                                     headers=headers)
-        try:
-            resp = urllib.request.urlopen(req, timeout=self.timeout_s)
-        except urllib.error.HTTPError as e:
+        conn, resp = self._request("POST", path, body=json.dumps(body).encode(),
+                                   headers=headers)
+        if resp.status != 200:
             detail = ""
             try:
-                detail = json.loads(e.read()).get("error", "")
+                detail = json.loads(resp.read()).get("error", "")
             except Exception:
                 pass
-            if e.code in (429, 503):
+            conn.close()
+            if resp.status in (429, 503):
                 raise ReplicaUnavailable(
-                    f"replica {self.id}: HTTP {e.code} {detail}", status=e.code) from e
-            raise ValueError(f"replica {self.id}: HTTP {e.code} {detail}") from e
-        except urllib.error.URLError as e:
-            raise ReplicaUnavailable(f"replica {self.id}: {e.reason}") from e
-        return _HttpLeg(resp)
+                    f"replica {self.id}: HTTP {resp.status} {detail}",
+                    status=resp.status)
+            raise ValueError(f"replica {self.id}: HTTP {resp.status} {detail}")
+        return _HttpLeg(conn, resp, self.id, progress_timeout_s=self.timeout_s)
 
     def drain(self, timeout: Optional[float] = None) -> None:
         # the upstream process is not ours to stop: drain = leave rotation

@@ -3,9 +3,11 @@
 Same wire format as ``serving/server.py`` (``POST /v1/generate`` with
 optional SSE streaming, ``POST /v1/resume``, ``GET /v1/stats``,
 ``GET /healthz``) plus ``GET /v1/fleet/stats`` (per-replica dispatch counts,
-roles, probes — what ``bin/dstpu_loadgen`` prints per-replica attribution
-from). A client cannot tell the router from a single replica, which is the
-point: "millions of users" is N replicas behind this process.
+roles, breaker states, supervisor slots, probes) and — when fault injection
+is armed with ``allow_remote`` — ``POST /v1/fleet/chaos`` (re-seed/disable
+the chaos harness; what ``bin/dstpu_loadgen --chaos`` drives). A client
+cannot tell the router from a single replica, which is the point: "millions
+of users" is N replicas behind this process.
 
 Dispatch policy per request leg:
 
@@ -15,31 +17,50 @@ Dispatch policy per request leg:
 - **least-loaded**: without a key, the replica with the fewest
   queued+in-flight requests wins (probes cached ``probe_ttl_s``, driven by
   the ``/healthz`` + ``/v1/stats`` surfaces for HTTP upstreams).
-- **failover**: a 429/503/unreachable replica is excluded and the next
-  candidate tried, up to ``max_attempts``.
+- **circuit breaking**: every replica's breaker (``fleet/breaker.py``) gates
+  candidacy — an OPEN replica is skipped without a probe or a socket; a
+  HALF_OPEN one admits bounded trial dispatches. Breakers are fed by probe
+  failures, dispatch refusals (never 429 backpressure) and mid-leg deaths.
+- **failover**: an unavailable replica is excluded and the next candidate
+  tried, up to ``max_attempts``, with bounded-jitter backoff between
+  attempts (the shared ``backoff_delay`` policy).
+- **graceful degradation**: when a disaggregated fleet has one role pool
+  entirely dark (drained, quarantined, or breaker-open), requests are served
+  monolithically on the surviving pool — counted in
+  ``fleet_degraded_requests_total`` and flagged ``degraded`` in the final
+  doc, never silent, never a blanket 502.
 
 Prefill/decode disaggregation: when both a ``prefill`` and a ``decode`` pool
 exist, a generate request runs as two legs — prefill + first token on a
 prefill-role replica (``handoff=True``), then the portable KV payload
 (``ragged/handoff.py``) continues on a decode-role replica via
-``/v1/resume`` — so TTFT capacity and ITL capacity scale independently. The
-router parents both replica request spans under its own span, so the
-Perfetto track reads router → prefill replica → decode replica as one trace.
+``/v1/resume``. A decode replica dying mid-leg is retried **once** on a peer
+with the still-buffered payload: the resume is token-identical, so the
+already-streamed token prefix is skipped and the client sees one seamless
+stream. The router parents both replica request spans under its own span, so
+the Perfetto track reads router → prefill replica → decode replica as one
+trace.
 """
 
 import base64
 import hashlib
 import json
+import os
+import random
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Iterator, List, Optional
+from typing import Iterator, List, Optional, Set
 
 from deepspeed_tpu import telemetry
+from deepspeed_tpu.fleet.breaker import backoff_delay
 from deepspeed_tpu.fleet.config import FleetConfig
+from deepspeed_tpu.fleet.faults import (FaultConfig, FaultInjector,
+                                        config_from_env)
 from deepspeed_tpu.fleet.manager import ReplicaManager
 from deepspeed_tpu.fleet.metrics import FleetMetrics
-from deepspeed_tpu.fleet.replica import Leg, Replica, ReplicaUnavailable
+from deepspeed_tpu.fleet.replica import (Leg, Replica, ReplicaDied,
+                                         ReplicaUnavailable)
 from deepspeed_tpu.serving.server import TRACE_HEADER, parse_request_body
 from deepspeed_tpu.telemetry import new_span_id, new_trace_id, now_us
 from deepspeed_tpu.utils.logging import logger
@@ -86,12 +107,18 @@ class RoutedRequest:
         self._t0_s = time.monotonic()
         self._final: Optional[dict] = None
         self._current_leg: Optional[Leg] = None
+        self._current_replica: Optional[Replica] = None
         self._legs_meta: List[dict] = []
         self._cancelled = False
+        self._degraded = False
 
         mgr = router._manager
-        prefill_pool = mgr.replicas(role="prefill", available_only=True)
-        decode_pool = mgr.replicas(role="decode", available_only=True)
+        prefill_pool = self._dispatchable("prefill")
+        decode_pool = self._dispatchable("decode")
+        # disaggregated *topology*: both roles exist in the registry, whatever
+        # their current health — the degradation accounting baseline
+        registered_roles = {r.role for r in mgr.replicas()}
+        disagg_topology = {"prefill", "decode"} <= registered_roles
         mnt = doc.get("max_new_tokens")
         # `is None`, not falsy-or: an explicit 0 must flow through to the
         # replica's own 'max_new_tokens must be >= 1' 400, exactly as it
@@ -106,17 +133,25 @@ class RoutedRequest:
                               handoff=True),
                 resume=False, pool=prefill_pool, what="prefill")
         elif resume:
-            pool = decode_pool or mgr.replicas(available_only=True)
+            pool = decode_pool or self._dispatchable()
+            if not decode_pool and "decode" in registered_roles:
+                # same contract as the generate path: serving a resume off
+                # the dark decode pool is degradation — counted, not silent
+                self._mark_degraded("decode pool unavailable; resuming on "
+                                    "the surviving pool")
             self._leg1 = self._dispatch(
                 self._leg_doc(payload=doc["payload"],
                               handoff=self._client_handoff),
                 resume=True, pool=pool, what="resume")
         else:
             # whole-request serving: the mixed pool when one exists, else any
-            # available replica (a fleet missing one disaggregated side
-            # degrades to serving whole requests wherever it can)
-            pool = (mgr.replicas(role="mixed", available_only=True)
-                    or mgr.replicas(available_only=True))
+            # dispatchable replica. A disaggregated fleet with one side
+            # entirely dark lands here — graceful degradation, counted
+            pool = self._dispatchable("mixed") or self._dispatchable()
+            if disagg_topology and self._n > 1:
+                self._mark_degraded(
+                    f"{'decode' if prefill_pool else 'prefill'} pool "
+                    f"unavailable; serving monolithically")
             self._leg1 = self._dispatch(
                 self._leg_doc(prompt=doc["prompt"],
                               handoff=self._client_handoff),
@@ -139,38 +174,96 @@ class RoutedRequest:
         if leg is not None:
             leg.cancel()
 
+    # ---------------------------------------------------------------- pools --
+    def _dispatchable(self, role: Optional[str] = None) -> List[Replica]:
+        """The pool the router may dispatch to right now: in-rotation AND not
+        behind an open breaker (an OPEN replica costs nothing here — no probe,
+        no socket)."""
+        return [r for r in self._router._manager.replicas(role=role,
+                                                          available_only=True)
+                if r.breaker is None or r.breaker.allow()]
+
+    def _mark_degraded(self, reason: str) -> None:
+        if self._degraded:
+            return
+        self._degraded = True
+        router = self._router
+        with router._counter_lock:
+            router._counters["degraded"] += 1
+        if router._metrics:
+            router._metrics.degraded.inc()
+        logger.warning(f"fleet: degraded serving: {reason}")
+
     # ---------------------------------------------------------------- legs --
     def _dispatch(self, doc: dict, resume: bool, pool: List[Replica],
-                  what: str) -> Leg:
+                  what: str, exclude: Optional[Set[str]] = None,
+                  internal_payload: bool = False) -> Leg:
         """Failover dispatch over ``pool``: an unavailable replica (429/503/
-        unreachable) is excluded and the next candidate tried; the chosen
-        replica's request root parents under a per-hop router span."""
+        unreachable) is excluded — and its breaker fed — and the next
+        candidate tried after a bounded-jitter backoff; the chosen replica's
+        request root parents under a per-hop router span. ``internal_payload``
+        marks a router-packed resume body: a replica rejecting it (ValueError)
+        smells like transit corruption, so the next attempt re-sends the
+        pristine buffered copy instead of failing the request."""
         router = self._router
         cfg = router._config
-        exclude = set()
-        last: Optional[ReplicaUnavailable] = None
-        for _ in range(min(cfg.max_attempts, max(1, len(pool)))):
+        faults = router._faults
+        exclude = set(exclude or ())
+        last: Optional[Exception] = None
+        last_status = 503
+        for attempt in range(min(cfg.max_attempts, max(1, len(pool)))):
+            if attempt and cfg.retry_backoff_base_s > 0:
+                time.sleep(backoff_delay(attempt - 1, cfg.retry_backoff_base_s,
+                                         cfg.retry_backoff_cap_s,
+                                         cfg.retry_jitter_frac, random.random()))
             candidates = router._healthy(pool, exclude)
             if not candidates:
                 break
             replica = router._pick(candidates, self._session_key)
+            breaker = replica.breaker
+            if breaker is not None and not breaker.try_acquire():
+                exclude.add(replica.id)  # HALF_OPEN trial slots exhausted
+                continue
             hop_span = new_span_id() if self.trace_id is not None else None
             t0 = now_us()
             with router._counter_lock:  # handler threads race on attribution
                 replica.dispatches += 1
+            body = doc
             try:
-                leg = replica.dispatch(doc, resume=resume,
+                if faults is not None:
+                    body = self._inject_dispatch_faults(faults, replica, doc,
+                                                        resume and internal_payload)
+                leg = replica.dispatch(body, resume=resume,
                                        trace_id=self.trace_id,
                                        parent_span_id=hop_span)
             except ReplicaUnavailable as e:
                 with router._counter_lock:
                     replica.failures += 1
+                if breaker is not None:
+                    if e.status == 429:
+                        breaker.release()  # backpressure is load, not breakage
+                    else:
+                        breaker.record_failure()
                 exclude.add(replica.id)
-                last = e
+                last, last_status = e, e.status
                 if router._metrics:
                     router._metrics.retries.inc()
                 logger.info(f"fleet: {what} leg failed over from {replica.id}: {e}")
                 continue
+            except (ValueError, TypeError) as e:
+                if breaker is not None:
+                    breaker.release()  # the payload was refused, not the replica
+                if resume and internal_payload:
+                    last, last_status = e, 502
+                    if router._metrics:
+                        router._metrics.retries.inc()
+                    logger.warning(f"fleet: {what} leg payload refused by "
+                                   f"{replica.id} (suspected transit corruption; "
+                                   f"retrying pristine): {e}")
+                    continue
+                raise
+            if breaker is not None:
+                breaker.record_success()
             spans = telemetry.get_span_recorder()
             if spans is not None and self.trace_id is not None:
                 # the hop span is recorded up-front (instant event): its id
@@ -182,14 +275,73 @@ class RoutedRequest:
                              args={"replica": replica.id, "role": replica.role,
                                    "excluded": sorted(exclude)})
             self._current_leg = leg
+            self._current_replica = replica
             self._last_replica_id = replica.id
             return leg
         if router._metrics:
             router._metrics.failures.inc()
-        status = last.status if last is not None else 503
+        status = last.status if isinstance(last, ReplicaUnavailable) else last_status
+        if status < 100:  # transport-class failures carry status=0 as the
+            status = 503  # breaker signal; a client must see a real HTTP code
         raise RoutingError(
             f"no replica available for {what} leg "
             f"({len(pool)} in pool, {len(exclude)} excluded): {last}", status)
+
+    def _inject_dispatch_faults(self, faults: FaultInjector, replica: Replica,
+                                doc: dict, corruptible: bool) -> dict:
+        """Consult every dispatch-time injection point for this attempt;
+        returns the (possibly corrupted-copy) body to send. Raising here
+        flows through the same except-arms a real transport failure would."""
+        router = self._router
+        n = faults.fire("dispatch_delay", replica.id)
+        if n is not None:
+            router._count_fault()
+            time.sleep(faults.delay_s(n, replica.id))
+        if faults.fire("replica_kill", replica.id) is not None \
+                and hasattr(replica, "kill"):
+            router._count_fault()
+            replica.kill("injected replica_kill")  # dispatch below will refuse
+        if faults.fire("connect_reset", replica.id) is not None:
+            router._count_fault()
+            raise ReplicaUnavailable(
+                f"replica {replica.id}: injected connection reset", status=0)
+        if faults.fire("http_5xx", replica.id) is not None:
+            router._count_fault()
+            raise ReplicaUnavailable(
+                f"replica {replica.id}: injected HTTP 503", status=503)
+        if corruptible:
+            n = faults.fire("handoff_corrupt", replica.id)
+            if n is not None:
+                router._count_fault()
+                # corrupt THIS attempt's copy only: the retry re-sends the
+                # pristine buffered payload (corruption-in-transit semantics)
+                return {**doc, "payload": faults.corrupt(doc["payload"], n,
+                                                         replica.id)}
+        return doc
+
+    def _stream(self, leg: Leg, replica_id: str) -> Iterator[int]:
+        """Leg token iterator with the mid-stream truncation injection point
+        armed (one decision per leg)."""
+        faults = self._router._faults
+        cut = None
+        if faults is not None:
+            n = faults.fire("stream_truncate", replica_id)
+            if n is not None:
+                self._router._count_fault()
+                cut = faults.truncate_after(n, replica_id)
+        for i, tok in enumerate(leg):
+            if cut is not None and i >= cut:
+                leg.cancel()
+                raise ReplicaDied(f"replica {replica_id}: injected mid-stream "
+                                  f"truncation after {cut} tokens")
+            yield tok
+
+    def _fail_current_replica(self) -> None:
+        """A leg died under an admitted request: a breaker-grade failure for
+        the replica that held it."""
+        replica = self._current_replica
+        if replica is not None and replica.breaker is not None:
+            replica.breaker.record_failure(trial=False)
 
     def _leg_doc(self, **overrides) -> dict:
         doc = {k: self._doc[k] for k in _LEG_FIELDS if self._doc.get(k) is not None}
@@ -205,15 +357,25 @@ class RoutedRequest:
     def _run(self) -> Iterator[int]:
         router = self._router
         if not self._disagg:
-            for tok in self._leg1:
-                yield tok
-            final = dict(self._leg1.result())
+            try:
+                for tok in self._stream(self._leg1, self._last_replica_id):
+                    yield tok
+                final = dict(self._leg1.result())
+            except ReplicaDied:
+                # single-leg death: nothing buffered to resume from — the
+                # breaker learns, the client gets 502 / a terminal SSE error
+                self._fail_current_replica()
+                raise
             self._leg_meta("resume" if self._resume else "serve", final)
             if not self._client_handoff:
                 final.pop("handoff", None)
         else:
             # --- leg 1 result: prefill + first token
-            final1 = self._leg1.result()
+            try:
+                final1 = self._leg1.result()
+            except ReplicaDied:
+                self._fail_current_replica()
+                raise
             for tok in final1["tokens"]:
                 yield tok
             self._leg_meta("prefill", final1)
@@ -237,25 +399,40 @@ class RoutedRequest:
                 final = dict(final1)
                 final.pop("handoff", None)  # internal transport, not client data
             else:
-                # --- leg 2: decode continuation on the decode pool
-                remaining = None
-                if self._doc.get("deadline_s") is not None:
-                    remaining = max(0.001, float(self._doc["deadline_s"])
-                                    - (time.monotonic() - self._t0_s))
-                decode_pool = router._manager.replicas(role="decode",
-                                                       available_only=True)
-                leg2 = self._dispatch(
-                    self._leg_doc(payload=payload,
-                                  max_new_tokens=self._n - 1,
-                                  handoff=self._client_handoff,
-                                  deadline_s=remaining),
-                    resume=True, pool=decode_pool, what="decode")
+                # --- leg 2: decode continuation on the decode pool. The
+                # payload stays buffered until the leg completes: a decode
+                # replica dying mid-leg gets ONE re-dispatch to a peer —
+                # resume is token-identical, so the already-streamed prefix
+                # is skipped and the client stream stays seamless.
                 if router._metrics:
                     router._metrics.handoffs.inc()
                     router._metrics.handoff_bytes.observe(len(payload))
-                for tok in leg2:
-                    yield tok
-                final2 = leg2.result()
+                exclude: Set[str] = set()
+                sent2 = 0
+                final2 = None
+                for attempt in range(2):
+                    leg2 = self._dispatch_decode(payload, exclude)
+                    try:
+                        to_skip, skipped = sent2, 0
+                        for tok in self._stream(leg2, self._last_replica_id):
+                            if skipped < to_skip:
+                                skipped += 1
+                                continue
+                            yield tok
+                            sent2 += 1
+                        final2 = dict(leg2.result())
+                        break
+                    except ReplicaDied as e:
+                        self._fail_current_replica()
+                        exclude.add(self._last_replica_id)
+                        if attempt == 1 or self._cancelled:
+                            raise
+                        if router._metrics:
+                            router._metrics.retries.inc()
+                        logger.warning(
+                            f"fleet: decode leg died on {self._last_replica_id} "
+                            f"after {sent2} streamed tokens; re-dispatching the "
+                            f"buffered handoff once: {e}")
                 self._leg_meta("decode", final2)
                 tokens = list(final1["tokens"]) + list(final2["tokens"])
                 final = {
@@ -273,15 +450,47 @@ class RoutedRequest:
 
         final["trace_id"] = self.trace_id
         final["legs"] = self._legs_meta
+        if self._degraded:
+            final["degraded"] = True
         spans = telemetry.get_span_recorder()
         if spans is not None and self.trace_id is not None:
             spans.record("route", cat="fleet", ts_us=self._t0_us,
                          dur_us=now_us() - self._t0_us,
                          trace_id=self.trace_id, span_id=self._root_span_id,
                          args={"disaggregated": self._disagg,
+                               "degraded": self._degraded,
                                "state": final.get("state"),
                                "legs": [m["replica"] for m in self._legs_meta]})
         self._final = final
+
+    def _dispatch_decode(self, payload: bytes, exclude: Set[str]) -> Leg:
+        """Dispatch the decode continuation: the decode pool first; when that
+        pool is entirely dark, degrade to resuming on any surviving replica
+        (prefill/mixed engines share the KV geometry) rather than 502ing a
+        request whose prefill work is already paid for."""
+        router = self._router
+        remaining = None
+        if self._doc.get("deadline_s") is not None:
+            remaining = max(0.001, float(self._doc["deadline_s"])
+                            - (time.monotonic() - self._t0_s))
+        doc = self._leg_doc(payload=payload, max_new_tokens=self._n - 1,
+                            handoff=self._client_handoff, deadline_s=remaining)
+        decode_pool = [r for r in self._dispatchable("decode")
+                       if r.id not in exclude]
+        try:
+            return self._dispatch(doc, resume=True, pool=decode_pool,
+                                  what="decode", exclude=exclude,
+                                  internal_payload=True)
+        except RoutingError:
+            fallback = [r for r in self._dispatchable()
+                        if r.role != "decode" and r.id not in exclude]
+            if not fallback:
+                raise
+            self._mark_degraded("decode pool unavailable mid-request; "
+                                "resuming on the surviving pool")
+            return self._dispatch(doc, resume=True, pool=fallback,
+                                  what="decode-degraded", exclude=exclude,
+                                  internal_payload=True)
 
 
 class FleetRouter:
@@ -291,11 +500,30 @@ class FleetRouter:
         self._manager = manager
         self._config = config or manager.config
         self._metrics = FleetMetrics.maybe_create()
-        self._counters = {"requests": 0}
+        self._counters = {"requests": 0, "degraded": 0}
         self._counter_lock = threading.Lock()
         self._server = None
         self._thread = None
         self._draining = threading.Event()
+        # fault injection: config first, the DSTPU_FAULTS env var (JSON
+        # FaultConfig body) second — None on the (default, production) path,
+        # so every hook is one is-None check
+        env_faults = config_from_env(os.environ.get("DSTPU_FAULTS"))
+        self._faults: Optional[FaultInjector] = None
+        if self._config.faults.enabled:
+            self._faults = FaultInjector(self._config.faults)
+        elif env_faults is not None and env_faults.enabled:
+            self._faults = FaultInjector(env_faults)
+        # remote chaos control is decided ONCE at construction — and
+        # independently of arming: DSTPU_FAULTS='{"allow_remote": true}'
+        # exposes the endpoint with zero faults firing, so a loadgen --chaos
+        # run's baseline half really is fault-free
+        self._chaos_remote = bool(
+            self._config.faults.allow_remote
+            or (env_faults is not None and env_faults.allow_remote))
+        if self._faults is not None:
+            logger.warning(f"fleet: FAULT INJECTION ARMED "
+                           f"(seed={self._faults.config.seed})")
 
     @property
     def manager(self) -> ReplicaManager:
@@ -307,6 +535,12 @@ class FleetRouter:
         out = []
         for replica in pool:
             if replica.id in exclude or not replica.available:
+                continue
+            if replica.breaker is not None and not replica.breaker.allow():
+                # open breaker: skipped without a probe — no socket, no
+                # handler thread pinned on a black-holed upstream
+                if self._metrics:
+                    self._metrics.breaker_short_circuits.inc()
                 continue
             probe = replica.probe(max_age_s=ttl)
             if probe.get("healthy") and not probe.get("draining"):
@@ -320,6 +554,21 @@ class FleetRouter:
             return max(candidates,
                        key=lambda r: _rendezvous_score(session_key, r.id))
         return min(candidates, key=lambda r: (r.load, r.id))
+
+    def _count_fault(self) -> None:
+        if self._metrics:
+            self._metrics.faults_injected.inc()
+
+    def set_faults(self, config: Optional[FaultConfig]) -> None:
+        """Arm/re-seed/disable the fault injector at runtime (the
+        ``/v1/fleet/chaos`` handler and the chaos tests)."""
+        self._faults = (FaultInjector(config)
+                        if config is not None and config.enabled else None)
+        if self._faults is not None:
+            logger.warning(f"fleet: FAULT INJECTION ARMED "
+                           f"(seed={config.seed})")
+        else:
+            logger.info("fleet: fault injection disarmed")
 
     def route(self, doc: dict, resume: bool = False,
               session_key: Optional[str] = None,
@@ -354,6 +603,9 @@ class FleetRouter:
         with self._counter_lock:
             doc["router"] = dict(self._counters)
         doc["router"]["draining"] = self._draining.is_set()
+        faults = self._faults
+        if faults is not None:
+            doc["faults"] = faults.report()
         return doc
 
     def stats(self) -> dict:
@@ -408,8 +660,32 @@ class FleetRouter:
                 else:
                     self._send_json(404, {"error": f"no route {path}"})
 
+            def _handle_chaos(self):
+                """POST /v1/fleet/chaos: arm/re-seed/disable fault injection
+                over HTTP — only when a config/env explicitly allowed remote
+                chaos control (403 otherwise; production routers never expose
+                a kill switch by accident)."""
+                if not router._chaos_remote:
+                    self._send_json(403, {"error": "remote chaos control is "
+                                          "not enabled on this router"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    if not 0 < length <= 1 << 16:
+                        raise ValueError(f"body length {length} out of bounds")
+                    fault_config = FaultConfig(**json.loads(self.rfile.read(length)))
+                except Exception as e:
+                    self._send_json(400, {"error": str(e)})
+                    return
+                router.set_faults(fault_config)
+                self._send_json(200, {"enabled": fault_config.enabled,
+                                      "seed": fault_config.seed})
+
             def do_POST(self):
                 path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/v1/fleet/chaos":
+                    self._handle_chaos()
+                    return
                 if path not in ("/v1/generate", "/v1/resume"):
                     self._send_json(404, {"error": f"no route {path}"})
                     return
@@ -456,8 +732,8 @@ class FleetRouter:
                     routed.cancel()
                     self._send_json(400, {"error": str(e)})
                 except RuntimeError as e:
-                    # a replica died mid-leg (e.g. an upstream SSE ended with
-                    # no done event): answer 502, free the surviving leg's KV
+                    # a replica died mid-leg (ReplicaDied, or an upstream SSE
+                    # malformation): answer 502, free the surviving leg's KV
                     routed.cancel()
                     self._send_json(502, {"error": str(e)})
 

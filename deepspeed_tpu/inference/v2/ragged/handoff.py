@@ -21,6 +21,9 @@ Header fields::
     extra        caller state (serving stashes generation state here:
                  next_token, sampler rng_state, generated count)
     kv           {"shape": [...], "dtype": "bfloat16"} or null (no blocks)
+    kv_crc32     CRC-32 of the raw KV bytes (present whenever kv is) —
+                 verified at unpack, so a payload corrupted in transit is
+                 rejected loudly instead of decoding silently wrong tokens
     cache        donor KV geometry: block_size / num_layers / kv_heads /
                  head_dim — validated on import, so a payload can only land
                  in an engine with an identical cache layout
@@ -32,6 +35,7 @@ vector.
 
 import json
 import struct
+import zlib
 from typing import Optional, Tuple
 
 import numpy as np
@@ -85,6 +89,8 @@ def pack_sequence(state_manager, uid: int, tokens, extra: Optional[dict] = None,
                                        "dtype": str(kv.dtype)},
     }
     raw = b"" if kv is None else np.ascontiguousarray(kv).tobytes()
+    if kv is not None:
+        header["kv_crc32"] = zlib.crc32(raw) & 0xFFFFFFFF
     hdr = json.dumps(header).encode()
     return MAGIC + struct.pack("<I", len(hdr)) + hdr + raw
 
@@ -118,6 +124,9 @@ def _validate_header(header) -> None:
         if not (isinstance(shape, list) and len(shape) == 6
                 and all(isinstance(d, int) and d >= 0 for d in shape)):
             raise ValueError("handoff header: kv.shape must be 6 non-negative ints")
+        crc = header.get("kv_crc32")
+        if crc is not None and not isinstance(crc, int):
+            raise ValueError("handoff header: kv_crc32 must be an int")
     # self-consistency: the committed-token count must be covered by the KV
     # actually shipped — otherwise the recipient would attend over blocks
     # that do not exist (faulting or streaming garbage for a whole batch)
@@ -161,6 +170,14 @@ def unpack(payload: bytes) -> Tuple[dict, Optional[np.ndarray]]:
     if len(payload) - off != want:
         raise ValueError(f"handoff payload truncated: {len(payload) - off} KV "
                          f"bytes, header promises {want}")
+    crc = header.get("kv_crc32")
+    # memoryview: the KV region is the bulk of a multi-MB payload on the
+    # per-request handoff hot path — checksum it without a second copy
+    if crc is not None and zlib.crc32(memoryview(payload)[off:]) & 0xFFFFFFFF != crc:
+        # corruption-in-transit must be a loud reject here, never silently
+        # wrong attention downstream (the framing checks above only catch
+        # length damage; a flipped KV byte is invisible without this)
+        raise ValueError("handoff payload corrupted: KV checksum mismatch")
     kv = np.frombuffer(payload, dtype=dtype, count=int(np.prod(shape)),
                        offset=off).reshape(shape)
     return header, kv

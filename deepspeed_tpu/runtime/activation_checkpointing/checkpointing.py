@@ -34,7 +34,8 @@ def _policy():
     if _CONFIG.cpu_checkpointing:
         cp = getattr(jax.checkpoint_policies, "offload_dot_products_to_host", None)
         if cp is not None:
-            return cp("device", "pinned_host")
+            from deepspeed_tpu.runtime.zero.offload import host_memory_kind
+            return cp("device", host_memory_kind())
         logger.warning("cpu_checkpointing: this jax has no host-offload remat policy; "
                        "saving dot products on device instead")
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable

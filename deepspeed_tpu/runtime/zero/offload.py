@@ -26,6 +26,33 @@ paths, chosen by a capability probe:
 from deepspeed_tpu.utils.logging import logger
 
 _HOST_COMPUTE_CACHE = {}
+_MEMORY_KINDS = {}
+
+
+def _addressable_memory_kinds():
+    """Memory kinds the current backend's devices actually address — jax
+    versions differ on whether CPU exposes ``pinned_host`` or only
+    ``unpinned_host``, and building a NamedSharding with an unaddressable
+    kind is a hard ValueError."""
+    import jax
+    backend = jax.default_backend()
+    if backend not in _MEMORY_KINDS:
+        try:
+            _MEMORY_KINDS[backend] = {m.kind for d in jax.local_devices()
+                                      for m in d.addressable_memories()}
+        except Exception:  # pragma: no cover - very old jax: no memories API
+            _MEMORY_KINDS[backend] = set()
+    return _MEMORY_KINDS[backend]
+
+
+def host_memory_kind() -> str:
+    """The host-resident memory kind on this backend: ``pinned_host`` where
+    it exists (TPU), else ``unpinned_host`` (CPU backends that expose only
+    the unpinned alias). Same at-rest semantics — off-accelerator DRAM."""
+    kinds = _addressable_memory_kinds()
+    if "pinned_host" in kinds or not kinds:
+        return "pinned_host"
+    return "unpinned_host"
 
 
 def backend_supports_host_compute(mesh) -> bool:
@@ -44,7 +71,7 @@ def backend_supports_host_compute(mesh) -> bool:
     if key in _HOST_COMPUTE_CACHE:
         return _HOST_COMPUTE_CACHE[key]
     try:
-        s_h = NamedSharding(mesh, P(), memory_kind="pinned_host")
+        s_h = NamedSharding(mesh, P(), memory_kind=host_memory_kind())
         s_d = NamedSharding(mesh, P())
         m0 = jax.device_put(jnp.zeros((8, )), s_h)
         g0 = jax.device_put(jnp.ones((8, )), s_d)
@@ -79,7 +106,7 @@ def with_memory_kind(shardings, memory_kind: str):
 
 
 def host_shardings(shardings):
-    return with_memory_kind(shardings, "pinned_host")
+    return with_memory_kind(shardings, host_memory_kind())
 
 
 def device_shardings(shardings):
@@ -116,7 +143,7 @@ class OptimizerOffloadPlan:
         self.rest_shardings = host_shardings(opt_shardings)
         self.compute_shardings = self.rest_shardings if self.host_compute \
             else device_shardings(opt_shardings)
-        logger.info(f"ZeRO-Offload optimizer states -> pinned_host "
+        logger.info(f"ZeRO-Offload optimizer states -> {host_memory_kind()} "
                     f"({'XLA host compute' if self.host_compute else 'dispatch-boundary staging'})")
 
     # -- checkpoint interop (overridden by the NVMe plan) ------------------------
@@ -171,7 +198,7 @@ class OptimizerOffloadPlan:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = jax.tree.leaves(param_shardings)[0].mesh
-        s_scalar_h = NamedSharding(mesh, P(), memory_kind="pinned_host")
+        s_scalar_h = NamedSharding(mesh, P(), memory_kind=host_memory_kind())
         grads_h = to_memory_kind(grads, host_shardings(grad_shardings))
         params_h = to_memory_kind(params, host_shardings(param_shardings))
         lr_h = jax.device_put(lr, s_scalar_h)

@@ -62,6 +62,12 @@ class ServingConfig(DeepSpeedConfigModel):
     """None = auto (heartbeat only when the engine has expert parallelism
     enabled); True/False force it."""
 
+    sse_keepalive_s: float = Field(10.0, gt=0)
+    """SSE comment-line cadence while a stream has no token to send (queue
+    wait, long prefill): keeps the socket demonstrably alive so a fleet
+    router's bounded read budget (``FleetConfig.read_timeout_s``) measures
+    replica *death*, never mere load."""
+
     host: str = "127.0.0.1"
     port: int = Field(0, ge=0, le=65535)
     """Bind address for ``ServingServer``; port 0 = ephemeral (the bound

@@ -53,6 +53,13 @@ _STARVATION_FAIL_TICKS = 5000
 _SCHEDULER_IDS = itertools.count()
 
 
+# error-string prefix kill() stamps on every request it fails: the fleet
+# router keys on it to tell "this replica died under the request" (retryable
+# on a peer — the decode leg re-dispatches) from a semantic engine failure
+# (which would reproduce anywhere)
+KILLED_ERROR_PREFIX = "replica killed"
+
+
 class QueueFullError(RuntimeError):
     """reject-mode backpressure: the submission queue is at capacity."""
 
@@ -96,6 +103,9 @@ class ServingScheduler:
         self._stopping = False   # no new submits
         self._shutdown = False   # thread exit
         self._stopped = False
+        self._killed = False     # kill(): abrupt-death disposition ran
+        self._kill_reason: Optional[str] = None
+        self._ready = threading.Event()  # the loop has started ticking
         self._starved_ticks = 0
         self._start_s = time.monotonic()
         self._last_heartbeat_s = 0.0
@@ -705,7 +715,11 @@ class ServingScheduler:
 
     # ------------------------------------------------------------------ loop --
     def _run(self) -> None:
+        self._ready.set()  # readiness gate: the loop is ticking
         while not self._shutdown:
+            if self._kill_reason is not None:
+                self._die()  # in-flight disposition on the engine-owning thread
+                return
             flight = telemetry.get_flight_recorder()
             if flight is not self._flight:
                 self._attach_flight(flight)
@@ -733,6 +747,49 @@ class ServingScheduler:
             self._engine.empty_run()
 
     # ------------------------------------------------------------------ stop --
+    @property
+    def ready(self) -> bool:
+        """Readiness (the ``/healthz`` gate): the background loop has started
+        ticking — requests submitted now will actually be scheduled. A
+        manually-driven scheduler (``start=False``) is ready by construction;
+        a stopped/killed one is not."""
+        if self._stopped:
+            return False
+        return self._ready.is_set() or self._thread is None
+
+    def kill(self, reason: str = "killed") -> None:
+        """Abrupt-death disposition (the fault-injection / supervisor path —
+        ``stop()`` is the graceful sibling): no drain, every queued and
+        in-flight request is finalized FAILED with a ``replica killed:``
+        error so streams and legs observe the death as a terminal event, KV
+        blocks return to the pool, and the loop exits. Idempotent."""
+        if self._stopped or self._killed:
+            return
+        with self._not_full:
+            self._stopping = True
+            self._kill_reason = reason
+            self._not_full.notify_all()  # wake blocked submitters
+        if self._thread is not None:
+            self._thread.join()  # _run sees the flag and runs _die()
+            self._thread = None
+        else:
+            self._die()
+
+    def _die(self) -> None:
+        """The kill disposition, on the engine-owning thread: fail everything
+        terminal, free KV, detach, mark dead."""
+        error = f"{KILLED_ERROR_PREFIX}: {self._kill_reason or 'killed'}"
+        for req in list(self._active.values()):
+            self._finalize(req, RequestState.FAILED, error=error)
+        while self._queue:
+            self._finalize(self._queue.popleft(), RequestState.FAILED, error=error)
+        self._shutdown = True
+        self._killed = True
+        if getattr(self._engine, "_serving_scheduler", None) is self:
+            self._engine._serving_scheduler = None
+        self._attach_flight(None)
+        self._stopped = True
+
     def _has_work(self) -> bool:
         return (bool(self._queue) or bool(self._active)
                 or self._admitting is not None)

@@ -50,6 +50,7 @@ then the listener shuts down.
 
 import base64
 import json
+import queue
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -171,8 +172,16 @@ class ServingServer:
                 if path == "/v1/stats":
                     self._send_json(200, scheduler.stats())
                 elif path == "/healthz":
-                    self._send_json(200, {"status": "draining" if draining.is_set()
-                                          else "ok"})
+                    # readiness-gated liveness: "starting" until the scheduler
+                    # loop ticks (a supervisor registers a replica only on
+                    # "ok" — see fleet/supervisor.py), "draining" on the way
+                    # out; fleet probes treat anything but "ok" as
+                    # not-dispatchable
+                    if draining.is_set():
+                        status = "draining"
+                    else:
+                        status = "ok" if scheduler.ready else "starting"
+                    self._send_json(200, {"status": status})
                 else:
                     self._send_json(404, {"error": f"no route {path}"})
 
@@ -248,10 +257,24 @@ class ServingServer:
                     self.send_header(TRACE_HEADER, req.trace_id)
                 self.end_headers()
                 try:
-                    for i, tok in enumerate(req.stream):
+                    i = 0
+                    while True:
+                        try:
+                            tok = req.stream.get(timeout=cfg.sse_keepalive_s)
+                        except queue.Empty:
+                            # no token yet (queue wait, long prefill): an SSE
+                            # comment keeps the socket demonstrably alive, so
+                            # a fleet router's read budget measures death,
+                            # never load (SSE parsers ignore ':' lines)
+                            self.wfile.write(b": keepalive\n\n")
+                            self.wfile.flush()
+                            continue
+                        if tok is None:  # stream closed and drained: terminal
+                            break
                         self.wfile.write(
                             f"data: {json.dumps({'token': tok, 'index': i})}\n\n".encode())
                         self.wfile.flush()
+                        i += 1
                     self.wfile.write(
                         f"data: {json.dumps({'done': True, **_request_doc(req)})}\n\n".encode())
                     self.wfile.flush()

@@ -65,4 +65,14 @@ METRIC_FAMILIES = {
     "fleet_handoff_bytes": "KV-handoff payload size",
     "fleet_scale_ups_total": "autoscaler replica additions",
     "fleet_scale_downs_total": "autoscaler replica drains",
+    # fleet fault tolerance (fleet/breaker.py, fleet/supervisor.py,
+    # fleet/router.py, fleet/faults.py)
+    "fleet_breaker_opens_total": "circuit-breaker transitions into OPEN",
+    "fleet_breaker_closes_total": "circuit-breaker recoveries (HALF_OPEN trial succeeded)",
+    "fleet_breaker_open_replicas": "replicas currently behind an OPEN breaker",
+    "fleet_breaker_short_circuits_total": "dispatch candidates skipped on an open breaker",
+    "fleet_restarts_total": "supervised replica restarts after a crash or hang",
+    "fleet_restart_quarantines_total": "supervised replicas quarantined after crash-looping",
+    "fleet_degraded_requests_total": "requests served monolithically with a disaggregated pool dark",
+    "fleet_faults_injected_total": "faults injected by the chaos harness",
 }

@@ -16,6 +16,13 @@ prefill-role and two decode-role in-process replicas behind the FleetRouter.
 Each request prefills (plus first token) on a prefill replica, hands its KV
 off as a portable payload, and finishes decoding on a decode replica; the
 final SSE event shows both legs. Then a fleet-wide graceful drain.
+
+Supervised mode (``DSTPU_SERVE_MODE=supervised``): the fault-tolerance loop —
+a ReplicaSupervisor owns two replica slots (readiness-gated registration),
+one replica is killed mid-fleet, the supervisor detects the death and
+restarts it automatically (visible as ``fleet_restarts_total`` and in the
+``/v1/fleet/stats`` supervisor table), and requests keep flowing throughout
+because the router's failover + circuit breaker route around the hole.
 """
 
 import os
@@ -193,6 +200,91 @@ def fleet_main():
     print("OK")
 
 
+def supervised_main():
+    """Fault-tolerance demo: a supervised 2-replica fleet survives a replica
+    kill — the supervisor readiness-gates registration, detects the death,
+    restarts the replica with backoff, and the router serves through it all
+    (failover during the outage, full capacity after the restart)."""
+    import json
+    import time
+    import urllib.request
+
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.fleet import (FleetConfig, FleetRouter, ReplicaManager,
+                                     SlotState, SupervisorConfig)
+    from deepspeed_tpu.fleet.supervisor import ReplicaSupervisor
+    from deepspeed_tpu.serving import ServingConfig
+
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+
+    cfg = LlamaConfig.tiny(vocab_size=512, max_position_embeddings=128)
+    _, params = init_params(cfg, seq_len=16)
+    engine_config = RaggedInferenceEngineConfig(
+        state_manager=DSStateManagerConfig(
+            memory_config=MemoryConfig(mode=AllocationMode.ALLOCATE, size=128),
+            max_context=128, max_ragged_batch_size=256, max_ragged_sequence_count=8),
+        kv_block_size=16)
+
+    manager = ReplicaManager(
+        engine_factory=lambda: build_engine(params, cfg, engine_config),
+        config=FleetConfig(probe_ttl_s=0.0),
+        serving_config=ServingConfig(decode_chunk=4))
+    supervisor = ReplicaSupervisor(manager, SupervisorConfig(
+        poll_interval_s=0.05, restart_backoff_base_s=0.1,
+        restart_backoff_cap_s=0.5, max_crashes=5, crash_window_s=120.0))
+    slot_a = supervisor.add_local(role="mixed")
+    supervisor.add_local(role="mixed")
+    supervisor.start()
+    assert supervisor.wait_ready(timeout=300), "replicas never became ready"
+    router = FleetRouter(manager).start()
+    print(f"supervised fleet on {router.url}: "
+          f"{manager.pool_size('mixed')} replicas "
+          f"(registration was gated on /healthz readiness)")
+
+    def generate(name):
+        body = json.dumps({"prompt": rng.integers(0, cfg.vocab_size, 12).tolist(),
+                           "max_new_tokens": 6}).encode()
+        req = urllib.request.Request(router.url + "/v1/generate", data=body,
+                                     headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            doc = json.loads(resp.read())
+        assert doc["state"] == "DONE", doc
+        print(f"[{name}] done: state={doc['state']} "
+              f"replica={doc['legs'][0]['replica']} tokens={doc['tokens']}")
+
+    rng = np.random.default_rng(0)
+    generate("before-kill")
+
+    # a replica dies abruptly (what a SIGKILL'd process looks like in-process)
+    slot_a.replica.kill("demo crash")
+    print(f"killed replica {slot_a.id}; serving continues on the survivor...")
+    generate("during-outage")  # failover + breaker route around the hole
+
+    deadline = time.monotonic() + 300
+    while not (slot_a.state is SlotState.READY and slot_a.restarts >= 1):
+        assert time.monotonic() < deadline, "supervisor never restarted the replica"
+        time.sleep(0.05)
+    print(f"supervisor restarted {slot_a.id} automatically "
+          f"(restarts={slot_a.restarts})")
+    generate("after-restart")
+
+    stats = json.loads(urllib.request.urlopen(
+        router.url + "/v1/fleet/stats", timeout=10).read())
+    sup = stats["supervisor"]
+    assert sup["restarts"] >= 1, sup
+    assert all(s["state"] == "READY" for s in sup["slots"]), sup
+    assert manager.pool_size("mixed") == 2
+    restarts_metric = telemetry.get_registry().snapshot()["fleet_restarts_total"]
+    assert restarts_metric[0][1] >= 1
+    print(f"supervisor table: restarts={sup['restarts']} "
+          f"slots={[(s['id'], s['state']) for s in sup['slots']]}")
+
+    supervisor.stop()
+    router.stop()  # graceful fleet-wide drain
+    telemetry.shutdown()
+    print("OK")
+
+
 def main():
     cfg = LlamaConfig.tiny(vocab_size=512, max_position_embeddings=128)
     _, params = init_params(cfg, seq_len=16)
@@ -249,5 +341,7 @@ if __name__ == "__main__":
         serve_main()
     elif mode == "fleet":
         fleet_main()
+    elif mode == "supervised":
+        supervised_main()
     else:
         main()

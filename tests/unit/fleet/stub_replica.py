@@ -1,0 +1,90 @@
+"""Stdlib-only stub replica server for supervisor process tests.
+
+Speaks just enough of the ``serving/server.py`` surface for the supervisor's
+readiness gate and hang detection (``GET /healthz``, ``GET /v1/stats``) and
+honors the ``--port-file`` announcement protocol — without importing jax, so
+a spawn costs ~100ms and the tier-1 suite can exercise real process
+supervision (exit detection, SIGKILL, restart, crash-loop quarantine).
+
+Modes:
+
+- ``serve`` (default) — healthy forever;
+- ``exit`` — exit(1) immediately (before announcing): the launch-failure path;
+- ``exit-after-ready`` — announce, serve healthy, then exit(1) after
+  ``--ttl-s``: the crash-after-ready path;
+- ``never-ready`` — announce and serve, but ``/healthz`` stays ``starting``:
+  the readiness-timeout path;
+- ``hang-after-ready`` — healthy for ``--ttl-s``, then every request blocks:
+  the hang-detection path.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--port-file", required=True)
+    p.add_argument("--mode", default="serve",
+                   choices=("serve", "exit", "exit-after-ready", "never-ready",
+                            "hang-after-ready"))
+    p.add_argument("--ttl-s", type=float, default=0.5)
+    args = p.parse_args(argv)
+
+    if args.mode == "exit":
+        sys.exit(1)
+
+    t0 = time.monotonic()
+
+    class Handler(BaseHTTPRequestHandler):
+
+        def _send(self, doc):
+            data = json.dumps(doc).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if args.mode == "hang-after-ready" and \
+                    time.monotonic() - t0 > args.ttl_s:
+                time.sleep(3600)  # wedged, not dead
+            if self.path.startswith("/healthz"):
+                status = "starting" if args.mode == "never-ready" else "ok"
+                self._send({"status": status})
+            elif self.path.startswith("/v1/stats"):
+                self._send({"queue_depth": 0, "active": {"total": 0},
+                            "counters": {"heartbeats": 0},
+                            "engine": {"free_blocks": 1, "capacity_blocks": 1},
+                            "draining": False})
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def log_message(self, fmt, *a):
+            ...
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    server.daemon_threads = True
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    host, port = server.server_address
+    tmp = f"{args.port_file}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(f"{host} {port}\n")
+    os.replace(tmp, args.port_file)
+
+    if args.mode == "exit-after-ready":
+        time.sleep(args.ttl_s)
+        sys.exit(1)
+    while True:
+        time.sleep(1.0)
+
+
+if __name__ == "__main__":
+    main()

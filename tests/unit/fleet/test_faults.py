@@ -1,0 +1,593 @@
+"""Chaos suite (ISSUE tentpole): the deterministic FaultInjector drives every
+recovery path the fault-tolerance subsystem claims — failover + breaker
+cycles on injected 5xx, decode-leg re-dispatch on mid-stream death, pristine
+retry on corrupted handoffs, kill + failover, graceful degradation — plus
+bounded upstream socket budgets and the seeded chaos soak (slow)."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.fleet import (BreakerConfig, BreakerState, FaultConfig,
+                                 FaultInjector, FleetConfig, FleetRouter,
+                                 HttpReplica, ReplicaDied, ReplicaState,
+                                 ReplicaUnavailable, RoutingError,
+                                 SupervisorConfig)
+from deepspeed_tpu.fleet.supervisor import ReplicaSupervisor, SlotState
+
+
+def _prompt(n=9, vocab=64):
+    return (np.arange(n) % vocab).tolist()
+
+
+def _fleet_config(**kw):
+    kw.setdefault("probe_ttl_s", 0.0)
+    kw.setdefault("retry_backoff_base_s", 0.0)  # deterministic test retries
+    kw.setdefault("breaker", BreakerConfig(failure_threshold=2,
+                                           open_cooldown_s=0.1))
+    return FleetConfig(**kw)
+
+
+def _snapshot(name):
+    series = telemetry.get_registry().snapshot().get(name, [])
+    return sum(v for _, v in series)
+
+
+# ---------------------------------------------------------------------------
+# the injector itself
+# ---------------------------------------------------------------------------
+def test_schedule_is_deterministic_and_matches_live_fires():
+    cfg = FaultConfig(enabled=True, seed=42, connect_reset_p=0.25,
+                      http_5xx_p=0.2, http_5xx_burst=3)
+    a, b = FaultInjector(cfg), FaultInjector(cfg)
+    for point, scope in (("connect_reset", "r0"), ("http_5xx", "r1"),
+                         ("http_5xx", None)):
+        live = [n for n in (a.fire(point, scope) for _ in range(200))
+                if n is not None]
+        assert live == a.schedule(point, 200, scope)      # live == pure oracle
+        assert live == b.schedule(point, 200, scope)      # fresh instance agrees
+        assert live, f"nothing fired at {point} in 200 events — p rotted?"
+    # a different seed is a different schedule
+    other = FaultInjector(FaultConfig(enabled=True, seed=43,
+                                      connect_reset_p=0.25))
+    assert (other.schedule("connect_reset", 200, "r0")
+            != a.schedule("connect_reset", 200, "r0"))
+    # bursts produce consecutive runs (what trips a breaker)
+    sched = a.schedule("http_5xx", 500, "burst-scope")
+    runs = sum(1 for i in range(1, len(sched)) if sched[i] == sched[i - 1] + 1)
+    assert runs > 0, "burst=3 never produced consecutive faults"
+    with pytest.raises(ValueError):
+        a.fire("not_a_point")
+    report = a.report()
+    assert report["seed"] == 42 and report["fired"]
+
+
+def test_router_has_no_injector_by_default_and_env_arms_it(make_fleet,
+                                                           monkeypatch):
+    manager = make_fleet(roles=("mixed",))
+    assert FleetRouter(manager)._faults is None  # production default
+    monkeypatch.setenv("DSTPU_FAULTS",
+                       '{"enabled": true, "seed": 9, "http_5xx_p": 0.5}')
+    armed = FleetRouter(manager)
+    assert armed._faults is not None and armed._faults.config.seed == 9
+    # allow_remote WITHOUT enabled: the chaos endpoint is live but nothing
+    # fires until armed over it — a loadgen --chaos baseline stays fault-free
+    monkeypatch.setenv("DSTPU_FAULTS", '{"allow_remote": true}')
+    remote_only = FleetRouter(manager)
+    assert remote_only._faults is None and remote_only._chaos_remote
+    monkeypatch.setenv("DSTPU_FAULTS", '{"enabled": fal')  # malformed
+    with pytest.raises(Exception):
+        FleetRouter(manager)  # a typo'd chaos config must not run clean
+
+
+# ---------------------------------------------------------------------------
+# breaker cycle under injected faults (acceptance: open -> half-open ->
+# closed observed in fleet_* metrics)
+# ---------------------------------------------------------------------------
+def test_injected_5xx_trips_breakers_then_recovery_closes_them(make_fleet):
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+    manager = make_fleet(roles=("mixed", "mixed"), config=_fleet_config())
+    router = FleetRouter(manager)
+    router.set_faults(FaultConfig(enabled=True, seed=0, http_5xx_p=1.0))
+    # every dispatch attempt 503s: each request feeds one failure to each
+    # replica's breaker; threshold=2 opens both after two requests
+    for _ in range(2):
+        with pytest.raises(RoutingError):
+            router.route({"prompt": _prompt(), "max_new_tokens": 2}).result()
+    replicas = manager.replicas()
+    assert all(r.breaker.state is BreakerState.OPEN for r in replicas)
+    assert _snapshot("fleet_breaker_opens_total") == 2
+    assert _snapshot("fleet_breaker_open_replicas") == 2
+    # an OPEN breaker short-circuits candidacy: no pool at all
+    with pytest.raises(RoutingError) as err:
+        router.route({"prompt": _prompt(), "max_new_tokens": 2})
+    assert "0 in pool" in str(err.value)
+    assert _snapshot("fleet_routing_failures_total") >= 3
+    # the fault clears; after the cooldown the HALF_OPEN trial dispatch
+    # succeeds and the breakers close — the full cycle, metric-visible
+    router.set_faults(None)
+    time.sleep(0.12)
+    assert all(r.breaker.state is BreakerState.HALF_OPEN for r in replicas)
+    doc = router.route({"prompt": _prompt(), "max_new_tokens": 2}).result()
+    assert doc["state"] == "DONE"
+    assert any(r.breaker.state is BreakerState.CLOSED for r in replicas)
+    assert _snapshot("fleet_breaker_closes_total") >= 1
+    assert _snapshot("fleet_faults_injected_total") >= 4
+    # /v1/fleet/stats surfaces breaker state + the injector report
+    stats = router.fleet_stats()
+    assert all(row["breaker"]["opens"] >= 1 for row in stats["replicas"])
+
+
+def test_half_open_admits_bounded_trials_only(make_fleet):
+    manager = make_fleet(roles=("mixed",), config=_fleet_config())
+    replica = manager.replicas()[0]
+    replica.breaker.record_failure()
+    replica.breaker.record_failure()
+    assert replica.breaker.state is BreakerState.OPEN
+    time.sleep(0.12)
+    assert replica.breaker.try_acquire()       # the one trial slot
+    assert not replica.breaker.try_acquire()   # concurrent peers are refused
+    replica.breaker.record_failure()           # trial failed: OPEN again,
+    assert replica.breaker.state is BreakerState.OPEN
+    d = replica.breaker.describe()
+    assert d["open_episodes"] == 2             # with a scaled cooldown
+
+
+# ---------------------------------------------------------------------------
+# mid-stream death: single leg dies loudly, decode leg re-dispatches
+# ---------------------------------------------------------------------------
+def test_stream_truncation_single_leg_is_a_loud_502(make_fleet):
+    manager = make_fleet(roles=("mixed",), config=_fleet_config())
+    replica = manager.replicas()[0]
+    router = FleetRouter(manager)
+    router.set_faults(FaultConfig(enabled=True, seed=1, stream_truncate_p=1.0,
+                                  stream_truncate_max_tokens=2))
+    routed = router.route({"prompt": _prompt(), "max_new_tokens": 8})
+    with pytest.raises(ReplicaDied):
+        routed.result()
+    # the death fed the breaker and the replica-side request reached a
+    # terminal state with its KV freed (the truncation cancels the leg)
+    assert replica.breaker.describe()["consecutive_failures"] >= 1
+    deadline = time.monotonic() + 10
+    while replica.scheduler.n_active and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert replica.scheduler.n_active == 0
+    assert replica.engine._state_manager.n_tracked_sequences == 0
+
+
+def _make_disagg(make_fleet, decode_ids=("d0", "d1")):
+    manager = make_fleet(roles=(), config=_fleet_config())
+    manager.add_local(role="prefill", replica_id="p0")
+    for rid in decode_ids:
+        manager.add_local(role="decode", replica_id=rid)
+    return manager
+
+
+def test_decode_leg_death_redispatches_once_token_identical(make_fleet):
+    """The ISSUE satellite: a decode replica dying mid-leg no longer 502s —
+    the still-buffered handoff payload re-dispatches to a peer once, the
+    token-identical resume's already-streamed prefix is skipped, and the
+    client sees one seamless, byte-identical stream."""
+    manager = _make_disagg(make_fleet)
+    router = FleetRouter(manager)
+    doc = {"prompt": _prompt(17), "max_new_tokens": 7}
+    expected = router.route(dict(doc)).result()  # fault-free baseline
+    assert expected["state"] == "DONE" and len(expected["tokens"]) == 7
+
+    # a seed whose schedule kills d0's first streamed leg but spares d1
+    # (dispatch order is deterministic: load ties break by id, d0 first)
+    seed = next(s for s in range(1000)
+                if (i := FaultInjector(FaultConfig(
+                    enabled=True, seed=s, stream_truncate_p=0.5,
+                    stream_truncate_max_tokens=2))).would_fire(
+                        "stream_truncate", 0, "d0")
+                and not i.would_fire("stream_truncate", 0, "d1"))
+    router.set_faults(FaultConfig(enabled=True, seed=seed,
+                                  stream_truncate_p=0.5,
+                                  stream_truncate_max_tokens=2))
+    routed = router.route(dict(doc))
+    streamed = list(routed.tokens())
+    final = routed.result()
+    assert final["state"] == "DONE"
+    assert final["tokens"] == expected["tokens"], "resume must be token-identical"
+    assert streamed == expected["tokens"], "client stream must be seamless"
+    kinds = [(m["kind"], m["replica"]) for m in final["legs"]]
+    assert kinds[0] == ("prefill", "p0")
+    assert kinds[-1] == ("decode", "d1"), f"decode must re-land on d1: {kinds}"
+    # d0's dead leg reached a terminal state; nothing leaked anywhere
+    for rid in ("d0", "d1"):
+        replica = manager.get(rid)
+        deadline = time.monotonic() + 10
+        while replica.scheduler.n_active and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert replica.engine._state_manager.n_tracked_sequences == 0, rid
+
+
+def test_corrupted_handoff_is_rejected_and_retried_pristine(make_fleet):
+    """Corruption-in-transit: the decode replica rejects the payload loudly
+    (never silent wrong tokens); the router re-sends its pristine buffered
+    copy and the request completes token-identically."""
+    manager = _make_disagg(make_fleet, decode_ids=("d0",))
+    router = FleetRouter(manager)
+    doc = {"prompt": _prompt(11), "max_new_tokens": 5}
+    expected = router.route(dict(doc)).result()
+    seed = next(s for s in range(1000)
+                if (i := FaultInjector(FaultConfig(
+                    enabled=True, seed=s, handoff_corrupt_p=0.5))).would_fire(
+                        "handoff_corrupt", 0, "d0")
+                and not i.would_fire("handoff_corrupt", 1, "d0"))
+    router.set_faults(FaultConfig(enabled=True, seed=seed,
+                                  handoff_corrupt_p=0.5))
+    final = router.route(dict(doc)).result()
+    assert final["state"] == "DONE"
+    assert final["tokens"] == expected["tokens"]
+    d0 = manager.get("d0")
+    assert d0.engine._state_manager.n_tracked_sequences == 0
+
+
+def test_replica_kill_fails_over_and_leaves_no_half_dead_replica(make_fleet):
+    manager = make_fleet(roles=(), config=_fleet_config())
+    manager.add_local(role="mixed", replica_id="m0")
+    manager.add_local(role="mixed", replica_id="m1")
+    router = FleetRouter(manager)
+    seed = next(s for s in range(1000)
+                if (i := FaultInjector(FaultConfig(
+                    enabled=True, seed=s, replica_kill_p=0.5))).would_fire(
+                        "replica_kill", 0, "m0")
+                and not i.would_fire("replica_kill", 0, "m1"))
+    router.set_faults(FaultConfig(enabled=True, seed=seed, replica_kill_p=0.5))
+    doc = router.route({"prompt": _prompt(), "max_new_tokens": 3}).result()
+    assert doc["state"] == "DONE"            # failover absorbed the kill
+    m0, m1 = manager.get("m0"), manager.get("m1")
+    assert m0.state is ReplicaState.DOWN     # killed outright, not half-dead
+    assert doc["legs"][0]["replica"] == "m1"
+    assert m0.scheduler._stopped             # kill disposition ran
+    router.set_faults(None)
+    doc2 = router.route({"prompt": _prompt(), "max_new_tokens": 2}).result()
+    assert doc2["state"] == "DONE" and doc2["legs"][0]["replica"] == "m1"
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation
+# ---------------------------------------------------------------------------
+def test_decode_pool_dark_degrades_to_monolithic_counted(make_fleet):
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+    manager = _make_disagg(make_fleet, decode_ids=("d0",))
+    router = FleetRouter(manager)
+    baseline = router.route({"prompt": _prompt(), "max_new_tokens": 4}).result()
+    assert [m["kind"] for m in baseline["legs"]] == ["prefill", "decode"]
+    assert "degraded" not in baseline
+    # the whole decode pool goes dark (breaker OPEN — drained/quarantined
+    # behave identically through _dispatchable)
+    d0 = manager.get("d0")
+    d0.breaker.record_failure()
+    d0.breaker.record_failure()
+    assert d0.breaker.state is BreakerState.OPEN
+    final = router.route({"prompt": _prompt(), "max_new_tokens": 4}).result()
+    assert final["state"] == "DONE", "degradation must serve, not 502"
+    assert final["degraded"] is True
+    assert [m["kind"] for m in final["legs"]] == ["serve"]  # monolithic
+    assert final["legs"][0]["replica"] == "p0"
+    assert _snapshot("fleet_degraded_requests_total") == 1
+    assert router.fleet_stats()["router"]["degraded"] == 1
+
+
+def test_decode_death_with_no_decode_peer_degrades_mid_request(make_fleet):
+    """Mid-request degradation: the only decode replica is killed at its
+    dispatch; the buffered payload resumes on the surviving prefill replica
+    (counted), instead of 502ing a request whose prefill is already paid."""
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+    manager = _make_disagg(make_fleet, decode_ids=("d0",))
+    router = FleetRouter(manager)
+    doc = {"prompt": _prompt(13), "max_new_tokens": 6}
+    expected = router.route(dict(doc)).result()
+    seed = next(s for s in range(1000)
+                if (i := FaultInjector(FaultConfig(
+                    enabled=True, seed=s, replica_kill_p=0.5))).would_fire(
+                        "replica_kill", 0, "d0")
+                and not i.would_fire("replica_kill", 0, "p0")
+                and not i.would_fire("replica_kill", 1, "p0"))
+    router.set_faults(FaultConfig(enabled=True, seed=seed, replica_kill_p=0.5))
+    final = router.route(dict(doc)).result()
+    assert final["state"] == "DONE"
+    assert final["tokens"] == expected["tokens"]
+    assert final["degraded"] is True
+    assert final["legs"][-1]["kind"] == "decode"
+    assert final["legs"][-1]["replica"] == "p0"  # resumed on the survivor
+    assert _snapshot("fleet_degraded_requests_total") == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos control endpoint
+# ---------------------------------------------------------------------------
+def test_chaos_endpoint_is_403_unless_explicitly_allowed(make_fleet):
+    manager = make_fleet(roles=("mixed",))
+    router = FleetRouter(manager).start()
+    try:
+        req = urllib.request.Request(
+            router.url + "/v1/fleet/chaos",
+            data=json.dumps({"enabled": True, "seed": 1}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=10)
+        assert err.value.code == 403
+        assert router._faults is None
+    finally:
+        router.stop(drain=False)
+
+
+def test_chaos_endpoint_arms_reseedss_and_disarms(make_fleet):
+    manager = make_fleet(roles=("mixed",),
+                         config=_fleet_config(
+                             faults=FaultConfig(allow_remote=True)))
+    router = FleetRouter(manager).start()
+    try:
+        def post(body):
+            req = urllib.request.Request(
+                router.url + "/v1/fleet/chaos", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read())
+        out = post({"enabled": True, "seed": 7, "dispatch_delay_p": 1.0,
+                    "dispatch_delay_max_s": 0.001})
+        assert out == {"enabled": True, "seed": 7}
+        assert router._faults is not None and router._faults.config.seed == 7
+        doc = router.route({"prompt": _prompt(), "max_new_tokens": 2}).result()
+        assert doc["state"] == "DONE"
+        stats = json.loads(urllib.request.urlopen(
+            router.url + "/v1/fleet/stats", timeout=10).read())
+        assert stats["faults"]["fired"].get("dispatch_delay", 0) >= 1
+        assert post({"enabled": False}) == {"enabled": False, "seed": 0}
+        assert router._faults is None
+    finally:
+        router.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# bounded socket budgets (ISSUE satellite)
+# ---------------------------------------------------------------------------
+def test_blackholed_upstream_bounded_by_read_budget():
+    """An upstream that accepts and then goes silent pins the dispatch thread
+    for the READ budget, not timeout_s=120; the failure is the breaker-grade
+    ReplicaUnavailable."""
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(8)
+    host, port = listener.getsockname()
+    try:
+        replica = HttpReplica(f"http://{host}:{port}", replica_id="blackhole",
+                              connect_timeout_s=0.5, read_timeout_s=0.3)
+        t0 = time.monotonic()
+        with pytest.raises(ReplicaUnavailable) as err:
+            replica.dispatch({"prompt": [1, 2], "max_new_tokens": 2})
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0, f"dispatch pinned for {elapsed:.1f}s"
+        assert "timeout" in str(err.value)
+        assert err.value.status == 0  # transport-class: a breaker signal
+        # probes are bounded too, and failed probes back off: the second
+        # probe inside the backoff window serves the cached error doc
+        # without touching the socket again
+        t0 = time.monotonic()
+        doc = replica.probe(max_age_s=0.0)
+        assert not doc["healthy"] and "error" in doc
+        first_at = replica._probe_at
+        assert replica.probe(max_age_s=0.0) is doc
+        assert replica._probe_at == first_at
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        listener.close()
+
+
+def test_wedged_upstream_dies_by_progress_ceiling_despite_keepalives(make_engine):
+    """A live-but-wedged replica (scheduler halted, HTTP handler still
+    emitting SSE keepalives) dies by the whole-leg progress ceiling — the
+    keepalives prove the process lives, so the read budget alone can't catch
+    it, and must not."""
+    from deepspeed_tpu.serving import ServingConfig, ServingScheduler, ServingServer
+    engine = make_engine()
+    scheduler = ServingScheduler(engine, ServingConfig(sse_keepalive_s=0.05))
+    server = ServingServer(scheduler).start()
+    try:
+        scheduler.submit(_prompt(), max_new_tokens=2).result()  # XLA warm-up
+        replica = HttpReplica(server.url, replica_id="stall",
+                              connect_timeout_s=1.0, read_timeout_s=0.5,
+                              timeout_s=1.2)
+        leg = replica.dispatch({"prompt": _prompt(), "max_new_tokens": 200})
+        first = next(iter(leg))
+        assert isinstance(first, int)
+        scheduler._shutdown = True  # wedge: loop exits, stream never closes
+        t0 = time.monotonic()
+        with pytest.raises(ReplicaDied, match="no token progress"):
+            leg.result(timeout=10)
+        elapsed = time.monotonic() - t0
+        assert 0.5 < elapsed < 6.0, elapsed  # ceiling, not the read budget
+    finally:
+        scheduler._shutdown = True
+        server.stop(drain=False)
+
+
+def test_slow_but_alive_replica_survives_the_read_budget(make_engine):
+    """Load is not breakage: a replica whose first token takes much longer
+    than read_timeout_s (deep queue, long prefill) keepalives its way
+    through the read budget and completes normally — no ReplicaDied, no
+    breaker food."""
+    from deepspeed_tpu.serving import ServingConfig, ServingScheduler, ServingServer
+    engine = make_engine()
+    scheduler = ServingScheduler(engine, ServingConfig(sse_keepalive_s=0.05),
+                                 start=False)  # manual stepping = a stall knob
+    server = ServingServer(scheduler).start()
+    try:
+        replica = HttpReplica(server.url, replica_id="slow",
+                              connect_timeout_s=1.0, read_timeout_s=0.3,
+                              timeout_s=120.0)
+        leg = replica.dispatch({"prompt": _prompt(), "max_new_tokens": 3})
+        time.sleep(1.0)  # TTFT >> read_timeout_s: only keepalives flow
+
+        def drive():
+            for _ in range(5000):
+                if scheduler._counters["completed"] >= 1:
+                    return
+                if not scheduler.step():
+                    time.sleep(0.005)
+
+        driver = threading.Thread(target=drive, daemon=True)
+        driver.start()
+        final = leg.result(timeout=120)
+        driver.join(timeout=60)
+        assert final["state"] == "DONE" and len(final["tokens"]) == 3
+    finally:
+        scheduler.stop(drain=False)
+        server.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# the seeded chaos soak (slow)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_seeded_chaos_soak_every_request_terminal_no_leaks(make_fleet):
+    """The acceptance run: kills + resets + 5xx + delays + truncations +
+    corrupted handoffs against a supervised disaggregated fleet under
+    concurrent load. Every request reaches a terminal state, nothing leaks
+    KV or sequences, no thread hangs, at least one automatic restart and one
+    breaker open happen, and the identical seed reproduces the identical
+    fault schedule."""
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+    fault_config = FaultConfig(
+        enabled=True, seed=1234,
+        dispatch_delay_p=0.10, dispatch_delay_max_s=0.005,
+        connect_reset_p=0.05, http_5xx_p=0.05, http_5xx_burst=3,
+        stream_truncate_p=0.04, stream_truncate_max_tokens=3,
+        handoff_corrupt_p=0.04, replica_kill_p=0.02)
+    manager = make_fleet(roles=(), config=_fleet_config(), num_blocks=96)
+    supervisor = ReplicaSupervisor(manager, SupervisorConfig(
+        poll_interval_s=0.05, ready_timeout_s=60.0,
+        restart_backoff_base_s=0.05, restart_backoff_cap_s=0.2,
+        restart_jitter_frac=0.1, max_crashes=50, crash_window_s=600.0, seed=7))
+    for role in ("prefill", "prefill", "decode", "decode"):
+        supervisor.add_local(role=role)
+    supervisor.start()
+    assert supervisor.wait_ready(timeout=300.0)
+    router = FleetRouter(manager)
+    router.set_faults(FaultConfig(**fault_config.model_dump()))
+
+    n_requests = 200
+    rng = np.random.default_rng(0)
+    outcomes = []
+    lock = threading.Lock()
+    thread_floor = threading.active_count()
+
+    def one(i):
+        prompt = rng.integers(0, 64, int(rng.integers(4, 32))).tolist()
+        doc = {"prompt": prompt, "max_new_tokens": int(rng.integers(2, 10)),
+               "temperature": 0.7 if i % 3 == 0 else 0.0, "seed": i}
+        try:
+            routed = router.route(doc)
+            final = routed.result()
+            with lock:
+                outcomes.append((final["state"], i))
+        except (RoutingError, ReplicaDied, RuntimeError, ValueError) as e:
+            # under chaos some requests legitimately fail — but they must
+            # fail TERMINALLY and promptly, never hang
+            with lock:
+                outcomes.append((f"refused:{type(e).__name__}", i))
+
+    threads = [threading.Thread(target=one, args=(i, )) for i in range(n_requests)]
+    for batch in range(0, n_requests, 8):
+        group = threads[batch:batch + 8]
+        for t in group:
+            t.start()
+        for t in group:
+            t.join(timeout=300)
+            assert not t.is_alive(), "chaos request wedged — not terminal"
+
+    assert len(outcomes) == n_requests  # every request reached a terminal state
+    done = sum(1 for s, _ in outcomes if s == "DONE")
+    assert done >= n_requests // 2, f"chaos drowned the fleet: {done} DONE"
+
+    # at least one automatic restart and one breaker open, metric-visible
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and not supervisor.wait_ready(timeout=1.0):
+        pass
+    assert _snapshot("fleet_restarts_total") >= 1, "no automatic restart"
+    assert _snapshot("fleet_breaker_opens_total") >= 1, "no breaker trip"
+    assert _snapshot("fleet_faults_injected_total") >= 10
+
+    # quiesce, then the leak sweep over every LIVE engine
+    router.set_faults(None)
+    supervisor.stop()
+    deadline = time.monotonic() + 60
+    for replica in manager.replicas():
+        if replica.state is not ReplicaState.UP:
+            continue
+        sched = replica.scheduler
+        while ((sched.n_active or sched.queue_depth)
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert sched.n_active == 0 and sched.queue_depth == 0, replica.id
+        assert replica.engine._state_manager.n_tracked_sequences == 0, replica.id
+        assert replica.engine.free_blocks == 96, \
+            f"{replica.id} leaked {96 - replica.engine.free_blocks} KV blocks"
+
+    # no hung threads beyond the replica schedulers that are still serving
+    live_threads = threading.active_count()
+    assert live_threads <= thread_floor + len(manager.replicas()) + 4, \
+        f"thread leak: {live_threads} alive (floor {thread_floor})"
+
+    # identical seed -> identical fault schedule: the pure-schedule property
+    # the live run rode on, recomputed by two fresh injectors
+    fresh = FaultInjector(FaultConfig(**fault_config.model_dump()))
+    again = FaultInjector(FaultConfig(**fault_config.model_dump()))
+    for point in ("connect_reset", "http_5xx", "replica_kill"):
+        assert fresh.schedule(point, 300, "scope") == again.schedule(point, 300, "scope")
+
+
+# ---------------------------------------------------------------------------
+# loadgen chaos mode (ISSUE satellite)
+# ---------------------------------------------------------------------------
+def test_loadgen_chaos_mode_prints_recovery_report(make_fleet):
+    """bin/dstpu_loadgen --chaos <seed>: baseline half, remote-armed fault
+    injection half, recovery report with restarts / breaker trips / degraded
+    counts and the p99 delta."""
+    import os
+    import subprocess
+    import sys
+    manager = make_fleet(roles=("mixed", "mixed"),
+                         config=_fleet_config(
+                             faults=FaultConfig(allow_remote=True)))
+    router = FleetRouter(manager).start()
+    bin_dir = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))), "bin")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(bin_dir, "dstpu_loadgen"),
+             "--target", router.url, "--requests", "8", "--concurrency", "2",
+             "--prompt-len", "6", "--max-new-tokens", "3", "--vocab-size", "64",
+             "--chaos", "7",
+             "--chaos-profile",
+             '{"dispatch_delay_p": 1.0, "dispatch_delay_max_s": 0.002,'
+             ' "connect_reset_p": 0.0, "http_5xx_p": 0.0,'
+             ' "stream_truncate_p": 0.0, "handoff_corrupt_p": 0.0,'
+             ' "replica_kill_p": 0.0}'],
+            capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr[-800:] + r.stdout[-800:]
+        assert "# chaos seed=7" in r.stdout
+        assert "# recovery report" in r.stdout
+        assert "faults injected" in r.stdout
+        assert "breaker trips" in r.stdout
+        assert "p99 e2e" in r.stdout
+        assert "8/8 requests reached a terminal outcome" in r.stdout
+        # the injector was disarmed at the end of the run
+        assert router._faults is None
+        # delays actually fired (dispatch_delay_p=1.0, 4 chaos requests)
+        fired = [line for line in r.stdout.splitlines()
+                 if "faults injected" in line][0]
+        assert "dispatch_delay" in fired
+    finally:
+        router.stop(drain=False)

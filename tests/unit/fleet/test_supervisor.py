@@ -1,0 +1,298 @@
+"""ReplicaSupervisor unit tests (ISSUE satellite): readiness gate, backoff
+schedule, exit/hang detection, restart, crash-loop quarantine — against both
+in-process (local) slots and real subprocesses (the stdlib stub server, so no
+jax import per spawn)."""
+
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.fleet import (FleetConfig, FleetRouter, ReplicaManager,
+                                 ReplicaState, SlotState, SupervisorConfig,
+                                 backoff_delay)
+from deepspeed_tpu.fleet.supervisor import ReplicaSupervisor
+
+STUB = os.path.join(os.path.dirname(os.path.abspath(__file__)), "stub_replica.py")
+
+FAST = dict(poll_interval_s=0.05, ready_timeout_s=5.0,
+            restart_backoff_base_s=0.05, restart_backoff_cap_s=0.2,
+            restart_jitter_frac=0.0)
+
+
+def _stub_cmd(mode="serve", ttl_s=0.5):
+    return [sys.executable, STUB, "--port-file", "{port_file}",
+            "--mode", mode, "--ttl-s", str(ttl_s)]
+
+
+def _fleet_config(**kw):
+    kw.setdefault("probe_ttl_s", 0.0)
+    kw.setdefault("connect_timeout_s", 1.0)
+    kw.setdefault("read_timeout_s", 1.0)
+    kw.setdefault("probe_backoff_cap_s", 0.1)
+    kw.setdefault("retry_backoff_base_s", 0.0)
+    return FleetConfig(**kw)
+
+
+def _wait(predicate, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# the shared backoff policy
+# ---------------------------------------------------------------------------
+def test_backoff_delay_grows_caps_and_jitters():
+    base, cap = 0.5, 10.0
+    bare = [backoff_delay(k, base, cap) for k in range(8)]
+    assert bare[:4] == [0.5, 1.0, 2.0, 4.0]
+    assert bare[-1] == cap  # capped, not unbounded
+    assert bare == sorted(bare)
+    # jitter is BOUNDED: d*(1±j), deterministic in the caller's draw
+    lo = backoff_delay(2, base, cap, jitter_frac=0.25, u=0.0)
+    hi = backoff_delay(2, base, cap, jitter_frac=0.25, u=1.0 - 1e-12)
+    assert lo == pytest.approx(2.0 * 0.75)
+    assert hi == pytest.approx(2.0 * 1.25, rel=1e-6)
+    assert backoff_delay(2, base, cap, 0.25, u=0.5) == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# local-backed slots (in-process replicas, real engines)
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def supervised_local(make_fleet):
+    """One supervised local slot over the shared engine factory."""
+    manager = make_fleet(roles=(), config=_fleet_config())
+    supervisor = ReplicaSupervisor(manager, SupervisorConfig(
+        max_crashes=3, crash_window_s=60.0, **FAST))
+    slot = supervisor.add_local(role="mixed")
+    supervisor.start()
+    yield manager, supervisor, slot
+    supervisor.stop()
+
+
+def test_local_readiness_gate_then_dispatchable(supervised_local):
+    manager, supervisor, slot = supervised_local
+    assert supervisor.wait_ready(timeout=30.0)
+    assert slot.state is SlotState.READY
+    # registration happened only after readiness: the replica is dispatchable
+    assert manager.pool_size("mixed") == 1
+    router = FleetRouter(manager)
+    doc = router.route({"prompt": [1, 2, 3], "max_new_tokens": 2}).result()
+    assert doc["state"] == "DONE"
+    # surfaced in /v1/fleet/stats via the manager
+    stats = router.fleet_stats()
+    assert stats["supervisor"]["slots"][0]["state"] == "READY"
+    assert stats["supervisor"]["restarts"] == 0
+
+
+def test_local_kill_is_detected_and_restarted(supervised_local):
+    manager, supervisor, slot = supervised_local
+    assert supervisor.wait_ready(timeout=30.0)
+    old_replica = slot.replica
+    old_replica.kill("chaos")
+    _wait(lambda: slot.restarts >= 1 and slot.state is SlotState.READY,
+          timeout=60.0, what="automatic restart")
+    assert slot.replica is not old_replica          # a fresh engine
+    assert slot.replica.id == slot.id               # same fleet identity
+    assert manager.pool_size("mixed") == 1
+    router = FleetRouter(manager)
+    doc = router.route({"prompt": [4, 5], "max_new_tokens": 2}).result()
+    assert doc["state"] == "DONE"  # the restarted replica serves
+
+
+def test_local_crash_loop_quarantines_and_reset_recovers(make_fleet):
+    manager = make_fleet(roles=(), config=_fleet_config())
+    supervisor = ReplicaSupervisor(manager, SupervisorConfig(
+        max_crashes=2, crash_window_s=60.0, **FAST))
+    slot = supervisor.add_local(role="mixed")
+    supervisor.start()
+    try:
+        for _ in range(2):  # kill every incarnation: a persistent crasher
+            _wait(lambda: slot.state is SlotState.READY
+                  or slot.state is SlotState.QUARANTINED,
+                  timeout=60.0, what="slot ready")
+            if slot.state is SlotState.QUARANTINED:
+                break
+            slot.replica.kill("chaos")
+            time.sleep(0.1)
+        _wait(lambda: slot.state is SlotState.QUARANTINED, timeout=60.0,
+              what="quarantine")
+        # surfaced, not silently respawned: a QUARANTINED row in stats,
+        # absent from every capacity view
+        assert manager.pool_size("mixed") == 0
+        stats = manager.stats()
+        assert stats["quarantined"] == 1
+        row = next(r for r in stats["replicas"] if r["id"] == slot.id)
+        assert row["state"] == "QUARANTINED"
+        restarts_before = slot.restarts
+        time.sleep(0.3)
+        assert slot.restarts == restarts_before, "quarantined slot respawned"
+        # operator reset clears the budget and relaunches
+        supervisor.reset(slot.id)
+        _wait(lambda: slot.state is SlotState.READY, timeout=60.0,
+              what="post-reset relaunch")
+        assert manager.pool_size("mixed") == 1
+    finally:
+        supervisor.stop()
+
+
+def test_quarantined_replica_is_absent_capacity_for_autoscaler(make_fleet):
+    """The ISSUE small-fix: a quarantined replica must read as a hole to
+    fill (scale up to replace), not an unhealthy-but-live member to
+    oscillate around."""
+    from deepspeed_tpu.fleet import AutoscaleConfig, FleetAutoscaler
+    manager = make_fleet(roles=("mixed", "mixed"), config=_fleet_config())
+    victim = manager.replicas()[0]
+    victim.state = ReplicaState.QUARANTINED  # what the supervisor does
+    scaler = FleetAutoscaler(manager, AutoscaleConfig(
+        min_replicas=2, max_replicas=4, sustain_ticks=3))
+    obs = scaler.observe()
+    assert obs["replicas"] == 1  # absent, not unhealthy-but-live
+    assert obs["queue_per_replica"] != float("inf")
+    # below the floor: replaced immediately, no sustain window
+    assert scaler.step() == "up"
+    assert manager.pool_size("mixed") == 2
+    # and the pool is now stable: no oscillating scale-down of the new member
+    assert scaler.step() is None
+
+
+def test_autoscaler_does_not_double_fill_a_restarting_slot(make_fleet):
+    """A supervised slot mid-restart (BACKOFF) is capacity in flight, not a
+    hole: the below-min replacement must wait for the supervisor, else every
+    crash overshoots the pool by one."""
+    from deepspeed_tpu.fleet import AutoscaleConfig, FleetAutoscaler
+    manager = make_fleet(roles=("mixed",), config=_fleet_config())
+    supervisor = ReplicaSupervisor(manager, SupervisorConfig(
+        max_crashes=10, crash_window_s=60.0, **FAST))
+    slot = supervisor.add_local(role="mixed")
+    scaler = FleetAutoscaler(manager, AutoscaleConfig(
+        min_replicas=2, max_replicas=4))
+    # simulate the supervisor's crash window: replica removed, slot BACKOFF
+    slot.state = SlotState.BACKOFF
+    assert manager.pool_size("mixed") == 1
+    assert manager.pending_replicas("mixed") == 1
+    assert scaler.step() is None, "restart in flight — not a hole to fill"
+    # a QUARANTINED slot IS a durable hole
+    slot.state = SlotState.QUARANTINED
+    assert scaler.step() == "up"
+    assert manager.pool_size("mixed") == 2
+
+
+# ---------------------------------------------------------------------------
+# process-backed slots (real subprocesses, stdlib stub server)
+# ---------------------------------------------------------------------------
+def test_process_spawn_ready_kill_restart():
+    manager = ReplicaManager(config=_fleet_config())
+    supervisor = ReplicaSupervisor(manager, SupervisorConfig(
+        max_crashes=3, crash_window_s=60.0, **FAST))
+    slot = supervisor.add_process(_stub_cmd("serve"), role="mixed")
+    supervisor.start()
+    try:
+        assert supervisor.wait_ready(timeout=30.0)
+        assert manager.pool_size("mixed") == 1
+        pid = slot.replica.proc.pid
+        probe = slot.replica.probe(max_age_s=0.0)
+        assert probe["healthy"]
+        os.kill(pid, signal.SIGKILL)  # a real crash
+        _wait(lambda: slot.restarts >= 1 and slot.state is SlotState.READY,
+              timeout=30.0, what="process restart")
+        assert slot.replica.proc.pid != pid
+        assert manager.pool_size("mixed") == 1
+        row = manager.stats()["supervisor"]["slots"][0]
+        assert row["restarts"] == 1 and row["kind"] == "process"
+    finally:
+        supervisor.stop()
+    assert slot.replica is None or slot.replica.proc.poll() is not None, \
+        "supervisor.stop() must reap its processes"
+
+
+def test_process_never_ready_exhausts_budget_and_quarantines():
+    manager = ReplicaManager(config=_fleet_config())
+    supervisor = ReplicaSupervisor(manager, SupervisorConfig(
+        max_crashes=2, crash_window_s=60.0, poll_interval_s=0.05,
+        ready_timeout_s=0.5, restart_backoff_base_s=0.05,
+        restart_backoff_cap_s=0.1, restart_jitter_frac=0.0))
+    slot = supervisor.add_process(_stub_cmd("never-ready"), role="mixed")
+    supervisor.start()
+    try:
+        _wait(lambda: slot.state is SlotState.QUARANTINED, timeout=30.0,
+              what="quarantine of a never-ready replica")
+        assert "not ready" in slot.last_error
+        # never registered as dispatchable capacity — only the placeholder row
+        assert manager.pool_size("mixed") == 0
+        row = next(r for r in manager.stats()["replicas"] if r["id"] == slot.id)
+        assert row["state"] == "QUARANTINED"
+    finally:
+        supervisor.stop()
+
+
+def test_process_exit_before_announce_is_a_launch_crash():
+    manager = ReplicaManager(config=_fleet_config())
+    supervisor = ReplicaSupervisor(manager, SupervisorConfig(
+        max_crashes=1, crash_window_s=60.0, **FAST))
+    slot = supervisor.add_process(_stub_cmd("exit"), role="mixed")
+    supervisor.start()
+    try:
+        _wait(lambda: slot.state is SlotState.QUARANTINED, timeout=30.0,
+              what="instant-exit quarantine")
+        assert "exited" in slot.last_error
+    finally:
+        supervisor.stop()
+
+
+def test_process_hang_is_detected_and_restarted():
+    """A wedged-but-alive replica (answers nothing, process up) is killed
+    after probe_hang_failures consecutive failed probes and restarted."""
+    manager = ReplicaManager(config=_fleet_config())
+    supervisor = ReplicaSupervisor(manager, SupervisorConfig(
+        max_crashes=5, crash_window_s=2.0, probe_hang_failures=2, **FAST))
+    slot = supervisor.add_process(_stub_cmd("hang-after-ready", ttl_s=0.3),
+                                  role="mixed")
+    supervisor.start()
+    try:
+        assert supervisor.wait_ready(timeout=30.0)
+        pid = slot.replica.proc.pid
+        _wait(lambda: slot.restarts >= 1, timeout=30.0, what="hang restart")
+        assert "hung" in (slot.last_error or "")
+        assert slot.replica is None or slot.replica.proc.pid != pid
+    finally:
+        supervisor.stop()
+
+
+@pytest.mark.slow
+def test_dstpu_replica_process_end_to_end(tmp_path):
+    """The real bin/dstpu_replica entrypoint under supervision: readiness-
+    gated registration, a routed request, graceful teardown. Slow: each spawn
+    imports jax in a subprocess."""
+    pytest.importorskip("jax")
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    cmd = [sys.executable, os.path.join(repo, "bin", "dstpu_replica"),
+           "--port-file", "{port_file}", "--vocab-size", "64",
+           "--num-blocks", "32", "--max-context", "64"]
+    # a real replica's first request compiles XLA: give the read budget the
+    # compile time (the 1s test default is for the stub server)
+    manager = ReplicaManager(config=_fleet_config(read_timeout_s=180.0))
+    supervisor = ReplicaSupervisor(manager, SupervisorConfig(
+        max_crashes=2, crash_window_s=120.0, poll_interval_s=0.1,
+        ready_timeout_s=180.0, restart_backoff_base_s=0.1,
+        restart_backoff_cap_s=0.5, restart_jitter_frac=0.0))
+    slot = supervisor.add_process(cmd, role="mixed",
+                                  env={"JAX_PLATFORMS": "cpu"})
+    supervisor.start()
+    try:
+        assert supervisor.wait_ready(timeout=240.0), slot.describe()
+        router = FleetRouter(manager)
+        prompt = (np.arange(5) % 64).tolist()
+        doc = router.route({"prompt": prompt, "max_new_tokens": 3}).result()
+        assert doc["state"] == "DONE" and doc["n_tokens"] == 3
+    finally:
+        supervisor.stop()

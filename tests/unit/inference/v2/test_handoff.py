@@ -126,9 +126,16 @@ def test_framing_rejects_corruption(make_engine):
     with pytest.raises(ValueError, match="must be bytes"):
         handoff.unpack({"not": "bytes"})
     # version check
-    bad = bytearray(payload)
     hdr = handoff.unpack(payload)[0]
     assert hdr["version"] == handoff.VERSION
+    # a single flipped byte in the raw-KV region keeps every length/framing
+    # check happy — only the kv_crc32 catches it (corruption-in-transit must
+    # be a loud reject, never silently wrong attention on the recipient)
+    assert isinstance(hdr["kv_crc32"], int)
+    flipped = bytearray(payload)
+    flipped[-1] ^= 0xFF
+    with pytest.raises(ValueError, match="checksum mismatch"):
+        handoff.unpack(bytes(flipped))
 
 
 def test_seen_tokens_must_be_covered_by_shipped_kv(make_engine):

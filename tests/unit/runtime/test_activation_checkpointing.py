@@ -37,8 +37,12 @@ def test_checkpoint_preserves_value_and_grad():
 
     direct_v, direct_g = jax.value_and_grad(_fn)(w, x)
     ck_v, ck_g = jax.value_and_grad(lambda w, x: checkpointing.checkpoint(_fn, w, x))(w, x)
-    np.testing.assert_allclose(np.asarray(ck_v), np.asarray(direct_v), rtol=1e-6)
-    np.testing.assert_allclose(np.asarray(ck_g), np.asarray(direct_g), rtol=1e-6)
+    # remat re-executes the forward under a different fusion plan, so the
+    # recomputed activations can differ from the saved ones by a few fp32
+    # ulps (observed 2e-6 relative across XLA releases) — value parity, not
+    # bit parity, is the contract
+    np.testing.assert_allclose(np.asarray(ck_v), np.asarray(direct_v), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ck_g), np.asarray(direct_g), rtol=1e-5)
 
 
 def test_checkpoint_reduces_saved_residuals():
@@ -64,7 +68,9 @@ def test_checkpoint_partition_activations_policy():
     x = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
     v, g = jax.value_and_grad(lambda w, x: checkpointing.checkpoint(_fn, w, x))(w, x)
     dv, dg = jax.value_and_grad(_fn)(w, x)
-    np.testing.assert_allclose(np.asarray(g), np.asarray(dg), rtol=1e-6)
+    # same ulp headroom as above: remat recomputation is value-, not
+    # bit-identical across XLA fusion plans
+    np.testing.assert_allclose(np.asarray(g), np.asarray(dg), rtol=1e-5)
 
 
 def test_configure_flag_overrides():

@@ -5,13 +5,17 @@ Reference semantics: ``deepspeed/runtime/zero/stage3.py:1816`` +
 off-accelerator; numerics are unchanged. On the virtual CPU mesh, host and
 device DRAM are physically one, so the residency assertion is the *placement*
 fact XLA acts on for real TPUs: every optimizer-state leaf carries the
-``pinned_host`` memory kind at rest (HBM holds no copy between steps)."""
+backend's host memory kind at rest (``pinned_host`` on TPU; CPU backends
+expose only the ``unpinned_host`` alias — ``host_memory_kind()`` resolves
+it), so HBM holds no copy between steps."""
 
 import numpy as np
 import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.utils import groups
+
+from deepspeed_tpu.runtime.zero.offload import host_memory_kind
 
 from ..simple_model import make_simple_model, random_batches
 
@@ -67,10 +71,10 @@ def test_offload_parity_and_placement(stage, fused):
     eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params0,
                                             config=_cfg(stage, offload=True))
     for leaf in _opt_leaves(eng.opt_state):
-        assert leaf.sharding.memory_kind == "pinned_host", leaf.sharding
+        assert leaf.sharding.memory_kind == host_memory_kind(), leaf.sharding
     _train(eng, batches, fused)
     for leaf in _opt_leaves(eng.opt_state):
-        assert leaf.sharding.memory_kind == "pinned_host", "state must return to host after step"
+        assert leaf.sharding.memory_kind == host_memory_kind(), "state must return to host after step"
 
     for g, w in zip(jax.tree.leaves(jax.device_get(eng.params)),
                     jax.tree.leaves(jax.device_get(ref.params))):
@@ -86,7 +90,7 @@ def test_cpuadam_implies_offload():
                                             config=_cfg(1, offload=False, optimizer="cpuadam"))
     assert eng._offload.enabled
     for leaf in _opt_leaves(eng.opt_state):
-        assert leaf.sharding.memory_kind == "pinned_host"
+        assert leaf.sharding.memory_kind == host_memory_kind()
     _train(eng, random_batches(2, 16, HIDDEN))
 
 
@@ -145,7 +149,7 @@ def test_offload_with_pipeline_engine():
     l1 = float(eng.train_batch(batch=(x, y)))
     assert l1 < l0
     for leaf in _opt_leaves(eng.opt_state):
-        assert leaf.sharding.memory_kind == "pinned_host"
+        assert leaf.sharding.memory_kind == host_memory_kind()
 
 
 def test_offload_checkpoint_roundtrip(tmp_path):
@@ -164,7 +168,7 @@ def test_offload_checkpoint_roundtrip(tmp_path):
                                              config=_cfg(2, offload=True))
     eng2.load_checkpoint(str(tmp_path), tag="t1")
     for leaf in _opt_leaves(eng2.opt_state):
-        assert leaf.sharding.memory_kind == "pinned_host"
+        assert leaf.sharding.memory_kind == host_memory_kind()
     for g, w in zip(jax.tree.leaves(jax.device_get(eng2.opt_state)),
                     jax.tree.leaves(jax.device_get(eng.opt_state))):
         np.testing.assert_allclose(g, w, rtol=0, atol=0)

@@ -22,8 +22,10 @@ def fresh_telemetry():
     telemetry.state.registry = None
 
 
-@pytest.fixture(scope="module")
+@pytest.fixture(scope="package")
 def llama_setup():
+    # package scope: one model init for the whole serving suite, not one per
+    # test file — the params are read-only inputs to every engine build
     cfg = LlamaConfig.tiny(dtype=jnp.float32)
     model = LlamaModel(cfg)
     ids = jnp.zeros((1, 8), jnp.int32)

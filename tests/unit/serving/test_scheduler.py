@@ -420,3 +420,51 @@ def test_idle_heartbeat_runs_empty_batches(make_engine):
             time.sleep(0.01)
     finally:
         sched.stop(drain=False)
+
+
+# ------------------------------------------------------- kill + readiness --
+def test_kill_fails_everything_terminal_and_frees_kv(make_engine, llama_setup):
+    """The abrupt-death disposition (fleet fault tolerance): every queued and
+    in-flight request ends FAILED with the 'replica killed' marker, streams
+    close, KV returns to the pool — what the router and the supervisor key
+    their recovery on."""
+    from deepspeed_tpu.serving.scheduler import KILLED_ERROR_PREFIX
+    cfg, _, _ = llama_setup
+    engine = make_engine()
+    free0 = engine.free_blocks
+    sched = ServingScheduler(engine, ServingConfig())
+    active = sched.submit((np.arange(9) % cfg.vocab_size).tolist(),
+                          max_new_tokens=500)
+    deadline = time.monotonic() + 60
+    while active.first_token_s is None:  # mid-decode, KV held
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+    queued = sched.submit([1, 2, 3], max_new_tokens=5)
+    sched.kill("injected fault")
+    for req in (active, queued):
+        assert req.state is RequestState.FAILED
+        assert req.error.startswith(KILLED_ERROR_PREFIX)
+        assert req.stream.closed
+    assert engine._state_manager.n_tracked_sequences == 0
+    assert engine.free_blocks == free0
+    assert not sched.ready
+    with pytest.raises(SchedulerStopped):
+        sched.submit([1], max_new_tokens=1)
+    sched.kill()            # idempotent
+    sched.stop(drain=False)  # and stop() after kill() is a no-op
+
+
+def test_ready_gates_on_the_loop_ticking(make_engine):
+    engine = make_engine()
+    sched = ServingScheduler(engine, ServingConfig())
+    deadline = time.monotonic() + 30
+    while not sched.ready:
+        assert time.monotonic() < deadline, "scheduler never became ready"
+        time.sleep(0.001)
+    sched.stop(drain=False)
+    assert not sched.ready  # a stopped scheduler is not dispatchable
+    # a manually-driven scheduler (start=False) is ready by construction
+    engine2 = make_engine()
+    manual = ServingScheduler(engine2, ServingConfig(), start=False)
+    assert manual.ready
+    manual.stop(drain=False)

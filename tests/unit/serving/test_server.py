@@ -170,3 +170,30 @@ def test_graceful_drain_finishes_in_flight(make_engine, llama_setup):
     assert engine._state_manager.n_tracked_sequences == 0
     with pytest.raises(OSError):  # listener is really down
         urllib.request.urlopen(url + "/healthz", timeout=1)
+
+
+def test_healthz_reflects_readiness_not_just_liveness(make_engine):
+    """The fleet supervisor's registration gate: /healthz answers 'ok' only
+    once the scheduler loop ticks, and stops saying 'ok' when the scheduler
+    is dead even though the listener still answers."""
+    engine = make_engine()
+    scheduler = ServingScheduler(engine, ServingConfig())
+    srv = ServingServer(scheduler).start()
+    try:
+        deadline = time.monotonic() + 30
+        while True:
+            health = json.loads(urllib.request.urlopen(
+                srv.url + "/healthz", timeout=10).read())
+            if health == {"status": "ok"}:
+                break
+            assert health == {"status": "starting"}
+            assert time.monotonic() < deadline, "never became ready"
+        scheduler.kill("test")
+        health = json.loads(urllib.request.urlopen(
+            srv.url + "/healthz", timeout=10).read())
+        assert health["status"] != "ok"  # a dead scheduler is not dispatchable
+    finally:
+        srv._draining.set()
+        srv._server.shutdown()
+        srv._server.server_close()
+        srv._server = None

@@ -52,6 +52,18 @@ def test_serve_v2_fleet_mode():
     assert "per-replica dispatches:" in r.stdout
 
 
+def test_serve_v2_supervised_mode():
+    """serve_v2.py DSTPU_SERVE_MODE=supervised: a ReplicaSupervisor-owned
+    fleet survives a replica kill — requests succeed before, during (failover
+    to the survivor) and after the automatic restart, and the supervisor
+    table in /v1/fleet/stats records the restart."""
+    r = _run_example("serve_v2.py", extra_env={"DSTPU_SERVE_MODE": "supervised"})
+    assert "[before-kill] done: state=DONE" in r.stdout
+    assert "[during-outage] done: state=DONE" in r.stdout
+    assert "[after-restart] done: state=DONE" in r.stdout
+    assert "restarted sup-mixed-0 automatically (restarts=1)" in r.stdout
+
+
 def test_train_zero3_with_telemetry(tmp_path):
     _run_example("train_zero3.py", extra_env={"DSTPU_TELEMETRY_DIR": str(tmp_path)})
 
