@@ -6,14 +6,15 @@ dispatch behavior and the autoscaler's policy loop. Validated pydantic-style
 like the other config blocks (``serving/config.py``, ``telemetry/config.py``).
 """
 
-from typing import Literal, Optional
+from typing import Literal, Optional, Tuple
 
 from pydantic import Field
 
 from deepspeed_tpu.fleet.breaker import BreakerConfig
 from deepspeed_tpu.fleet.faults import FaultConfig
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
-from deepspeed_tpu.serving.config import DEFAULT_MAX_RESUME_BODY_BYTES
+from deepspeed_tpu.serving.config import (DEFAULT_MAX_RESUME_BODY_BYTES,
+                                          PrefixCacheConfig)
 
 ReplicaRole = Literal["mixed", "prefill", "decode"]
 """``mixed`` serves whole requests; ``prefill``/``decode`` replicas form the
@@ -157,6 +158,18 @@ class FleetConfig(DeepSpeedConfigModel):
     """Upper bound on a client ``POST /v1/resume`` body at the router (the
     base64 KV-handoff payload; fully buffered per handler thread — see
     ``ServingConfig.max_resume_body_bytes``)."""
+
+    prefix_cache: PrefixCacheConfig = PrefixCacheConfig()
+    """Automatic prefix caching applied to fleet-built local replicas
+    (``serving/config.PrefixCacheConfig``). When ``enabled``, this block is
+    authoritative for the roles in ``prefix_cache_roles`` and the cache is
+    forced OFF for the others — the disaggregated shape: the prefill pool
+    reuses shared prompts, the decode pool (which only ever imports handed-off
+    KV) carries no trie. Disabled (default) = replicas keep whatever their own
+    ``ServingConfig.prefix_cache`` says."""
+
+    prefix_cache_roles: Tuple[ReplicaRole, ...] = ("mixed", "prefill")
+    """Replica roles that receive ``prefix_cache`` when it is enabled."""
 
     autoscale: AutoscaleConfig = AutoscaleConfig()
     """Elastic scaling policy (``fleet/policy.py``)."""

@@ -59,9 +59,28 @@ class ReplicaManager:
             raise ValueError("ReplicaManager needs an engine_factory for add_local")
         engine = self._engine_factory()
         replica = LocalReplica(engine, role=role,
-                               serving_config=self._serving_config,
+                               serving_config=self._role_serving_config(role),
                                replica_id=replica_id)
         return self._register(replica)
+
+    def _role_serving_config(self, role: str) -> Optional[ServingConfig]:
+        """The serving config a fleet-built replica of ``role`` runs with.
+        ``FleetConfig.prefix_cache`` (when enabled) is authoritative per role:
+        roles in ``prefix_cache_roles`` get the fleet's cache block, every
+        other role runs with the cache off — prefill-pool replicas reuse
+        shared prompts while decode-pool replicas, which only import
+        handed-off KV, skip the trie entirely."""
+        base = self._serving_config
+        fleet_pc = self._config.prefix_cache
+        if not fleet_pc.enabled:
+            return base
+        if role in self._config.prefix_cache_roles:
+            return (base or ServingConfig()).model_copy(
+                update={"prefix_cache": fleet_pc})
+        if base is not None and base.prefix_cache.enabled:
+            from deepspeed_tpu.serving.config import PrefixCacheConfig
+            return base.model_copy(update={"prefix_cache": PrefixCacheConfig()})
+        return base
 
     def add_upstream(self, url: str, role: str = "mixed",
                      replica_id: Optional[str] = None) -> HttpReplica:

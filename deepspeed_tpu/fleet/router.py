@@ -439,6 +439,10 @@ class RoutedRequest:
                     "uid": final2.get("uid"),
                     "tokens": tokens,
                     "n_tokens": len(tokens),
+                    # the prefix-cache hit happened on the prefill leg: surface
+                    # it like the monolithic path does (loadgen --shared-prefix
+                    # splits hit/miss TTFT on this field)
+                    "cached_tokens": final1.get("cached_tokens", 0),
                     "state": final2.get("state"),
                     "finish_reason": final2.get("finish_reason"),
                     "error": final2.get("error"),

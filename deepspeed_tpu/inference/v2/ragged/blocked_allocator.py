@@ -2,6 +2,15 @@
 
 Reference: ``deepspeed/inference/v2/ragged/blocked_allocator.py`` (BlockedAllocator:11
 — a free-list over torch tensors). Pure host logic; numpy-backed here.
+
+Blocks are **reference counted** so the prefix cache (``prefix_cache.py``) can
+share one physical block between the radix trie and any number of live
+sequences: ``allocate`` hands out blocks at refcount 1, ``incref`` adds a
+sharer, and ``free`` is a *decref* — the block returns to the free list only
+when its last reference drops. Unshared blocks behave exactly as before
+(allocate → refcount 1 → one ``free`` releases), so non-caching callers never
+see the mechanism; double-frees, which the old allocator silently corrupted
+the free list with, now raise.
 """
 
 import numpy as np
@@ -17,6 +26,7 @@ class BlockedAllocator:
         self._next = np.arange(1, num_blocks + 1, dtype=np.int64)
         self._head = 0
         self._free_blocks = num_blocks
+        self._refs = np.zeros(num_blocks, dtype=np.int64)
 
     @property
     def free_blocks(self) -> int:
@@ -28,16 +38,47 @@ class BlockedAllocator:
         out = np.empty(num_blocks, dtype=np.int64)
         for i in range(num_blocks):
             out[i] = self._head
+            self._refs[self._head] = 1
             self._head = int(self._next[self._head])
         self._free_blocks -= num_blocks
         return out
 
     def free(self, blocks) -> None:
+        """Drop one reference per listed block; a block whose count reaches
+        zero returns to the free list. Freeing an already-free block raises
+        (double-free would otherwise cycle the free list and hand the same
+        block to two sequences)."""
         blocks = np.atleast_1d(np.asarray(blocks, dtype=np.int64))
         for b in blocks:
             b = int(b)
-            if b < 0 or b >= self._num_blocks:
-                raise ValueError(f"Block {b} is out of range [0, {self._num_blocks})")
-            self._next[b] = self._head
-            self._head = b
-        self._free_blocks += len(blocks)
+            self._check_range(b)
+            if self._refs[b] <= 0:
+                raise ValueError(f"Block {b} freed more times than it was referenced "
+                                 f"(double free)")
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                self._next[b] = self._head
+                self._head = b
+                self._free_blocks += 1
+
+    def incref(self, blocks) -> None:
+        """Add one reference per listed block (the prefix-cache share path).
+        Only live blocks can gain sharers — increffing a free block would
+        resurrect memory another allocation is about to claim."""
+        blocks = np.atleast_1d(np.asarray(blocks, dtype=np.int64))
+        for b in blocks:
+            b = int(b)
+            self._check_range(b)
+            if self._refs[b] <= 0:
+                raise ValueError(f"Block {b} is not allocated; cannot incref")
+        for b in blocks:
+            self._refs[int(b)] += 1
+
+    def ref_count(self, block: int) -> int:
+        block = int(block)
+        self._check_range(block)
+        return int(self._refs[block])
+
+    def _check_range(self, b: int) -> None:
+        if b < 0 or b >= self._num_blocks:
+            raise ValueError(f"Block {b} is out of range [0, {self._num_blocks})")

@@ -62,6 +62,7 @@ class BlockedKVCache:
         self._spill_tag = f"{os.getpid()}_{uuid.uuid4().hex[:8]}"
         self._aio = None
         self._restore_fn = None
+        self._fork_fn = None
 
     @property
     def free_blocks(self) -> int:
@@ -87,6 +88,40 @@ class BlockedKVCache:
 
     def free(self, blocks):
         self._allocator.free(blocks)
+
+    def incref(self, blocks) -> None:
+        """Add one reference per block (prefix-cache sharing; see
+        ``BlockedAllocator.incref``). ``free`` is the matching decref."""
+        self._allocator.incref(blocks)
+
+    def ref_count(self, block: int) -> int:
+        return self._allocator.ref_count(block)
+
+    def fork_blocks(self, src_blocks) -> np.ndarray:
+        """Copy-on-write fork: allocate fresh blocks and device-copy
+        ``src_blocks``' contents (every layer, K and V) into them, returning
+        the new ids. The sources are untouched — the caller maps the copies
+        into a sequence that is about to *write* where the sources are shared
+        read-only (the prefix cache's first-divergent-block fork). A failed
+        allocation consumes nothing."""
+        import jax
+        import jax.numpy as jnp
+
+        src_blocks = np.atleast_1d(np.asarray(src_blocks)).astype(np.int64)
+        new_blocks = self._allocator.allocate(src_blocks.size)
+        if self._fork_fn is None:
+            self._fork_fn = jax.jit(
+                lambda cache, src, dst: cache.at[:, :, dst].set(cache[:, :, src]),
+                donate_argnums=(0, ))
+        try:
+            new_cache = self._fork_fn(self._cache, jnp.asarray(src_blocks),
+                                      jnp.asarray(new_blocks))
+            jax.block_until_ready(new_cache)
+        except Exception:
+            self._allocator.free(new_blocks)
+            raise
+        self._cache = new_cache
+        return new_blocks
 
     def gather_blocks(self, blocks) -> np.ndarray:
         """Device→host copy of ``blocks``' contents (every layer, K and V)

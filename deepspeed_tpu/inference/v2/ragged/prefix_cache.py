@@ -1,0 +1,271 @@
+"""Automatic prefix cache: a radix/trie index over the paged KV cache.
+
+Role model: vLLM's automatic prefix caching / SGLang's RadixAttention — the
+mechanism that makes the shared-system-prompt workload (N requests over one
+long common prefix) pay prefill once instead of N times.
+
+Design
+------
+The unit of sharing is one **full KV block** (``block_size`` tokens). Each
+trie node represents one block's worth of tokens and is keyed by a *chained*
+content hash: ``digest(node) = sha1(digest(parent) + token_bytes(block))``, so
+a node's identity pins the entire token prefix up to and including its block —
+two prompts share a node iff they share every token up to that block boundary.
+
+Ownership is reference counts on the :class:`~.blocked_allocator.BlockedAllocator`:
+
+- the **trie holds one reference** on every block it indexes;
+- every live sequence holds one reference on each block in its table (its
+  private blocks arrive at refcount 1 from ``allocate``; shared prefix blocks
+  are increffed by :meth:`acquire`);
+- a sequence flush *decrefs* (``kv_cache.free``), so publishing a finished
+  sequence's blocks and then flushing it leaves exactly the trie's reference;
+- evicting a trie leaf decrefs once — the device block is reclaimed only when
+  no live sequence still maps it.
+
+Writes never touch shared blocks: a hit is block-aligned, so the suffix's KV
+scatters land in freshly-allocated blocks — except a **fully-cached prompt**,
+whose re-fed final token would write into the last shared block; the scheduler
+forks that block copy-on-write (``kv_cache.fork_blocks``) before mapping it.
+
+Eviction is LRU over *evictable leaves*: leaf nodes whose block has refcount 1
+(the trie's own — no live sharer; freeing a shared leaf reclaims nothing).
+Interior nodes become evictable once their children go.
+
+Thread model: all mutation happens on the serving scheduler's thread (the
+engine-owning thread); the stats snapshot reads scalar counters and is safe
+from any thread.
+"""
+
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class _Node:
+    __slots__ = ("digest", "block", "parent", "children", "last_touch")
+
+    def __init__(self, digest: bytes, block: int, parent: Optional["_Node"]):
+        self.digest = digest
+        self.block = block          # device block id this node owns a ref on
+        self.parent = parent
+        self.children: Dict[bytes, "_Node"] = {}
+        self.last_touch = 0
+
+
+class PrefixHit:
+    """A successful :meth:`PrefixCache.acquire`: ``blocks`` are device block
+    ids (one reference each now held on the caller's behalf) covering
+    ``tokens`` leading prompt tokens."""
+
+    __slots__ = ("blocks", "tokens")
+
+    def __init__(self, blocks: List[int], tokens: int):
+        self.blocks = blocks
+        self.tokens = tokens
+
+
+class PrefixCache:
+    """Radix index + refcount choreography over one :class:`BlockedKVCache`.
+
+    ``max_blocks`` caps how many device blocks the trie may pin (None = the
+    whole pool — under KV pressure the scheduler evicts trie leaves before
+    touching live sequences, so an uncapped trie is backpressured naturally).
+    ``min_prefix_blocks`` is the smallest match worth applying: shorter hits
+    return empty (the bookkeeping would cost more than the saved prefill).
+    """
+
+    def __init__(self, kv_cache, max_blocks: Optional[int] = None,
+                 min_prefix_blocks: int = 1):
+        self._kv = kv_cache
+        self._block_size = kv_cache.block_size
+        self._max_blocks = max_blocks
+        self._min_prefix_blocks = max(1, int(min_prefix_blocks))
+        self._root = _Node(b"", -1, None)
+        self._by_digest: Dict[bytes, _Node] = {}
+        self._clock = 0  # monotonic LRU counter (no wall clock: deterministic)
+        # stats (read lock-free from stats threads; written on scheduler thread)
+        self.lookups = 0
+        self.hits = 0
+        self.hit_blocks = 0
+        self.tokens_served = 0   # prompt tokens served from cache
+        self.evictions = 0       # trie leaves evicted (blocks unpinned)
+        self.published_blocks = 0
+
+    # ------------------------------------------------------------- hashing --
+    def chain(self, tokens, base: Optional[List[bytes]] = None) -> List[bytes]:
+        """Chained digests of every *full* block of ``tokens``. ``base`` seeds
+        the chain with digests already computed for the leading blocks (the
+        scheduler hashes each prompt once at admission and extends over the
+        generated tail at publish time, instead of re-hashing the whole
+        history on the hot thread)."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self._block_size
+        n_full = tokens.size // bs
+        out = list(base[:n_full]) if base else []
+        digest = out[-1] if out else b""
+        for i in range(len(out), n_full):
+            h = hashlib.sha1()
+            h.update(digest)
+            h.update(np.ascontiguousarray(tokens[i * bs:(i + 1) * bs],
+                                          dtype=np.int32).tobytes())
+            digest = h.digest()
+            out.append(digest)
+        return out
+
+    # -------------------------------------------------------------- lookup --
+    def acquire(self, prompt, digests: Optional[List[bytes]] = None) -> PrefixHit:
+        """Longest cached prefix of ``prompt``, with one reference taken on
+        every matched block (release with :meth:`release`, or hand them to a
+        sequence whose flush decrefs). Matches shorter than
+        ``min_prefix_blocks`` blocks come back empty. ``digests`` is the
+        prompt's precomputed :meth:`chain`."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.lookups += 1
+        node = self._root
+        matched: List[_Node] = []
+        for digest in (digests if digests is not None else self.chain(prompt)):
+            child = node.children.get(digest)
+            if child is None:
+                break
+            matched.append(child)
+            node = child
+        if len(matched) < self._min_prefix_blocks:
+            return PrefixHit([], 0)
+        self._clock += 1
+        for n in matched:
+            n.last_touch = self._clock  # whole path stays warm
+        blocks = [n.block for n in matched]
+        self._kv.incref(blocks)
+        return PrefixHit(blocks, len(blocks) * self._block_size)
+
+    def record_hit(self, n_blocks: int, tokens: int) -> None:
+        """Account a hit the scheduler actually *applied* (a degraded or
+        failed application releases its blocks and records nothing, so
+        ``stats()`` agrees exactly with the scheduler's own counters)."""
+        self.hits += 1
+        self.hit_blocks += n_blocks
+        self.tokens_served += tokens
+
+    def release(self, blocks) -> None:
+        """Return references taken by :meth:`acquire` (decref)."""
+        if len(blocks):
+            self._kv.free(blocks)
+
+    # ------------------------------------------------------------- publish --
+    def publish(self, tokens, block_ids, committed_tokens: int,
+                digests: Optional[List[bytes]] = None) -> int:
+        """Index a sequence's full blocks: ``tokens`` is the token history,
+        ``block_ids`` its block table, ``committed_tokens`` how many leading
+        positions hold KV computed from exactly those tokens (the scheduler
+        caps it below ``seen_tokens`` when chunked decode committed discarded
+        over-run tokens); ``digests`` is a precomputed :meth:`chain` prefix.
+        Each *newly indexed* block gains one trie reference; blocks whose
+        prefix is already indexed are left to the sequence's flush. Returns
+        the number of blocks newly pinned."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        block_ids = np.atleast_1d(np.asarray(block_ids)).astype(np.int64)
+        bs = self._block_size
+        n_full = min(int(committed_tokens) // bs, int(block_ids.size),
+                     tokens.size // bs)
+        if n_full <= 0:
+            return 0
+        node = self._root
+        added = 0
+        path = {id(self._root)}  # the walk's spine must not be evicted under it
+        self._clock += 1
+        for i, digest in enumerate(self.chain(tokens[:n_full * bs], base=digests)):
+            child = node.children.get(digest)
+            if child is None:
+                if not self._make_room(1, protect=path):
+                    break  # cap reached and nothing evictable: stop indexing
+                block = int(block_ids[i])
+                self._kv.incref([block])
+                child = _Node(digest, block, node)
+                node.children[digest] = child
+                self._by_digest[digest] = child
+                added += 1
+            child.last_touch = self._clock
+            node = child
+            path.add(id(node))
+        self.published_blocks += added
+        return added
+
+    # ------------------------------------------------------------- evict --
+    @property
+    def n_blocks(self) -> int:
+        """Device blocks currently pinned by the trie."""
+        return len(self._by_digest)
+
+    def _evictable_leaves(self, protect) -> List[_Node]:
+        return [n for n in self._by_digest.values()
+                if not n.children and id(n) not in protect
+                and self._kv.ref_count(n.block) == 1]
+
+    def evict(self, n_blocks: int = 1, protect=frozenset()) -> int:
+        """Unpin up to ``n_blocks`` device blocks, LRU-first, restricted to
+        leaves no live sequence shares (freeing a shared leaf reclaims no
+        memory — those blocks return when their sequences flush) and outside
+        ``protect`` (node ids a publish walk is standing on). Evicting a leaf
+        can expose its parent; the scan repeats until satisfied or dry.
+        Returns how many blocks were actually freed."""
+        freed = 0
+        while freed < n_blocks:
+            leaves = self._evictable_leaves(protect)
+            if not leaves:
+                break
+            if n_blocks - freed == 1:
+                # the common KV-pressure shape (evict(1) per needed block):
+                # one O(n) min beats a full sort
+                self._remove(min(leaves, key=lambda n: n.last_touch))
+                freed += 1
+                break
+            leaves.sort(key=lambda n: n.last_touch)
+            for leaf in leaves:
+                self._remove(leaf)
+                freed += 1
+                if freed >= n_blocks:
+                    break
+        self.evictions += freed
+        return freed
+
+    def _remove(self, node: _Node) -> None:
+        assert not node.children
+        del node.parent.children[node.digest]
+        del self._by_digest[node.digest]
+        self._kv.free([node.block])
+
+    def _make_room(self, n: int, protect=frozenset()) -> bool:
+        """Ensure the trie can pin ``n`` more blocks under ``max_blocks``."""
+        if self._max_blocks is None:
+            return True
+        over = self.n_blocks + n - self._max_blocks
+        if over <= 0:
+            return True
+        return self.evict(over, protect=protect) >= over
+
+    def clear(self) -> None:
+        """Release every trie reference (scheduler shutdown): blocks shared
+        with still-live sequences survive until those sequences flush."""
+        for node in list(self._by_digest.values()):
+            node.children.clear()
+        for node in list(self._by_digest.values()):
+            del self._by_digest[node.digest]
+            self._kv.free([node.block])
+        self._root.children.clear()
+
+    # --------------------------------------------------------------- stats --
+    def stats(self) -> dict:
+        lookups = self.lookups
+        return {
+            "lookups": lookups,
+            "hits": self.hits,
+            "hit_rate": (self.hits / lookups) if lookups else 0.0,
+            "hit_blocks": self.hit_blocks,
+            "tokens_served": self.tokens_served,
+            "trie_blocks": self.n_blocks,
+            "evictions": self.evictions,
+            "published_blocks": self.published_blocks,
+            "max_blocks": self._max_blocks,
+        }

@@ -6,6 +6,8 @@ uid → DSSequenceDescriptor tracking over a BlockedKVCache).
 
 from typing import Dict, Optional
 
+import numpy as np
+
 from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache
 from deepspeed_tpu.inference.v2.ragged.manager_configs import DSStateManagerConfig, KVCacheConfig
 from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import DSSequenceDescriptor
@@ -41,6 +43,30 @@ class DSStateManager:
         max_blocks = (self._config.max_context + self._kv_config.block_size - 1) // self._kv_config.block_size
         seq = DSSequenceDescriptor(uid, max_blocks_per_seq=max_blocks)
         self._seqs[uid] = seq
+        return seq
+
+    def create_cached_sequence(self, uid: int, blocks, seen_tokens: int) -> DSSequenceDescriptor:
+        """Create a sequence whose block table arrives **pre-populated** — the
+        prefix-cache hit path: ``blocks`` already hold the KV for the first
+        ``seen_tokens`` committed tokens (shared, read-only; the caller holds
+        one reference per block on this sequence's behalf, which
+        ``flush_sequence`` returns). The next forward continues at position
+        ``seen_tokens`` exactly like a restored or imported sequence."""
+        blocks = np.atleast_1d(np.asarray(blocks)).astype(np.int64)
+        seen_tokens = int(seen_tokens)
+        if seen_tokens < 0 or seen_tokens > blocks.size * self._kv_config.block_size:
+            raise ValueError(
+                f"create_cached_sequence: seen_tokens={seen_tokens} does not fit "
+                f"{blocks.size} blocks of {self._kv_config.block_size} tokens")
+        seq = self._create_sequence(uid)
+        try:
+            if blocks.size:
+                seq.extend_kv_cache(blocks)
+            seq.pre_forward(seen_tokens)
+            seq.post_forward()
+        except Exception:
+            del self._seqs[uid]  # the caller still owns the block references
+            raise
         return seq
 
     def flush_sequence(self, uid: int) -> None:

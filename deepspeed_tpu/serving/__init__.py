@@ -17,7 +17,7 @@ Usage::
     server.stop()                               # graceful drain
 """
 
-from deepspeed_tpu.serving.config import ServingConfig
+from deepspeed_tpu.serving.config import PrefixCacheConfig, ServingConfig
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import (Request, RequestState, TERMINAL_STATES,
                                            TokenStream)
@@ -26,6 +26,7 @@ from deepspeed_tpu.serving.scheduler import (QueueFullError, SchedulerStopped,
 from deepspeed_tpu.serving.server import ServingServer
 
 __all__ = [
+    "PrefixCacheConfig",
     "ServingConfig", "ServingMetrics", "Request", "RequestState", "TERMINAL_STATES",
     "TokenStream", "ServingScheduler", "QueueFullError", "SchedulerStopped",
     "ServingServer",

@@ -18,6 +18,26 @@ DEFAULT_MAX_RESUME_BODY_BYTES = 2 << 30
 and a replica can never disagree on whether the same payload is admissible."""
 
 
+class PrefixCacheConfig(DeepSpeedConfigModel):
+    """Automatic prefix caching (radix-tree KV reuse with copy-on-write block
+    sharing — ``inference/v2/ragged/prefix_cache.py``). Off by default: the
+    trie pins finished sequences' prefix blocks, so a cache-enabled scheduler
+    intentionally does NOT return the KV pool to empty between requests."""
+
+    enabled: bool = False
+    """Look up every admitted prompt's longest cached prefix and publish
+    completed sequences' full blocks back to the trie."""
+
+    max_blocks: Optional[int] = Field(None, ge=1)
+    """Cap on device blocks the trie may pin; None = bounded only by the pool
+    (the KV-pressure path evicts unreferenced trie leaves LRU-first before
+    touching live sequences)."""
+
+    min_prefix_blocks: int = Field(1, ge=1)
+    """Smallest cached-prefix match (in blocks) worth applying to a request;
+    shorter matches prefill cold."""
+
+
 class ServingConfig(DeepSpeedConfigModel):
     """Knobs for the request scheduler + HTTP front-end."""
 
@@ -72,6 +92,10 @@ class ServingConfig(DeepSpeedConfigModel):
     port: int = Field(0, ge=0, le=65535)
     """Bind address for ``ServingServer``; port 0 = ephemeral (the bound
     address is on ``server.address`` after ``start()``)."""
+
+    prefix_cache: PrefixCacheConfig = PrefixCacheConfig()
+    """Automatic prefix caching over the paged KV cache (radix-tree reuse +
+    copy-on-write sharing); see :class:`PrefixCacheConfig`."""
 
     max_resume_body_bytes: int = Field(DEFAULT_MAX_RESUME_BODY_BYTES, gt=0)
     """Upper bound on a ``POST /v1/resume`` body (the base64 KV-handoff

@@ -43,6 +43,23 @@ class ServingMetrics:
             "serving_failures_total", "Requests that FAILED")
         self.evictions = registry.counter(
             "serving_kv_evictions_total", "Idle sequences offloaded under KV pressure")
+        # automatic prefix cache (inference/v2/ragged/prefix_cache.py)
+        self.prefix_lookups = registry.counter(
+            "serving_prefix_lookups_total", "Admitted prompts looked up in the prefix trie")
+        self.prefix_hits = registry.counter(
+            "serving_prefix_hits_total", "Admitted prompts served a cached prefix")
+        self.prefix_lookup_depth = registry.histogram(
+            "serving_prefix_lookup_depth_blocks",
+            "Cached-prefix depth (KV blocks) applied per lookup",
+            buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256))
+        self.prefix_tokens_saved = registry.counter(
+            "serving_prefix_tokens_saved_total",
+            "Prompt tokens served from cached KV instead of prefilled")
+        self.prefix_trie_blocks = registry.gauge(
+            "serving_prefix_trie_blocks", "Device KV blocks pinned by the prefix trie")
+        self.prefix_evictions = registry.counter(
+            "serving_prefix_evictions_total",
+            "Prefix-trie leaves evicted (LRU) under KV pressure or the trie cap")
 
     @classmethod
     def maybe_create(cls) -> Optional["ServingMetrics"]:

@@ -133,6 +133,13 @@ class Request:
         self._resume_header: Optional[dict] = None
         self._resume_kv = None  # parsed KV view into _resume_payload
         self.tokens: List[int] = []
+        # prompt tokens served from the prefix cache at admission (0 = cold);
+        # surfaced in /v1/stats rows and the final response doc so clients and
+        # the loadgen can split latency by hit/miss
+        self.cached_tokens = 0
+        # the prompt's chained block digests, hashed once at admission and
+        # extended (never recomputed) at each publish point
+        self._prefix_digests = None
         self.stream = TokenStream()
         self.error: Optional[str] = None
         self.finish_reason: Optional[str] = None  # "eos" | "length" | "context"

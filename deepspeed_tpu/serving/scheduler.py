@@ -99,7 +99,8 @@ class ServingScheduler:
         self._uids = itertools.count()
         self._counters = {k: 0 for k in
                           ("submitted", "rejected", "completed", "cancelled",
-                           "timed_out", "failed", "evictions", "batches", "heartbeats")}
+                           "timed_out", "failed", "evictions", "batches", "heartbeats",
+                           "prefix_hits", "prefix_tokens_saved", "prefix_evictions")}
         self._stopping = False   # no new submits
         self._shutdown = False   # thread exit
         self._stopped = False
@@ -112,6 +113,18 @@ class ServingScheduler:
         # pool capacity for permanent-infeasibility checks (a prompt needing
         # more KV blocks than the whole pool can never run)
         self._capacity_blocks = engine._state_manager.kv_cache.num_blocks
+
+        # automatic prefix caching: radix-tree KV reuse with copy-on-write
+        # block sharing (inference/v2/ragged/prefix_cache.py). All trie
+        # mutation happens on the scheduler thread — the same thread that owns
+        # every other engine touch.
+        self._prefix_cache = None
+        if self._config.prefix_cache.enabled:
+            from deepspeed_tpu.inference.v2.ragged.prefix_cache import PrefixCache
+            self._prefix_cache = PrefixCache(
+                engine._state_manager.kv_cache,
+                max_blocks=self._config.prefix_cache.max_blocks,
+                min_prefix_blocks=self._config.prefix_cache.min_prefix_blocks)
 
         engine._serving_scheduler = self
         # armed last: flight_state() must never observe a half-built
@@ -321,6 +334,14 @@ class ServingScheduler:
                     self._finalize(req, RequestState.FAILED, error=infeasible)
                     continue
                 req.uid = next(self._uids)
+                if req._resume_payload is None and self._prefix_cache is not None:
+                    try:
+                        self._apply_prefix_hit(req)
+                    except Exception:  # pragma: no cover - defensive: a failed
+                        # hit application degrades to a cold prefill, never a
+                        # failed request
+                        logger.exception(f"serving: prefix-cache hit application "
+                                         f"failed for uid {req.uid}; prefilling cold")
                 if req._resume_payload is not None:
                     outcome = self._import_resume(req)
                     if outcome is None:
@@ -383,6 +404,100 @@ class ServingScheduler:
             req._resume_kv = None
             req._fed = req.prompt.size  # the whole history is already prefilled
             return "ok"
+
+    # ---------------------------------------------------------- prefix cache --
+    def _apply_prefix_hit(self, req: Request) -> None:
+        """Map the longest cached prefix of ``req.prompt`` into a
+        pre-populated sequence so only the suffix prefills (scheduler thread).
+
+        A *fully*-cached prompt still re-feeds its final token — the engine
+        needs one forward to produce logits — and that token's KV write lands
+        in the last matched block, which is shared read-only; that block is
+        forked copy-on-write first. When no block is free for the fork (and
+        nothing is evictable) the hit degrades by one block instead, keeping
+        the write in a fresh suffix block."""
+        pc = self._prefix_cache
+        sm = self._engine._state_manager
+        # hash the prompt exactly once per request: the same chain serves the
+        # lookup here and both publish points (prefill completion + finalize)
+        req._prefix_digests = pc.chain(req.prompt)
+        hit = pc.acquire(req.prompt, digests=req._prefix_digests)
+        if self._metrics:
+            self._metrics.prefix_lookups.inc()
+            self._metrics.prefix_lookup_depth.observe(len(hit.blocks))
+        if not hit.blocks:
+            return
+        blocks = list(hit.blocks)
+        seen = hit.tokens
+        try:
+            if seen >= req.prompt.size:
+                forked = self._fork_for_cow(blocks[-1], req.uid)
+                if forked is None:
+                    pc.release([blocks[-1]])
+                    blocks.pop()  # degrade: recompute the last cached block
+                    if len(blocks) < self._config.prefix_cache.min_prefix_blocks:
+                        pc.release(blocks)  # below the configured hit floor
+                        return
+                    seen = len(blocks) * sm.kv_block_size
+                else:
+                    pc.release([blocks[-1]])
+                    blocks[-1] = int(forked)
+                    seen = req.prompt.size - 1  # one last-token step, then DECODE
+            sm.create_cached_sequence(req.uid, blocks, seen)
+        except Exception:
+            # drop every reference this hit still holds (a successful fork
+            # swapped the trie ref for a private refcount-1 copy, which the
+            # same release frees) — a failed application must leak nothing
+            pc.release(blocks)
+            raise
+        req._fed = seen
+        req.cached_tokens = seen
+        pc.record_hit(len(blocks), seen)  # applied for real: now it counts
+        self._counters["prefix_hits"] += 1
+        self._counters["prefix_tokens_saved"] += seen
+        if self._metrics:
+            self._metrics.prefix_hits.inc()
+            self._metrics.prefix_tokens_saved.inc(seen)
+            self._metrics.prefix_trie_blocks.set(pc.n_blocks)
+
+    def _fork_for_cow(self, src_block: int, uid: int) -> Optional[int]:
+        """Copy-on-write fork of one shared block, evicting (trie leaves
+        first, then cold idle sequences) under KV pressure. None = the pool
+        cannot yield a block right now."""
+        kv = self._engine._state_manager.kv_cache
+        while True:
+            if kv.free_blocks >= 1:
+                return int(kv.fork_blocks([src_block])[0])
+            if not self._evict_one({uid}):
+                return None
+
+    def _publish(self, req: Request, seq, tokens, committed: int) -> None:
+        """Index ``tokens``' full KV blocks in the prefix trie. Called at two
+        points: **prefill completion** (the prompt's blocks — so concurrent
+        requests over a shared prefix hit as soon as the first one's prefill
+        lands, not only after it finishes generating) and **finalize** on DONE
+        (prompt + generated history — multi-turn reuse). Publishing is
+        idempotent per content: already-indexed prefixes just refresh LRU.
+        The admission-time digest chain is extended, not recomputed."""
+        try:
+            req._prefix_digests = self._prefix_cache.chain(
+                tokens, base=req._prefix_digests)
+            self._prefix_cache.publish(tokens, seq.kv_blocks, committed,
+                                       digests=req._prefix_digests)
+        except Exception:  # pragma: no cover - defensive: publishing is an
+            # optimization; a failure must not lose the request's result
+            logger.exception(f"serving: prefix-cache publish failed for uid {req.uid}")
+        if self._metrics:
+            self._metrics.prefix_trie_blocks.set(self._prefix_cache.n_blocks)
+
+    def _publish_finished(self, req: Request, seq) -> None:
+        """The finalize-time publish (full history, instead of letting flush
+        free the blocks). Valid positions are those whose KV was computed from
+        a *kept* token: chunked decode commits discarded over-run tokens past
+        the history, so the committed count is capped at the kept length."""
+        history = np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)]) if req.tokens else req.prompt
+        self._publish(req, seq, history, min(seq.seen_tokens, history.size))
 
     def _permanently_infeasible(self, req: Request) -> Optional[str]:
         """A reason this request can NEVER be scheduled, or None. Failing at
@@ -485,9 +600,19 @@ class ServingScheduler:
         return plan
 
     def _evict_one(self, exclude_uids) -> bool:
-        """Offload the coldest idle engine-resident sequence (not in the batch
-        being built) to free device KV blocks; it restores transparently when
-        next touched. Returns False when nothing is evictable."""
+        """Free device KV blocks under pressure: evict an unreferenced prefix-
+        trie leaf (LRU) first — reclaiming cached-but-idle state costs nothing
+        live — then fall back to offloading the coldest idle engine-resident
+        sequence (not in the batch being built), which restores transparently
+        when next touched. Returns False when nothing is evictable."""
+        if self._prefix_cache is not None:
+            freed = self._prefix_cache.evict(1)
+            if freed:
+                self._counters["prefix_evictions"] += freed
+                if self._metrics:
+                    self._metrics.prefix_evictions.inc(freed)
+                    self._metrics.prefix_trie_blocks.set(self._prefix_cache.n_blocks)
+                return True
         engine = self._engine
         candidates = []
         for req in self._active.values():
@@ -592,6 +717,13 @@ class ServingScheduler:
                 if req._fed < req.prompt.size:
                     continue  # mid-prefill logits are meaningless
                 req._set_state(RequestState.DECODE)
+                if self._prefix_cache is not None:
+                    # publish the prompt's blocks NOW: its KV is fully
+                    # committed, and peers sharing the prefix are likely
+                    # already queued behind it (the burst shape)
+                    seq = engine._state_manager.get_sequence(req.uid)
+                    if seq is not None:
+                        self._publish(req, seq, req.prompt, seq.seen_tokens)
             nxt = self._sample(req, logits[i])
             self._push_token(req, nxt)
             if not req.finished:
@@ -675,7 +807,8 @@ class ServingScheduler:
         req.error = error
         if req.uid is not None:
             self._active.pop(req.uid, None)
-            if self._engine._state_manager.get_sequence(req.uid) is not None:
+            seq = self._engine._state_manager.get_sequence(req.uid)
+            if seq is not None:
                 if (state is RequestState.DONE and req.handoff_requested
                         and req.finish_reason == "length" and req.tokens):
                     # export BEFORE flushing: the payload reads the sequence's
@@ -688,6 +821,14 @@ class ServingScheduler:
                         # export degrades to a non-continuable response
                         logger.exception(f"serving: handoff export failed for "
                                          f"uid {req.uid}")
+                if (self._prefix_cache is not None and state is RequestState.DONE
+                        and not self._engine.is_offloaded(req.uid)):
+                    # publish BEFORE flushing: the trie takes references on the
+                    # full blocks, so flush's decref leaves them cached instead
+                    # of freed (an offloaded sequence's table is stale — its
+                    # device blocks were already returned — so it cannot
+                    # publish)
+                    self._publish_finished(req, seq)
                 self._engine.flush(req.uid)  # returns KV blocks (incl. offloaded)
         req._set_state(state)
         self._counters[self._FINAL_COUNTER[state]] += 1
@@ -703,6 +844,7 @@ class ServingScheduler:
                          args={"uid": req.uid, "state": state.name,
                                "finish_reason": req.finish_reason,
                                "prompt_tokens": int(req.prompt.size),
+                               "cached_tokens": req.cached_tokens,
                                "generated": len(req.tokens),
                                "resumed": req._resume_header is not None})
         if self._metrics:
@@ -785,6 +927,10 @@ class ServingScheduler:
             self._finalize(self._queue.popleft(), RequestState.FAILED, error=error)
         self._shutdown = True
         self._killed = True
+        if self._prefix_cache is not None:
+            self._prefix_cache.clear()  # unpin the trie's blocks
+            if self._metrics:
+                self._metrics.prefix_trie_blocks.set(0)
         if getattr(self._engine, "_serving_scheduler", None) is self:
             self._engine._serving_scheduler = None
         self._attach_flight(None)
@@ -823,6 +969,13 @@ class ServingScheduler:
             self._finalize(req, RequestState.CANCELLED)
         while self._queue:
             self._finalize(self._queue.popleft(), RequestState.CANCELLED)
+        if self._prefix_cache is not None:
+            # unpin the trie's blocks: a stopped scheduler leaves the engine's
+            # KV pool exactly as it found it (shared blocks survive until any
+            # still-tracked sequence flushes)
+            self._prefix_cache.clear()
+            if self._metrics:
+                self._metrics.prefix_trie_blocks.set(0)
         if getattr(self._engine, "_serving_scheduler", None) is self:
             self._engine._serving_scheduler = None
         self._attach_flight(None)
@@ -864,6 +1017,7 @@ class ServingScheduler:
             "uid": req.uid,
             "state": req.state.name,
             "prompt_tokens": int(req.prompt.size),
+            "cached_tokens": req.cached_tokens,
             "generated": len(req.tokens),
             "age_s": now - req.arrival_s,
             "ttft_s": req.ttft_s,
@@ -904,6 +1058,8 @@ class ServingScheduler:
                 "capacity_blocks": self._capacity_blocks,
                 "tracked_sequences": self._engine._state_manager.n_tracked_sequences,
             },
+            "prefix_cache": (self._prefix_cache.stats()
+                             if self._prefix_cache is not None else None),
             "draining": self._stopping,
             "uptime_s": time.monotonic() - self._start_s,
         }
@@ -923,6 +1079,7 @@ class ServingScheduler:
             seq = engine._state_manager.get_sequence(req.uid)
             row.update(
                 fed_tokens=req._fed,
+                cached_tokens=req.cached_tokens,
                 deferred_ticks=req._deferred,
                 deadline_in_s=(req.deadline - now) if req.deadline is not None else None,
                 kv_blocks=seq.cur_allocated_blocks if seq is not None else 0,

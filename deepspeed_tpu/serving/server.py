@@ -106,6 +106,7 @@ def _request_doc(req: Request, raw_handoff: bool = False) -> dict:
         "uid": req.uid,
         "tokens": list(req.tokens),
         "n_tokens": len(req.tokens),
+        "cached_tokens": req.cached_tokens,
         "state": req.state.name,
         "finish_reason": req.finish_reason,
         "error": req.error,

@@ -46,6 +46,14 @@ METRIC_FAMILIES = {
     "serving_cancellations_total": "requests cancelled mid-flight",
     "serving_failures_total": "requests that FAILED",
     "serving_kv_evictions_total": "idle sequences offloaded under KV pressure",
+    # automatic prefix cache (serving/metrics.py over
+    # inference/v2/ragged/prefix_cache.py)
+    "serving_prefix_lookups_total": "admitted prompts looked up in the prefix trie",
+    "serving_prefix_hits_total": "admitted prompts served a cached prefix",
+    "serving_prefix_lookup_depth_blocks": "cached-prefix depth (KV blocks) applied per lookup",
+    "serving_prefix_tokens_saved_total": "prompt tokens served from cached KV instead of prefilled",
+    "serving_prefix_trie_blocks": "device KV blocks pinned by the prefix trie",
+    "serving_prefix_evictions_total": "prefix-trie leaves evicted (LRU) under KV pressure or the trie cap",
     # compile watch (telemetry/compile_watch.py)
     "compile_cache_misses_total": "XLA backend compiles (jit cache misses), by site",
     "compile_seconds_total": "cumulative XLA compile wall seconds, by site",

@@ -1,0 +1,236 @@
+"""Prefix-cache mechanism units: reference-counted BlockedAllocator, the
+radix/trie index (``ragged/prefix_cache.py``), and copy-on-write block forks
+(``kv_cache.fork_blocks``) — the layers below the serving integration
+(tests/unit/serving/test_prefix_cache.py)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache
+from deepspeed_tpu.inference.v2.ragged.manager_configs import (AllocationMode,
+                                                               KVCacheConfig,
+                                                               MemoryConfig)
+from deepspeed_tpu.inference.v2.ragged.prefix_cache import PrefixCache
+
+BS = 4  # tiny block size: tests spell out block boundaries
+
+
+# ---------------------------------------------------------------- allocator --
+class TestRefcountedAllocator:
+
+    def test_allocate_free_roundtrip_unshared(self):
+        a = BlockedAllocator(8)
+        blocks = a.allocate(3)
+        assert a.free_blocks == 5
+        assert all(a.ref_count(b) == 1 for b in blocks)
+        a.free(blocks)
+        assert a.free_blocks == 8
+
+    def test_shared_block_survives_first_free(self):
+        a = BlockedAllocator(4)
+        (b, ) = a.allocate(1)
+        a.incref([b])
+        assert a.ref_count(b) == 2
+        a.free([b])
+        assert a.free_blocks == 3  # still held by the second reference
+        a.free([b])
+        assert a.free_blocks == 4
+
+    def test_double_free_raises(self):
+        a = BlockedAllocator(4)
+        (b, ) = a.allocate(1)
+        a.free([b])
+        with pytest.raises(ValueError, match="double free"):
+            a.free([b])
+        assert a.free_blocks == 4  # the failed free corrupted nothing
+
+    def test_incref_of_free_block_raises(self):
+        a = BlockedAllocator(4)
+        with pytest.raises(ValueError, match="not allocated"):
+            a.incref([0])
+
+    def test_freed_shared_block_is_not_reissued_while_referenced(self):
+        a = BlockedAllocator(2)
+        (b, ) = a.allocate(1)
+        a.incref([b])
+        a.free([b])
+        other = a.allocate(1)  # must NOT hand back b
+        assert int(other[0]) != int(b)
+
+
+# -------------------------------------------------------------------- trie --
+@pytest.fixture
+def kv():
+    cfg = KVCacheConfig(block_size=BS, cache_shape=(1, 1, 8), cache_dtype="float32")
+    return BlockedKVCache(cfg, MemoryConfig(mode=AllocationMode.ALLOCATE, size=32))
+
+
+def _alloc_seq(kv, tokens):
+    """Simulate a finished sequence: one block per BS tokens, all committed."""
+    n = (len(tokens) + BS - 1) // BS
+    return kv.reserve(n)
+
+
+class TestRadixIndex:
+
+    def test_publish_then_match_longest_prefix(self, kv):
+        pc = PrefixCache(kv)
+        toks = np.arange(10)  # 2 full blocks + a 2-token tail
+        blocks = _alloc_seq(kv, toks)
+        assert pc.publish(toks, blocks, committed_tokens=10) == 2
+        kv.free(blocks)  # "sequence flushed"; trie refs keep the 2 full blocks
+
+        hit = pc.acquire(np.arange(9))
+        assert hit.tokens == 2 * BS
+        assert hit.blocks == [int(blocks[0]), int(blocks[1])]
+        pc.release(hit.blocks)
+
+    def test_divergent_block_does_not_match(self, kv):
+        pc = PrefixCache(kv)
+        toks = np.arange(8)
+        blocks = _alloc_seq(kv, toks)
+        pc.publish(toks, blocks, committed_tokens=8)
+        kv.free(blocks)
+        other = np.concatenate([np.arange(4), [99, 99, 99, 99]])
+        hit = pc.acquire(other)
+        assert hit.tokens == BS and len(hit.blocks) == 1  # first block only
+        pc.release(hit.blocks)
+        # chained hashing: same tokens in block 1 under a DIFFERENT block 0
+        # must not match block 1's node
+        shifted = np.concatenate([[99] * 4, np.arange(4, 8)])
+        assert pc.acquire(shifted).tokens == 0
+
+    def test_min_prefix_blocks_gates_short_hits(self, kv):
+        pc = PrefixCache(kv, min_prefix_blocks=2)
+        toks = np.arange(4)
+        blocks = _alloc_seq(kv, toks)
+        pc.publish(toks, blocks, committed_tokens=4)
+        kv.free(blocks)
+        assert pc.acquire(toks).tokens == 0  # 1-block match < min
+        assert pc.stats()["hits"] == 0
+
+    def test_committed_cap_excludes_overrun_blocks(self, kv):
+        pc = PrefixCache(kv)
+        toks = np.arange(8)
+        blocks = _alloc_seq(kv, toks)
+        # only 5 positions hold kept-token KV: block 1 must not be indexed
+        assert pc.publish(toks, blocks, committed_tokens=5) == 1
+        assert pc.n_blocks == 1
+
+    def test_eviction_skips_chains_shared_by_live_sequences(self, kv):
+        pc = PrefixCache(kv)
+        a = np.arange(8)           # blocks A0, A1
+        b = np.arange(100, 108)    # blocks B0, B1
+        ba, bb = _alloc_seq(kv, a), _alloc_seq(kv, b)
+        pc.publish(a, ba, 8)
+        pc.publish(b, bb, 8)
+        kv.free(ba)
+        kv.free(bb)
+        hit = pc.acquire(a)       # a live sequence shares A's chain
+        assert pc.evict(10) == 2  # only B's chain is evictable (leaf-first)
+        assert pc.n_blocks == 2
+        assert pc.acquire(b).tokens == 0  # B gone, A intact
+        pc.release(hit.blocks)
+        assert pc.evict(10) == 2  # A's chain now unwinds too
+        assert kv.free_blocks == kv.num_blocks
+
+    def test_eviction_is_lru_ordered(self, kv):
+        pc = PrefixCache(kv)
+        a, b = np.arange(4), np.arange(100, 104)
+        ba = _alloc_seq(kv, a)
+        pc.publish(a, ba, 4)
+        kv.free(ba)
+        bb = _alloc_seq(kv, b)
+        pc.publish(b, bb, 4)
+        kv.free(bb)
+        pc.release(pc.acquire(a).blocks)  # touch A: B becomes LRU
+        assert pc.evict(1) == 1
+        assert pc.acquire(b).tokens == 0  # the LRU chain (B) was the victim
+        hit = pc.acquire(a)
+        assert hit.tokens == 4
+        pc.release(hit.blocks)
+
+    def test_shared_leaves_are_not_evictable(self, kv):
+        pc = PrefixCache(kv)
+        toks = np.arange(8)
+        blocks = _alloc_seq(kv, toks)
+        pc.publish(toks, blocks, 8)
+        kv.free(blocks)
+        hit = pc.acquire(toks)  # a "live sequence" shares both blocks
+        assert pc.evict(4) == 0  # nothing evictable: freeing reclaims nothing
+        pc.release(hit.blocks)
+        assert pc.evict(4) == 2  # now the whole chain unwinds leaf-first
+        assert kv.free_blocks == kv.num_blocks
+
+    def test_max_blocks_cap_evicts_lru_to_publish(self, kv):
+        pc = PrefixCache(kv, max_blocks=2)
+        a = np.arange(8)
+        ba = _alloc_seq(kv, a)
+        pc.publish(a, ba, 8)
+        kv.free(ba)
+        b = np.arange(100, 108)
+        bb = _alloc_seq(kv, b)
+        assert pc.publish(b, bb, 8) == 2  # evicted A's chain to make room
+        kv.free(bb)
+        assert pc.n_blocks == 2
+        assert pc.acquire(a).tokens == 0
+        hit = pc.acquire(b)
+        assert hit.tokens == 8
+        pc.release(hit.blocks)
+
+    def test_publish_at_cap_never_evicts_its_own_walk_path(self, kv):
+        """A capped trie asked to extend a matched chain must not evict the
+        node the walk is standing on (the only evictable leaf): it stops
+        indexing instead of attaching children to a detached parent."""
+        pc = PrefixCache(kv, max_blocks=1)
+        a = np.arange(4)
+        ba = _alloc_seq(kv, a)
+        pc.publish(a, ba, 4)
+        kv.free(ba)
+        extended = np.arange(8)  # block 0 matches the cached chain
+        bb = _alloc_seq(kv, extended)
+        assert pc.publish(extended, bb, 8) == 0  # no room that isn't the spine
+        kv.free(bb)
+        assert pc.n_blocks == 1
+        hit = pc.acquire(extended)
+        assert hit.tokens == BS  # the original chain is intact and reachable
+        pc.release(hit.blocks)
+
+    def test_clear_releases_only_trie_refs(self, kv):
+        pc = PrefixCache(kv)
+        toks = np.arange(8)
+        blocks = _alloc_seq(kv, toks)
+        pc.publish(toks, blocks, 8)
+        hit = pc.acquire(toks)  # simulated live sequence
+        kv.free(blocks)         # publisher flushed
+        pc.clear()
+        assert pc.n_blocks == 0
+        assert kv.free_blocks == kv.num_blocks - 2  # the live sharer holds on
+        kv.free(hit.blocks)
+        assert kv.free_blocks == kv.num_blocks
+
+
+# --------------------------------------------------------------------- cow --
+def test_fork_blocks_copies_content_and_isolates_writes(kv):
+    import jax.numpy as jnp
+
+    (src, ) = kv.reserve(1)
+    cache = kv.cache.at[:, :, src].set(7.0)
+    kv.set_cache(cache)
+    (dst, ) = kv.fork_blocks([src])
+    assert dst != src
+    assert kv.ref_count(dst) == 1
+    np.testing.assert_array_equal(np.asarray(kv.cache[:, :, dst]),
+                                  np.asarray(kv.cache[:, :, src]))
+    # a write through the fork leaves the source untouched
+    kv.set_cache(kv.cache.at[:, :, dst].set(9.0))
+    assert float(jnp.max(jnp.abs(kv.cache[:, :, src] - 7.0))) == 0.0
+
+
+def test_fork_blocks_pool_exhausted_consumes_nothing(kv):
+    blocks = kv.reserve(kv.num_blocks)
+    with pytest.raises(ValueError):
+        kv.fork_blocks([int(blocks[0])])
+    kv.free(blocks)
+    assert kv.free_blocks == kv.num_blocks

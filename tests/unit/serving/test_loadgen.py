@@ -7,7 +7,8 @@ import sys
 
 import pytest
 
-from deepspeed_tpu.serving import ServingConfig, ServingScheduler, ServingServer
+from deepspeed_tpu.serving import (PrefixCacheConfig, ServingConfig,
+                                   ServingScheduler, ServingServer)
 
 BIN = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))), "bin")
@@ -45,6 +46,43 @@ def test_loadgen_open_loop_lognormal(server, llama_setup):
                  "--vocab-size", str(cfg.vocab_size))
     assert r.returncode == 0, r.stderr[-800:]
     assert "ok=3 err=0" in r.stdout
+
+
+def test_loadgen_shared_prefix_reports_cache_effectiveness(make_engine, llama_setup):
+    """--shared-prefix against a cache-enabled server: sequential requests over
+    2 prompt groups hit after each group's first miss; the report carries hit
+    rate, prefill-tokens-saved, and the hit/miss TTFT split."""
+    cfg, _, _ = llama_setup
+    sched = ServingScheduler(
+        make_engine(),
+        ServingConfig(prefix_cache=PrefixCacheConfig(enabled=True)))
+    srv = ServingServer(sched).start()
+    try:
+        r = _loadgen("--url", srv.url, "--requests", "8", "--mode", "closed",
+                     "--concurrency", "1", "--shared-prefix", "32:2",
+                     "--prompt-len", "8", "--max-new-tokens", "4",
+                     "--vocab-size", str(cfg.vocab_size))
+        assert r.returncode == 0, r.stderr[-800:]
+        assert "ok=8 err=0" in r.stdout
+        assert "# prefix cache: hits=" in r.stdout, r.stdout
+        assert "ttft (hit)" in r.stdout and "ttft (miss)" in r.stdout, r.stdout
+        # 2 groups -> at most 2 cold publishers; everything after hits, so a
+        # 32-token prefix over 40-token prompts saves >= 50% of prefill
+        hits = int(r.stdout.split("# prefix cache: hits=")[1].split("/")[0])
+        assert hits >= 6
+        saved = int(r.stdout.split("prefill_tokens_saved=")[1].split("/")[0])
+        assert saved >= hits * 31
+        pc = sched.stats()["prefix_cache"]
+        assert pc["hits"] == hits and pc["lookups"] == 8
+    finally:
+        srv.stop(drain=False)
+
+
+def test_loadgen_shared_prefix_arg_validation():
+    r = _loadgen("--url", "http://127.0.0.1:1", "--requests", "1",
+                 "--shared-prefix", "0:2")
+    assert r.returncode == 2
+    assert "--shared-prefix takes" in r.stderr
 
 
 def test_loadgen_reports_connection_errors():

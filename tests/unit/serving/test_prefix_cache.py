@@ -1,0 +1,395 @@
+"""Automatic prefix caching through the serving scheduler: token-identical
+outputs with the cache on vs off (greedy AND sampled), the CPU perf gate (a
+fully-cached prompt schedules only its last token — zero prefill chunks),
+eviction-under-pressure preferring unreferenced trie leaves, refcount
+correctness under concurrent admit/evict/cancel, and fleet handoff of
+sequences holding shared blocks.
+
+Mechanism units (allocator refcounts, the radix index, COW forks) live in
+tests/unit/inference/v2/test_prefix_cache.py.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.serving import (PrefixCacheConfig, RequestState, ServingConfig,
+                                   ServingScheduler)
+
+MAX_STEPS = 400
+
+
+def _run_until(sched, pred, max_steps=MAX_STEPS):
+    for _ in range(max_steps):
+        if pred():
+            return
+        sched.step()
+    raise AssertionError(f"predicate not reached in {max_steps} steps")
+
+
+def _cached_config(**pc_kw):
+    pc_kw.setdefault("enabled", True)
+    return ServingConfig(prefix_cache=PrefixCacheConfig(**pc_kw))
+
+
+def _prompt(cfg, n, seed=0):
+    return np.random.default_rng(seed).integers(0, cfg.vocab_size, n).tolist()
+
+
+# --------------------------------------------------------- token identity --
+def test_token_identical_greedy_full_and_partial_hit(make_engine, llama_setup):
+    cfg, _, _ = llama_setup
+    full = _prompt(cfg, 64)                     # 4 full blocks: a full hit
+    partial = full[:32] + _prompt(cfg, 30, 1)   # 2 shared blocks + cold tail
+
+    cold_engine = make_engine()
+    cold = ServingScheduler(cold_engine, ServingConfig(), start=False)
+    warm_engine = make_engine()
+    warm = ServingScheduler(warm_engine, _cached_config(), start=False)
+    try:
+        expect = {}
+        for key, prompt in (("full", full), ("partial", partial)):
+            req = cold.submit(prompt, max_new_tokens=6)
+            _run_until(cold, lambda: req.finished)
+            expect[key] = req.result()
+
+        seed_req = warm.submit(full, max_new_tokens=6)  # publisher (cold miss)
+        _run_until(warm, lambda: seed_req.finished)
+        assert seed_req.cached_tokens == 0
+        assert seed_req.result() == expect["full"]
+
+        hit = warm.submit(full, max_new_tokens=6)
+        _run_until(warm, lambda: hit.finished)
+        assert hit.cached_tokens == 63  # fully cached: only the last token re-fed
+        assert hit.result() == expect["full"]
+
+        part = warm.submit(partial, max_new_tokens=6)
+        _run_until(warm, lambda: part.finished)
+        assert part.cached_tokens == 32  # the shared block-aligned prefix
+        assert part.result() == expect["partial"]
+    finally:
+        cold.stop(drain=False)
+        warm.stop(drain=False)
+    # the trie's pins release at stop: no leaked device blocks
+    assert warm_engine.free_blocks == warm_engine._state_manager.kv_cache.num_blocks
+
+
+def test_token_identical_sampled(make_engine, llama_setup):
+    """Sampling draws from a per-request seeded stream; a hit changes where
+    prefix KV comes from, never the logits or the draw sequence."""
+    cfg, _, _ = llama_setup
+    prompt = _prompt(cfg, 48)
+    kw = dict(max_new_tokens=6, temperature=0.8, seed=1234)
+
+    cold = ServingScheduler(make_engine(), ServingConfig(), start=False)
+    warm = ServingScheduler(make_engine(), _cached_config(), start=False)
+    try:
+        ref = cold.submit(prompt, **kw)
+        _run_until(cold, lambda: ref.finished)
+
+        seed_req = warm.submit(prompt, **kw)
+        _run_until(warm, lambda: seed_req.finished)
+        hit = warm.submit(prompt, **kw)
+        _run_until(warm, lambda: hit.finished)
+        assert hit.cached_tokens == 47
+        assert seed_req.result() == ref.result()
+        assert hit.result() == ref.result()
+    finally:
+        cold.stop(drain=False)
+        warm.stop(drain=False)
+
+
+# ------------------------------------------------------------- perf gate --
+def test_full_hit_schedules_zero_prefill_chunks_cpu_perf_gate(make_engine, llama_setup):
+    """The chip-independent perf evidence (ROADMAP item 1 direction): via the
+    PR-4 compile/step counters, a repeated prompt executes ZERO prefill model
+    chunks — the engine is fed exactly the suffix (one last-token step) plus
+    the decode inputs, and no new XLA program compiles."""
+    cfg, _, _ = llama_setup
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+    engine = make_engine()
+    sched = ServingScheduler(engine, _cached_config(), start=False)
+    prompt = _prompt(cfg, 64)
+    N = 6
+
+    def counters():
+        snap = telemetry.get_registry().snapshot()
+        return (sum(v for _, v in snap.get("inference_tokens_total", [])),
+                sum(v for _, v in snap.get("inference_batches_total", [])),
+                sum(v for _, v in snap.get("compile_cache_misses_total", [])))
+
+    try:
+        cold = sched.submit(prompt, max_new_tokens=N)
+        _run_until(sched, lambda: cold.finished)
+        tok0, batch0, compile0 = counters()
+        # cold fed the whole prompt plus N-1 decode inputs
+        assert tok0 == 64 + N - 1
+
+        warm = sched.submit(prompt, max_new_tokens=N)
+        _run_until(sched, lambda: warm.finished)
+        tok1, batch1, compile1 = counters()
+        # the first warm request may compile once-per-process programs (the
+        # COW fork copy, a decode bucket the cold run never hit); the SECOND
+        # warm request is the steady state the gate measures
+        warm2 = sched.submit(prompt, max_new_tokens=N)
+        _run_until(sched, lambda: warm2.finished)
+        tok2, batch2, compile2 = counters()
+    finally:
+        sched.stop(drain=False)
+
+    assert warm.result() == cold.result()
+    assert warm2.result() == cold.result()
+    # prefill tokens fed == suffix length (1): the whole warm request cost
+    # exactly N single-token steps — zero prefill chunks
+    assert tok1 - tok0 == N
+    assert batch1 - batch0 == N
+    assert tok2 - tok1 == N
+    assert compile2 == compile1  # steady state: nothing compiles, no prefill bucket runs
+    stats = sched.stats()
+    assert stats["counters"]["prefix_hits"] == 2
+    assert stats["counters"]["prefix_tokens_saved"] == 126
+
+
+def test_partial_hit_prefills_only_the_suffix(make_engine, llama_setup):
+    cfg, _, _ = llama_setup
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+    engine = make_engine()
+    sched = ServingScheduler(engine, _cached_config(), start=False)
+    base = _prompt(cfg, 64)
+    try:
+        seed_req = sched.submit(base, max_new_tokens=2)
+        _run_until(sched, lambda: seed_req.finished)
+        snap = telemetry.get_registry().snapshot()
+        tok0 = sum(v for _, v in snap.get("inference_tokens_total", []))
+
+        fork = base[:48] + _prompt(cfg, 16, 7)  # 3 shared blocks + 16 new tokens
+        req = sched.submit(fork, max_new_tokens=2)
+        _run_until(sched, lambda: req.finished)
+        snap = telemetry.get_registry().snapshot()
+        tok1 = sum(v for _, v in snap.get("inference_tokens_total", []))
+    finally:
+        sched.stop(drain=False)
+    assert req.cached_tokens == 48
+    assert tok1 - tok0 == 16 + 1  # the 16-token suffix + one decode input
+
+
+# --------------------------------------------------------------- eviction --
+def test_eviction_under_pressure_prefers_trie_leaves(make_engine, llama_setup):
+    """KV pressure reclaims cached-but-idle trie blocks (LRU) BEFORE
+    offloading any live sequence."""
+    cfg, _, _ = llama_setup
+    engine = make_engine(num_blocks=8)  # 8 x 16 tokens
+    # max_prefill_chunk keeps every chunk in the T=64 pad bucket (a 96-token
+    # chunk would compile a T=128 program just for this test)
+    cfg_pc = _cached_config().model_copy(update={"max_prefill_chunk": 48})
+    sched = ServingScheduler(engine, cfg_pc, start=False)
+    try:
+        seed_req = sched.submit(_prompt(cfg, 48), max_new_tokens=2)
+        _run_until(sched, lambda: seed_req.finished)
+        assert sched._prefix_cache.n_blocks == 3  # 48 committed tokens pinned
+
+        big = sched.submit(_prompt(cfg, 96, 5), max_new_tokens=2)  # needs 7 blocks
+        _run_until(sched, lambda: big.finished)
+        stats = sched.stats()
+        assert big.state is RequestState.DONE
+        assert stats["counters"]["prefix_evictions"] >= 1
+        assert stats["counters"]["evictions"] == 0  # no live sequence offloaded
+    finally:
+        sched.stop(drain=False)
+    assert engine.free_blocks == 8
+
+
+def test_trie_never_starves_admissions(make_engine, llama_setup):
+    """A trie pinning most of the pool must yield to new work: back-to-back
+    distinct prompts each publish, evict, and complete."""
+    cfg, _, _ = llama_setup
+    engine = make_engine(num_blocks=6)
+    sched = ServingScheduler(engine, _cached_config(), start=False)
+    try:
+        for seed in range(3):
+            req = sched.submit(_prompt(cfg, 64, seed + 10), max_new_tokens=2)
+            _run_until(sched, lambda: req.finished)
+            assert req.state is RequestState.DONE
+    finally:
+        sched.stop(drain=False)
+    assert engine.free_blocks == 6
+
+
+def test_failed_cow_fork_leaks_no_references(make_engine, llama_setup,
+                                             monkeypatch):
+    """A device failure inside the copy-on-write fork degrades the request to
+    a cold prefill AND drops every reference the hit acquired — the trie's
+    blocks stay evictable (refcount 1) instead of ratcheting up per retry."""
+    cfg, _, _ = llama_setup
+    engine = make_engine()
+    sched = ServingScheduler(engine, _cached_config(), start=False)
+    prompt = _prompt(cfg, 32)
+    kv = engine._state_manager.kv_cache
+    try:
+        seed_req = sched.submit(prompt, max_new_tokens=2)
+        _run_until(sched, lambda: seed_req.finished)
+        trie_blocks = [n.block for n in sched._prefix_cache._by_digest.values()]
+
+        monkeypatch.setattr(kv, "fork_blocks",
+                            lambda *a, **k: (_ for _ in ()).throw(
+                                RuntimeError("injected fork failure")))
+        req = sched.submit(prompt, max_new_tokens=2)
+        _run_until(sched, lambda: req.finished)
+        assert req.state is RequestState.DONE
+        assert req.cached_tokens == 0  # degraded to a cold prefill
+        assert req.result() == seed_req.result()
+    finally:
+        sched.stop(drain=False)
+    assert engine.free_blocks == kv.num_blocks  # nothing leaked
+    for b in trie_blocks:
+        with pytest.raises(ValueError):  # fully freed at stop: refs hit zero
+            kv.free([b])
+
+
+# ------------------------------------------------------------ concurrency --
+def test_refcount_correctness_under_concurrent_admit_evict_cancel(make_engine,
+                                                                  llama_setup):
+    """Hammer the cache from many submitter threads with mid-flight
+    cancellations on a pool small enough to force trie evictions: no double
+    free (the allocator raises — step() would log and the accounting below
+    would drift), no freeing a shared block under a live sequence, and the
+    pool balances exactly at the end."""
+    cfg, _, _ = llama_setup
+    engine = make_engine(num_blocks=24)
+    sched = ServingScheduler(engine, _cached_config())
+    prefixes = [_prompt(cfg, 32, 100 + g) for g in range(3)]
+    requests, lock = [], threading.Lock()
+
+    def client(seed):
+        rng = np.random.default_rng(seed)
+        for i in range(4):
+            prompt = prefixes[int(rng.integers(3))] + \
+                rng.integers(0, cfg.vocab_size, 8).tolist()
+            req = sched.submit(prompt, max_new_tokens=3)
+            with lock:
+                requests.append(req)
+            if rng.random() < 0.3:
+                time.sleep(float(rng.random()) * 0.01)
+                req.cancel()
+
+    threads = [threading.Thread(target=client, args=(s, )) for s in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    deadline = time.monotonic() + 120
+    for req in requests:
+        assert req.wait(timeout=max(0.1, deadline - time.monotonic())), req
+
+    pc = sched._prefix_cache
+    kv = engine._state_manager.kv_cache
+    # every device block is either free or pinned exactly once by the trie
+    assert engine.free_blocks + pc.n_blocks == kv.num_blocks
+    assert engine._state_manager.n_tracked_sequences == 0
+    sched.stop(drain=False)
+    assert engine.free_blocks == kv.num_blocks  # trie pins released
+
+
+# ---------------------------------------------------------------- handoff --
+def test_handoff_of_sequence_holding_shared_blocks_token_identical(make_engine,
+                                                                   llama_setup):
+    """Fleet prefill→decode handoff of a request served from the cache: the
+    export materializes shared-block contents, the donor's trie keeps its
+    references, and the continuation matches the single-engine run exactly."""
+    cfg, _, _ = llama_setup
+    prompt = _prompt(cfg, 64)
+
+    donor_engine = make_engine()
+    donor = ServingScheduler(donor_engine, _cached_config(), start=False)
+    recipient = ServingScheduler(make_engine(), ServingConfig(), start=False)
+    try:
+        # the publisher doubles as the single-engine ground truth (cold miss)
+        whole = donor.submit(prompt, max_new_tokens=8)
+        _run_until(donor, lambda: whole.finished)
+        assert whole.cached_tokens == 0
+
+        head = donor.submit(prompt, max_new_tokens=4, handoff=True)
+        _run_until(donor, lambda: head.finished)
+        assert head.cached_tokens == 63  # the handed-off sequence shared blocks
+        assert head.handoff_payload is not None
+        # donor side stays coherent: trie intact, no block leaked or lost
+        hit_again = donor.submit(prompt, max_new_tokens=2)
+        _run_until(donor, lambda: hit_again.finished)
+        assert hit_again.cached_tokens == 63
+
+        tail = recipient.submit_resume(head.handoff_payload, max_new_tokens=4)
+        _run_until(recipient, lambda: tail.finished)
+        assert head.result() + tail.result() == whole.result()
+    finally:
+        donor.stop(drain=False)
+        recipient.stop(drain=False)
+    assert donor_engine.free_blocks == donor_engine._state_manager.kv_cache.num_blocks
+
+
+# -------------------------------------------------------- stats and config --
+def test_stats_and_flight_report_prefix_cache(make_engine, llama_setup):
+    cfg, _, _ = llama_setup
+    sched = ServingScheduler(make_engine(), _cached_config(), start=False)
+    prompt = _prompt(cfg, 32)
+    try:
+        r1 = sched.submit(prompt, max_new_tokens=2)
+        _run_until(sched, lambda: r1.finished)
+        r2 = sched.submit(prompt, max_new_tokens=200)
+        _run_until(sched, lambda: r2.state is RequestState.DECODE)
+        doc = sched.stats()
+        pc = doc["prefix_cache"]
+        assert pc["lookups"] == 2 and pc["hits"] == 1
+        assert 0 < pc["hit_rate"] < 1
+        assert pc["trie_blocks"] == 2
+        assert [r["cached_tokens"] for r in doc["requests"]] == [31]
+        flight = sched.flight_state()
+        assert flight["prefix_cache"]["hits"] == 1
+        assert flight["requests"][0]["cached_tokens"] == 31
+        r2.cancel()
+        _run_until(sched, lambda: r2.finished)
+    finally:
+        sched.stop(drain=False)
+
+
+def test_stats_report_none_when_disabled(make_engine):
+    sched = ServingScheduler(make_engine(), ServingConfig(), start=False)
+    try:
+        assert sched.stats()["prefix_cache"] is None
+    finally:
+        sched.stop(drain=False)
+
+
+def test_prefix_cache_config_validation():
+    with pytest.raises(Exception):
+        PrefixCacheConfig(max_blocks=0)
+    with pytest.raises(Exception):
+        PrefixCacheConfig(min_prefix_blocks=0)
+    cfg = ServingConfig(prefix_cache={"enabled": True, "max_blocks": 64,
+                                      "min_prefix_blocks": 2})
+    assert cfg.prefix_cache.enabled and cfg.prefix_cache.max_blocks == 64
+
+
+def test_fleet_config_plumbs_prefix_cache_per_role():
+    """FleetConfig.prefix_cache is authoritative per role when enabled: the
+    prefill/mixed pools cache, the decode pool (which only imports handed-off
+    KV) does not — and an operator's serving config keeps its own block when
+    the fleet stays silent."""
+    from deepspeed_tpu.fleet.config import FleetConfig
+    from deepspeed_tpu.fleet.manager import ReplicaManager
+
+    fleet = FleetConfig(prefix_cache=PrefixCacheConfig(enabled=True, max_blocks=32))
+    mgr = ReplicaManager(config=fleet,
+                         serving_config=ServingConfig(default_max_new_tokens=7))
+    for role in ("mixed", "prefill"):
+        sc = mgr._role_serving_config(role)
+        assert sc.prefix_cache.enabled and sc.prefix_cache.max_blocks == 32
+        assert sc.default_max_new_tokens == 7  # the base config survives
+    assert not mgr._role_serving_config("decode").prefix_cache.enabled
+
+    # fleet silent -> the replica-level serving config is untouched
+    silent = ReplicaManager(config=FleetConfig(),
+                            serving_config=_cached_config())
+    assert silent._role_serving_config("decode").prefix_cache.enabled
