@@ -1,3 +1,4 @@
 from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent, ElasticAgentError
 from deepspeed_tpu.elasticity.elasticity import (ElasticityConfig, ElasticityError,
                                                  compute_elastic_config, elasticity_enabled)
+from deepspeed_tpu.elasticity.train_supervisor import TrainSupervisor

@@ -32,12 +32,18 @@ class DSElasticAgent:
     def __init__(self, cmd: List[str], num_processes: int, ds_config: Optional[dict] = None,
                  env: Optional[Dict[str, str]] = None, max_restarts: int = 3,
                  monitor_interval: float = 0.5,
-                 capacity_fn: Optional[Callable[[], int]] = None):
+                 capacity_fn: Optional[Callable[[], int]] = None,
+                 restart_backoff_base_s: float = 0.0,
+                 restart_backoff_cap_s: float = 30.0,
+                 restart_jitter_frac: float = 0.1, seed: int = 0):
         """``cmd`` is launched once per process with DSTPU_NUM_PROCESSES /
         DSTPU_PROCESS_ID exported (the contract ``comm.init_distributed``
         reads). ``capacity_fn`` reports how many processes can be spawned for
         the next attempt (defaults to the last world size — a failed process is
-        assumed recoverable; pass a probe for real node-loss handling)."""
+        assumed recoverable; pass a probe for real node-loss handling).
+        ``restart_backoff_base_s`` > 0 spaces restarts with the fleet's shared
+        bounded-jitter ``backoff_delay`` policy (0 = immediate, the legacy
+        behavior)."""
         self.cmd = list(cmd)
         self.num_processes = int(num_processes)
         self.ds_config = ds_config or {}
@@ -46,6 +52,11 @@ class DSElasticAgent:
         self.monitor_interval = monitor_interval
         self.capacity_fn = capacity_fn
         self.restart_count = 0
+        self.restart_backoff_base_s = float(restart_backoff_base_s)
+        self.restart_backoff_cap_s = float(restart_backoff_cap_s)
+        self.restart_jitter_frac = float(restart_jitter_frac)
+        import random as _random
+        self._backoff_rng = _random.Random(f"{seed}:elastic_agent")
 
     # -- world-size policy -------------------------------------------------------
     def next_world_size(self, capacity: int) -> int:
@@ -71,6 +82,10 @@ class DSElasticAgent:
             env["DSTPU_NUM_PROCESSES"] = str(world_size)
             env["DSTPU_PROCESS_ID"] = str(rank)
             env["DSTPU_ELASTIC_RESTART"] = str(self.restart_count)
+            # the training chaos injector keys its one-shot kill/sigterm
+            # points on this (runtime/faults.first_life) — without it a
+            # deterministic kill replays on every relaunch and crash-loops
+            env["DSTPU_RESTART_COUNT"] = str(self.restart_count)
             procs.append(subprocess.Popen(self.cmd, env=env))
         return procs
 
@@ -112,5 +127,18 @@ class DSElasticAgent:
                 raise ElasticAgentError(f"job failed after {self.max_restarts} restarts")
             capacity = self.capacity_fn() if self.capacity_fn is not None else world
             world = self.next_world_size(capacity)
+            delay = 0.0
+            if self.restart_backoff_base_s > 0.0:
+                # the fleet's one backoff formula (fleet/breaker.backoff_delay):
+                # exponential, capped, bounded jitter, deterministic in seed
+                from deepspeed_tpu.fleet.breaker import backoff_delay
+                delay = backoff_delay(self.restart_count - 1,
+                                      self.restart_backoff_base_s,
+                                      self.restart_backoff_cap_s,
+                                      self.restart_jitter_frac,
+                                      self._backoff_rng.random())
             logger.warning(f"elastic agent: worker failed; restarting with "
-                           f"world_size={world} (capacity {capacity})")
+                           f"world_size={world} (capacity {capacity}"
+                           f"{f', backoff {delay:.2f}s' if delay else ''})")
+            if delay:
+                time.sleep(delay)

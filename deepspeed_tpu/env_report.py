@@ -152,8 +152,86 @@ def trace_report(path):
     return 0
 
 
+def checkpoint_report(save_dir, keep_last_k=None):
+    """``dstpu_report --checkpoint <dir>``: verify every tag's manifest CRCs
+    and list good/torn/corrupt/reference status, plus which tags keep-last-K
+    retention would keep (K from ``--keep-last-k``, else the newest manifest's
+    recorded ``keep_last_k``). Returns 0 when every tag is good."""
+    import os
+
+    from deepspeed_tpu.runtime.checkpoint_engine.engine import (
+        LATEST_FILE, PREEMPT_MARKER, list_tags, retention_plan,
+        verify_checkpoint)
+
+    save_dir = os.path.abspath(save_dir)
+    tags = list_tags(save_dir)
+    pointed = None
+    latest_file = os.path.join(save_dir, LATEST_FILE)
+    if os.path.isfile(latest_file):
+        with open(latest_file) as f:
+            pointed = f.read().strip()
+
+    if keep_last_k is None:
+        for entry in tags:  # newest first; the freshest save's config wins
+            if entry["manifest"] is not None:
+                keep_last_k = entry["manifest"].get("keep_last_k", 0)
+                break
+    keep, drop = retention_plan(save_dir, keep_last_k or 0)
+    survivors = {e["tag"] for e in keep}
+
+    print("-" * 78)
+    print(f"checkpoint dir ......... {save_dir}")
+    print(f"tags ................... {len(tags)} "
+          f"(latest → {pointed or 'none'}, keep_last_k={keep_last_k or 0})")
+    if os.path.isfile(os.path.join(save_dir, PREEMPT_MARKER)):
+        import json
+        with open(os.path.join(save_dir, PREEMPT_MARKER)) as f:
+            marker = json.load(f)
+        print(f"preemption marker ...... tag {marker.get('tag')} at step "
+              f"{marker.get('global_steps')} "
+              f"({marker.get('used_s')}s of {marker.get('grace_s')}s grace)")
+    print("-" * 78)
+    if not tags:
+        print("no checkpoint tags found")
+        return 1
+    all_good = True
+    for entry in tags:
+        status, detail = verify_checkpoint(entry["path"])
+        all_good &= status == "good"
+        manifest = entry["manifest"] or {}
+        step = manifest.get("global_steps", "?")
+        n_files = len(manifest.get("files", {}))
+        n_arrays = len(manifest.get("arrays") or {})
+        flags = []
+        if entry["tag"] == pointed:
+            flags.append("latest")
+        flags.append("kept" if entry["tag"] in survivors else "prunable")
+        verdict = {"good": GREEN_OK, }.get(status, RED_NO)
+        print(f"{entry['tag']:<28} {verdict} {status:<9} step={step:<8} "
+              f"files={n_files:<4} arrays={n_arrays:<4} [{', '.join(flags)}]")
+        if status != "good":
+            print(f"{'':<28}   ↳ {detail}")
+    print("-" * 78)
+    print(f"verdict ................ "
+          f"{GREEN_OK + ' all tags verified' if all_good else RED_NO + ' bad tags present (load falls back to the newest good one)'}")
+    return 0 if all_good else 1
+
+
 def main(argv=None):
     argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if "--checkpoint" in argv:
+        idx = argv.index("--checkpoint")
+        if idx + 1 >= len(argv):
+            print("usage: dstpu_report --checkpoint <dir> [--keep-last-k K]")
+            return 2
+        keep = None
+        if "--keep-last-k" in argv:
+            kidx = argv.index("--keep-last-k")
+            if kidx + 1 >= len(argv):
+                print("usage: dstpu_report --checkpoint <dir> [--keep-last-k K]")
+                return 2
+            keep = int(argv[kidx + 1])
+        return checkpoint_report(argv[idx + 1], keep_last_k=keep)
     if "--metrics-url" in argv:
         idx = argv.index("--metrics-url")
         if idx + 1 >= len(argv):

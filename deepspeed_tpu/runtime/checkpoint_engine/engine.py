@@ -1,4 +1,4 @@
-"""Checkpoint save/load for the engine.
+"""Checkpoint save/load for the engine — crash-consistent.
 
 Reference: ``deepspeed/runtime/engine.py:3052-3548`` (save/load incl. ZeRO shards)
 and ``deepspeed/runtime/checkpoint_engine/`` (CheckpointEngine ABC / torch / nebula).
@@ -6,10 +6,25 @@ The TPU design (SURVEY.md §5.4): ONE logical checkpoint in sharded-array format
 (orbax → tensorstore). Every host writes only its shards; restore reshards into
 whatever mesh/topology is current — which is the reference's "universal checkpoint"
 (ds_to_universal.py) for free.
+
+Crash consistency (ISSUE 11): every committed checkpoint carries a
+``MANIFEST.json`` written *last* via atomic tmp+rename — the commit marker.
+It records per-array CRC32 checksums (the handoff ``kv_crc32`` idea applied to
+training state), per-file size+CRC32 of everything the commit wrote, the
+step/RNG/loss-scale state and the world shape that produced it. A checkpoint
+directory without a manifest is *torn* (the crash landed mid-commit); one whose
+files disagree with the manifest is *corrupt*. ``load_engine_state`` verifies
+before restoring and, when asked for the latest checkpoint, falls back LOUDLY
+(log + ``checkpoint_load_fallbacks_total``) to the newest verified-good tag
+instead of dying. Keep-last-K retention prunes old tags but never deletes the
+newest committed one.
 """
 
 import json
 import os
+import re
+import shutil
+import zlib
 import pickle
 
 import numpy as np
@@ -17,6 +32,50 @@ import numpy as np
 from deepspeed_tpu.utils.logging import logger
 
 LATEST_FILE = "latest"
+MANIFEST_FILE = "MANIFEST.json"
+PREEMPT_MARKER = "PREEMPTED.json"
+MANIFEST_FORMAT = 1
+
+# filenames the reference (torch) DeepSpeed writes per rank; their presence
+# means the directory is a reference checkpoint, not an orbax one
+_REFERENCE_SHARD_PREFIXES = ("zero_pp_rank_", "mp_rank_", "bf16_zero_pp_rank_")
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint failed manifest verification (torn or corrupt) and no
+    fallback was possible (explicit tag, or no verified-good tag remains)."""
+
+
+class ReferenceCheckpointError(RuntimeError):
+    """The directory holds reference-DeepSpeed torch shards, not an orbax
+    checkpoint — loudly reject with the migration path (ROADMAP item 5)."""
+
+
+def _metrics():
+    """Checkpoint counter family; None when telemetry is disabled (the one
+    boolean check contract)."""
+    from deepspeed_tpu import telemetry
+    if not telemetry.is_active():
+        return None
+    reg = telemetry.get_registry()
+    return {
+        "saves": reg.counter("checkpoint_saves_total",
+                             "Committed (manifest-sealed) checkpoint saves"),
+        "verify_failures": reg.counter(
+            "checkpoint_verify_failures_total",
+            "Checkpoint tags that failed manifest verification (torn/corrupt)"),
+        "fallbacks": reg.counter(
+            "checkpoint_load_fallbacks_total",
+            "Loads that skipped a bad tag and fell back to an older good one"),
+        "pruned": reg.counter("checkpoint_pruned_total",
+                              "Checkpoint tags deleted by keep-last-K retention"),
+    }
+
+
+def _count(name):
+    m = _metrics()
+    if m is not None:
+        m[name].inc()
 
 
 class CheckpointEngine:
@@ -87,32 +146,379 @@ def checkpoint_barrier(engine):
             raise RuntimeError(f"async checkpoint commit failed: {err[1]}") from err[1]
 
 
-def _write_host_state(path, save_dir, tag, host_state, save_latest):
+def close_async_checkpointer(engine):
+    """Drain + close the engine's async checkpointer (engine.destroy path):
+    the last save commits (or its failure surfaces) and orbax's background
+    threads are joined, so interpreter teardown can never tear a commit."""
+    checkpoint_barrier(engine)
+    st = getattr(engine, "_async_ckpt", None)
+    if st and st.get("ckptr") is not None:
+        ck, st["ckptr"] = st["ckptr"], None
+        ck.wait()
+
+
+def _atexit_barrier(engine_ref):
+    """atexit hook (weakref'd): an in-flight async commit always lands before
+    the interpreter tears down orbax's machinery — the regression was a save
+    dispatched moments before exit leaving a torn state dir."""
+    engine = engine_ref()
+    if engine is None:
+        return
+    try:
+        close_async_checkpointer(engine)
+    except Exception as e:  # exit path: report, never mask other teardown
+        logger.error(f"async checkpoint commit failed during interpreter "
+                     f"exit: {e}")
+
+
+# --------------------------------------------------------------- checksums --
+def _crc32_bytes(data, crc=0):
+    return zlib.crc32(data, crc) & 0xFFFFFFFF
+
+
+def _file_crc32(path, chunk=1 << 20):
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            crc = zlib.crc32(block, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _walk_files(root):
+    """{relpath: {"size", "crc32"}} for every regular file under ``root``,
+    excluding the manifest itself (it seals the others)."""
+    out = {}
+    for dirpath, _, filenames in os.walk(root):
+        for fname in sorted(filenames):
+            fp = os.path.join(dirpath, fname)
+            rel = os.path.relpath(fp, root)
+            if rel == MANIFEST_FILE or not os.path.isfile(fp):
+                continue
+            out[rel] = {"size": os.path.getsize(fp), "crc32": _file_crc32(fp)}
+    return out
+
+
+def array_checksums(tree):
+    """Per-leaf ``{path: {crc32, dtype, shape}}`` over a pytree of arrays —
+    the training-state analog of the handoff frame's ``kv_crc32``. Computed
+    from a host copy leaf-at-a-time (peak extra memory = one leaf). Returns
+    None when any leaf is not fully addressable from this process (multi-host
+    meshes: the file-level manifest still covers integrity)."""
     import jax
-    # host-side metadata is identical on every process; only rank 0 writes it
-    # (shared-filesystem checkpoints must not see N concurrent writers)
-    if jax.process_index() == 0:
-        with open(os.path.join(path, "host_state.pkl"), "wb") as f:
-            pickle.dump(host_state, f)
-        if save_latest:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(str(tag))
+
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = {}
+    for keypath, leaf in leaves:
+        if leaf is None:
+            continue
+        if not getattr(leaf, "is_fully_addressable", True):
+            return None
+        arr = np.asarray(jax.device_get(leaf))
+        out[jax.tree_util.keystr(keypath)] = {
+            # crc over the buffer itself (no payload-sized .tobytes() copy —
+            # the same memoryview treatment the handoff kv_crc32 got)
+            "crc32": _crc32_bytes(memoryview(np.ascontiguousarray(arr)).cast("B")),
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape),
+        }
+    return out
+
+
+def _verify_array_checksums(tree, want):
+    """Diff a restored pytree against the manifest's per-array CRCs; returns
+    the list of mismatched paths."""
+    got = array_checksums(tree)
+    if got is None:
+        return []
+    bad = []
+    for path, info in (want or {}).items():
+        g = got.get(path)
+        if g is None or g["crc32"] != info["crc32"]:
+            bad.append(path)
+    return bad
+
+
+# ---------------------------------------------------------------- manifest --
+def write_manifest(path, meta):
+    """Seal a checkpoint directory: walk + checksum every committed file,
+    then write MANIFEST.json atomically (tmp + rename) — the LAST write, so
+    manifest-present ⟺ commit-completed."""
+    manifest = dict(meta)
+    manifest["format"] = MANIFEST_FORMAT
+    manifest["files"] = _walk_files(path)
+    tmp = os.path.join(path, f".{MANIFEST_FILE}.tmp.{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(path, MANIFEST_FILE))
+    return manifest
+
+
+def read_manifest(path):
+    """The manifest dict, or None when absent (torn). Malformed JSON raises
+    ValueError (corrupt)."""
+    mf = os.path.join(path, MANIFEST_FILE)
+    if not os.path.isfile(mf):
+        return None
+    try:
+        with open(mf) as f:
+            return json.load(f)
+    except (json.JSONDecodeError, OSError) as e:
+        raise ValueError(f"manifest unreadable: {e}") from e
+
+
+def verify_checkpoint(path):
+    """Integrity verdict for one checkpoint directory:
+
+    - ``("good", detail)`` — manifest present, every sealed file exists with
+      matching size and CRC32;
+    - ``("torn", detail)`` — no manifest (crash mid-commit) or a sealed file
+      is missing;
+    - ``("corrupt", detail)`` — manifest unreadable, or a sealed file's
+      size/CRC32 disagrees with the manifest;
+    - ``("reference", detail)`` — reference-DeepSpeed torch shards (the load
+      path raises :class:`ReferenceCheckpointError` for these instead).
+    """
+    if not os.path.isdir(path):
+        return "torn", "checkpoint directory does not exist"
+    try:
+        detect_reference_checkpoint(path)
+    except ReferenceCheckpointError as e:
+        return "reference", str(e)
+    try:
+        manifest = read_manifest(path)
+    except ValueError as e:
+        return "corrupt", str(e)
+    if manifest is None:
+        return "torn", f"no {MANIFEST_FILE} (commit never completed)"
+    for rel, info in manifest.get("files", {}).items():
+        fp = os.path.join(path, rel)
+        if not os.path.isfile(fp):
+            return "torn", f"sealed file missing: {rel}"
+        size = os.path.getsize(fp)
+        if size != info["size"]:
+            return "corrupt", f"{rel}: size {size} != sealed {info['size']}"
+        if _file_crc32(fp) != info["crc32"]:
+            return "corrupt", f"{rel}: crc32 mismatch"
+    return "good", f"{len(manifest.get('files', {}))} files verified"
+
+
+def detect_reference_checkpoint(path):
+    """Raise :class:`ReferenceCheckpointError` when ``path`` holds the
+    reference (torch) DeepSpeed's per-rank shard files — the GPU→TPU
+    migration trap (ROADMAP item 5, reject half): an orbax restore over them
+    dies with an opaque tensorstore error; name the problem and the path."""
+    if not os.path.isdir(path):
+        return
+    hits = [name for name in sorted(os.listdir(path))
+            if name.startswith(_REFERENCE_SHARD_PREFIXES)]
+    if hits:
+        raise ReferenceCheckpointError(
+            f"{path} is a reference DeepSpeed (torch) checkpoint — found "
+            f"per-rank shard files {hits[:4]}{'...' if len(hits) > 4 else ''}. "
+            f"deepspeed_tpu loads sharded orbax/tensorstore checkpoints. "
+            f"Migration path: convert with the reference's "
+            f"checkpoint/ds_to_universal.py (universal checkpoint) and ingest "
+            f"via the orbax reshard-on-load path (ROADMAP item 5), or re-save "
+            f"from this engine with engine.save_checkpoint().")
+
+
+def list_tags(save_dir):
+    """Candidate checkpoint tags under ``save_dir``, NEWEST FIRST, each as
+    ``{"tag", "path", "manifest", "status", "detail"}``. Newest = highest
+    manifest ``global_steps`` (mtime tiebreak; manifest-less dirs sort by
+    mtime only). ``status`` here is the cheap verdict (manifest presence /
+    readability); full CRC verification is :func:`verify_checkpoint`."""
+    save_dir = os.path.abspath(save_dir)
+    if not os.path.isdir(save_dir):
+        return []
+    out = []
+    for name in os.listdir(save_dir):
+        path = os.path.join(save_dir, name)
+        if not os.path.isdir(path):
+            continue
+        looks_like_ckpt = (os.path.isfile(os.path.join(path, MANIFEST_FILE))
+                           or os.path.isfile(os.path.join(path, "host_state.pkl"))
+                           or os.path.isdir(os.path.join(path, "state")))
+        if not looks_like_ckpt:
+            continue
+        entry = {"tag": name, "path": path, "manifest": None,
+                 "status": "torn", "detail": f"no {MANIFEST_FILE}",
+                 "mtime": os.path.getmtime(path)}
+        try:
+            manifest = read_manifest(path)
+            if manifest is not None:
+                entry.update(manifest=manifest, status="committed",
+                             detail="manifest present")
+        except ValueError as e:
+            entry.update(status="corrupt", detail=str(e))
+        out.append(entry)
+
+    def sort_key(entry):
+        # torn tags have no manifest: fall back to the step number embedded
+        # in conventional tag names (global_stepN / preempt_stepN), then mtime
+        manifest = entry["manifest"] or {}
+        step = manifest.get("global_steps")
+        if step is None:
+            match = re.search(r"(\d+)$", entry["tag"])
+            step = int(match.group(1)) if match else -1
+        return (step, entry["mtime"])
+
+    out.sort(key=sort_key, reverse=True)
+    return out
+
+
+def retention_plan(save_dir, keep_last_k):
+    """``(keep, drop)`` tag-entry lists for keep-last-K retention. The newest
+    K tags survive; the newest *committed* (manifest-sealed) tag ALWAYS
+    survives even when older than the window — retention must never delete
+    the last good checkpoint. Sealed ≠ CRC-verified (a full CRC walk per
+    save would read every checkpoint back): a sealed-but-corrupted-in-place
+    newest tag can satisfy the protection, which is why chaos/flaky-disk
+    environments should run ``keep_last_k`` ≥ 2 (README)."""
+    tags = list_tags(save_dir)
+    if keep_last_k is None or keep_last_k <= 0 or len(tags) <= keep_last_k:
+        return tags, []
+    keep = tags[:keep_last_k]
+    drop = tags[keep_last_k:]
+    if not any(e["status"] == "committed" for e in keep):
+        for e in list(drop):
+            if e["status"] == "committed":
+                drop.remove(e)
+                keep.append(e)
+                break
+    return keep, drop
+
+
+def prune_checkpoints(save_dir, keep_last_k):
+    """Apply :func:`retention_plan`: delete the dropped tags. Returns the
+    deleted tag names."""
+    _, drop = retention_plan(save_dir, keep_last_k)
+    deleted = []
+    for entry in drop:
+        try:
+            shutil.rmtree(entry["path"])
+            deleted.append(entry["tag"])
+            _count("pruned")
+        except OSError as e:  # a stuck delete must not fail the save
+            logger.warning(f"checkpoint retention: could not delete "
+                           f"{entry['path']}: {e}")
+    if deleted:
+        logger.info(f"checkpoint retention: pruned {deleted} "
+                    f"(keep_last_k={keep_last_k})")
+    return deleted
+
+
+# -------------------------------------------------------------------- save --
+def _world_meta(engine):
+    import jax
+    return {
+        "process_count": jax.process_count(),
+        "device_count": jax.device_count(),
+        "mesh": {str(k): int(v) for k, v in dict(engine.mesh.shape).items()},
+    }
+
+
+def _manifest_meta(engine, tag, host_state, arrays_crc, keep_last_k):
+    """The manifest body, snapshotted SYNCHRONOUSLY at save time — an async
+    finalize thread must seal the dispatch-time state, not whatever steps the
+    training thread has taken since."""
+    import time
+    return {
+        "tag": str(tag),
+        "global_steps": host_state["global_steps"],
+        "global_samples": host_state["global_samples"],
+        "micro_steps": host_state["micro_steps"],
+        "skipped_steps": host_state["skipped_steps"],
+        "loss_scale": {k: float(np.asarray(v))
+                       for k, v in engine.scale_state._asdict().items()},
+        "rng": np.asarray(host_state["rng"]).tolist()
+               if host_state.get("rng") is not None else None,
+        "data_state": _jsonable(host_state.get("client_state")),
+        "world": _world_meta(engine),
+        "keep_last_k": keep_last_k,
+        "saved_unix": time.time(),
+        "arrays": arrays_crc,
+    }
+
+
+def _commit_host_side(engine, path, save_dir, tag, host_state, save_latest,
+                      manifest_meta, keep_last_k):
+    """The durable-marker tail of a save, strictly ordered AFTER the array
+    commit: host_state.pkl → MANIFEST.json (atomic, the commit marker) →
+    ``latest`` pointer → retention. Only process 0 writes (shared-filesystem
+    checkpoints must not see N concurrent writers)."""
+    import jax
+    if jax.process_index() != 0:
+        return
+    with open(os.path.join(path, "host_state.pkl"), "wb") as f:
+        pickle.dump(host_state, f)
+    write_manifest(path, manifest_meta)
+    if save_latest:
+        # atomic like the manifest: a crash mid-write must never leave an
+        # empty/half-written pointer for the next load to chase
+        tmp = os.path.join(save_dir, f".{LATEST_FILE}.tmp.{os.getpid()}")
+        with open(tmp, "w") as f:
+            f.write(str(tag))
+        os.replace(tmp, os.path.join(save_dir, LATEST_FILE))
+    _count("saves")
+    if keep_last_k > 0:
+        prune_checkpoints(save_dir, keep_last_k)
+    _maybe_inject_checkpoint_fault(engine, path)
+
+
+def _jsonable(obj):
+    """client/dataloader state for the manifest: best-effort JSON projection
+    (the authoritative copy lives in host_state.pkl, CRC-sealed)."""
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        return repr(obj)
+
+
+def _maybe_inject_checkpoint_fault(engine, path):
+    """Training chaos harness hook (runtime/faults.py): a seeded injector may
+    corrupt or truncate the checkpoint that was JUST committed — the torn/
+    corrupt fallback path becomes provable end-to-end."""
+    inj = getattr(engine, "_train_faults", None)
+    if inj is None:
+        return
+    n = inj.fire("checkpoint_corrupt")
+    if n is not None:
+        inj.corrupt_checkpoint(path, n)
+    if inj.fire("checkpoint_truncate") is not None:
+        inj.truncate_checkpoint(path)
 
 
 def save_engine_state(engine, save_dir, tag, client_state, save_latest,
                       async_save=False):
     """``async_save`` (reference nebula_checkpoint_engine.py role): the array
     commit proceeds on background threads while training continues; the
-    host-state + ``latest`` marker are written only AFTER the commit is
-    durable, so a crash mid-commit leaves the previous checkpoint current
-    (the reference's tier-commit semantics). ``checkpoint_barrier`` (taken by
-    the next save/load) bounds in-flight saves to one."""
+    host-state + MANIFEST + ``latest`` marker are written only AFTER the
+    commit is durable, so a crash mid-commit leaves the previous checkpoint
+    current (the reference's tier-commit semantics) and torn by construction
+    (no manifest). ``checkpoint_barrier`` (taken by the next save/load, engine
+    close, and atexit) bounds in-flight saves to one."""
     import threading
 
     path = _ckpt_path(save_dir, tag)
     os.makedirs(save_dir, exist_ok=True)
 
     checkpoint_barrier(engine)  # previous in-flight save must land first
+
+    # re-saving an existing tag (e.g. replaying steps after a sentinel
+    # rollback): drop the stale manifest FIRST, synchronously — while the
+    # state dir is being rewritten the tag must read as torn, never as a
+    # valid-looking seal over mismatched files
+    import jax as _jax
+    stale_manifest = os.path.join(path, MANIFEST_FILE)
+    if _jax.process_index() == 0 and os.path.isfile(stale_manifest):
+        os.unlink(stale_manifest)
 
     arrays = {
         "params": engine.params,
@@ -126,21 +532,40 @@ def save_engine_state(engine, save_dir, tag, client_state, save_latest,
         "skipped_steps": int(engine._overflow_count),
         "current_lr": engine._current_lr,
         "lr_scheduler": engine.lr_scheduler.state_dict() if engine.lr_scheduler is not None else None,
+        # the per-step rng stream: restoring it makes a resumed run replay the
+        # EXACT step sequence an uninterrupted run would have taken (the
+        # chaos-equivalence gate's requirement)
+        "rng": np.asarray(engine._rng),
         "ds_config": engine._config._param_dict,
         "client_state": client_state,
     }
+    ck_cfg = getattr(engine._config, "checkpoint_config", None)
+    keep_last_k = int(getattr(ck_cfg, "keep_last_k", 0) or 0)
+    # per-array CRCs are computed from a synchronous host snapshot (the async
+    # path must checksum BEFORE later donated train steps invalidate the
+    # buffers — same reason orbax stages synchronously)
+    arrays_crc = array_checksums(arrays) \
+        if getattr(ck_cfg, "array_checksums", True) else None
+    manifest_meta = _manifest_meta(engine, tag, host_state, arrays_crc,
+                                   keep_last_k)
 
     if not async_save:
         ck = OrbaxCheckpointEngine()
         ck.save(arrays, os.path.join(path, "state"))
         ck.wait()  # checkpoint must be durable before save_checkpoint returns
-        _write_host_state(path, save_dir, tag, host_state, save_latest)
+        _commit_host_side(engine, path, save_dir, tag, host_state, save_latest,
+                          manifest_meta, keep_last_k)
         logger.info(f"Saved checkpoint to {path}")
         return True
 
     st = getattr(engine, "_async_ckpt", None)
     if st is None:
         st = engine._async_ckpt = {"thread": None, "ckptr": None}
+        # the atexit barrier guarantees the LAST async save of a short-lived
+        # trainer still commits (or fails loudly) before interpreter teardown
+        import atexit
+        import weakref
+        atexit.register(_atexit_barrier, weakref.ref(engine))
     if st["ckptr"] is None:
         st["ckptr"] = OrbaxCheckpointEngine(use_async=True)
     ck = st["ckptr"]
@@ -151,7 +576,8 @@ def save_engine_state(engine, save_dir, tag, client_state, save_latest,
     def finalize():
         try:
             ck.finish()
-            _write_host_state(path, save_dir, tag, host_state, save_latest)
+            _commit_host_side(engine, path, save_dir, tag, host_state,
+                              save_latest, manifest_meta, keep_last_k)
             logger.info(f"Async checkpoint committed to {path}")
         except BaseException as e:  # surfaced at the next checkpoint_barrier
             st["error"] = (tag, e)
@@ -166,21 +592,104 @@ def save_engine_state(engine, save_dir, tag, client_state, save_latest,
     return True
 
 
+# -------------------------------------------------------------------- load --
 def load_engine_state(engine, load_dir, tag, load_optimizer_states=True, load_lr_scheduler_states=True,
                       load_module_only=False):
-    import jax
+    """Verified restore. An explicit ``tag`` is authoritative: a torn/corrupt
+    tag raises :class:`CheckpointCorruptionError`. ``tag=None`` asks for the
+    newest state: the ``latest`` pointer is tried first, then every other tag
+    newest-first — each bad tag is skipped LOUDLY (error log +
+    ``checkpoint_load_fallbacks_total``), and only when NO verified-good tag
+    remains does the load raise. An empty directory (nothing ever committed)
+    still returns ``(None, None)`` — a fresh start, not a failure."""
     checkpoint_barrier(engine)  # an in-flight async save must land first
-    if tag is None:
+    load_dir = os.path.abspath(load_dir)
+    detect_reference_checkpoint(load_dir)
+    ck_cfg = getattr(engine._config, "checkpoint_config", None)
+    verify = bool(getattr(ck_cfg, "verify_on_load", True))
+
+    explicit = tag is not None
+    if explicit:
+        candidates = [str(tag)]
+    else:
+        tags = list_tags(load_dir)
         latest = os.path.join(load_dir, LATEST_FILE)
-        if not os.path.isfile(latest):
-            logger.warning(f"Unable to find latest file at {latest}, returning (None, None)")
+        pointed = None
+        if os.path.isfile(latest):
+            with open(latest) as f:
+                pointed = f.read().strip()
+        # Fresh start ⟺ nothing was ever COMMITTED: no `latest` pointer (it
+        # is written after the first manifest) and no tag carrying a manifest
+        # (readable or not). Covers the empty dir, a dangling `latest` with
+        # wiped tags, and a crash during the very FIRST save (torn partial
+        # state dir) — none of which may crash-loop a supervisor.
+        committed_any = any(e["status"] != "torn" for e in tags)
+        pointed_exists = pointed is not None and \
+            os.path.isdir(_ckpt_path(load_dir, pointed))
+        if not committed_any and not pointed_exists:
+            logger.warning(
+                f"nothing ever committed under {load_dir} "
+                f"(latest={'missing' if pointed is None else pointed!r}, "
+                f"{len(tags)} torn partial tag(s)), returning (None, None)")
             return None, None
-        with open(latest) as f:
-            tag = f.read().strip()
-    path = _ckpt_path(load_dir, tag)
-    if not os.path.isdir(path):
-        logger.warning(f"Checkpoint path {path} does not exist")
-        return None, None
+        candidates = ([pointed] if pointed is not None else []) + \
+            [e["tag"] for e in tags if e["tag"] != pointed]
+
+    failures = []
+    for i, tg in enumerate(candidates):
+        path = _ckpt_path(load_dir, tg)
+        if not os.path.isdir(path):
+            msg = f"checkpoint path {path} does not exist"
+            if explicit:
+                # explicit tags are authoritative: a typo'd tag must not
+                # read as a silent fresh start
+                raise CheckpointCorruptionError(msg)
+            failures.append(msg)
+            logger.error(msg + "; trying the next newest tag")
+            continue
+        detect_reference_checkpoint(path)  # never a silent orbax stacktrace
+        if verify:
+            status, detail = verify_checkpoint(path)
+            if status != "good":
+                _count("verify_failures")
+                msg = f"checkpoint {path} is {status.upper()}: {detail}"
+                if explicit:
+                    raise CheckpointCorruptionError(msg)
+                _count("fallbacks")
+                failures.append(msg)
+                logger.error(f"{msg} — falling back to the newest "
+                             f"verified-good tag")
+                continue
+        try:
+            return _restore_into_engine(
+                engine, path, load_optimizer_states=load_optimizer_states,
+                load_lr_scheduler_states=load_lr_scheduler_states,
+                load_module_only=load_module_only,
+                verify_arrays=verify and bool(
+                    getattr(ck_cfg, "verify_arrays_on_load", False)))
+        except ReferenceCheckpointError:
+            raise
+        except Exception as e:
+            # includes an array-seal CheckpointCorruptionError from
+            # _restore_into_engine (raised BEFORE any engine state mutates):
+            # under tag=None it is one more bad tag to skip, not a dead end
+            if explicit:
+                raise
+            _count("verify_failures")
+            _count("fallbacks")
+            msg = f"checkpoint {path} failed to restore: {e}"
+            failures.append(msg)
+            logger.error(f"{msg} — falling back to the newest "
+                         f"verified-good tag")
+            continue
+    raise CheckpointCorruptionError(
+        f"no verified-good checkpoint under {load_dir}: " + "; ".join(failures))
+
+
+def _restore_into_engine(engine, path, load_optimizer_states,
+                         load_lr_scheduler_states, load_module_only,
+                         verify_arrays):
+    import jax
 
     ck = OrbaxCheckpointEngine()
     # Restore against the engine's current shardings → automatic resharding
@@ -191,6 +700,15 @@ def load_engine_state(engine, load_dir, tag, load_optimizer_states=True, load_lr
         "scale_state": {k: v for k, v in engine.scale_state._asdict().items()},
     }
     restored = ck.load(os.path.join(path, "state"), target=target)
+
+    if verify_arrays:
+        manifest = read_manifest(path) or {}
+        bad = _verify_array_checksums(restored, manifest.get("arrays"))
+        if bad:
+            raise CheckpointCorruptionError(
+                f"checkpoint {path}: restored arrays fail the manifest's "
+                f"per-array CRC32 ({bad[:4]}{'...' if len(bad) > 4 else ''})")
+
     engine.params = jax.device_put(restored["params"], engine._param_shardings)
     if load_optimizer_states and not load_module_only:
         # restore straight into the at-rest placement (pinned host when
@@ -214,6 +732,10 @@ def load_engine_state(engine, load_dir, tag, load_optimizer_states=True, load_lr
         engine.micro_steps = host_state["micro_steps"]
         engine._current_lr = host_state["current_lr"]
         engine._overflow_count = jnp.asarray(host_state.get("skipped_steps", 0), jnp.int32)
+        if host_state.get("rng") is not None:
+            # resume the per-step rng stream exactly (pre-manifest checkpoints
+            # lack it; they keep the engine's fresh key)
+            engine._rng = jnp.asarray(np.asarray(host_state["rng"]))
         if load_lr_scheduler_states and engine.lr_scheduler is not None and host_state["lr_scheduler"]:
             engine.lr_scheduler.load_state_dict(host_state["lr_scheduler"])
     logger.info(f"Loaded checkpoint from {path}")

@@ -61,6 +61,31 @@ class CheckpointConfig(DeepSpeedConfigModel):
     use_node_local_storage: bool = False
     parallel_write: dict = {}
 
+    # -- crash consistency (ISSUE 11; checkpoint_engine/engine.py) --
+    keep_last_k: int = Field(0, ge=0)
+    """Retention: keep only the newest K checkpoint tags after each commit
+    (0 = unlimited). The newest manifest-sealed tag is NEVER deleted, even
+    when older than the window — retention cannot eat the last good one."""
+
+    array_checksums: bool = True
+    """Record per-array CRC32s in the manifest at save (a synchronous host
+    snapshot per leaf — the training-state ``kv_crc32``)."""
+
+    verify_on_load: bool = True
+    """Verify the manifest (file sizes + CRC32s) before restoring; a torn or
+    corrupt tag falls back loudly to the newest verified-good one."""
+
+    verify_arrays_on_load: bool = False
+    """Additionally re-checksum every restored array against the manifest's
+    per-array CRC32s (catches corruption below the file layer; costs one
+    host pass over the restored state)."""
+
+    preemption_grace_s: float = Field(30.0, gt=0)
+    """Budget between a preemption signal (SIGTERM) and process exit: the
+    engine finishes the in-flight step, drains any async save, and writes
+    the final synchronous checkpoint inside this window
+    (``engine.install_preemption_handler``)."""
+
 
 class DataTypesConfig(DeepSpeedConfigModel):
     grad_accum_dtype: Optional[str] = None
@@ -150,6 +175,8 @@ class DeepSpeedConfig:
         from deepspeed_tpu.telemetry.config import TelemetryConfig
         self.telemetry_config = TelemetryConfig(**pd.get("telemetry", {}))
         self.checkpoint_config = CheckpointConfig(**pd.get(C.CHECKPOINT, {}))
+        from deepspeed_tpu.runtime.sentinel import AnomalySentinelConfig
+        self.anomaly_sentinel_config = AnomalySentinelConfig(**pd.get("anomaly_sentinel", {}))
         self.data_types_config = DataTypesConfig(**pd.get(C.DATA_TYPES, {}))
         self.aio_config = AioConfig(**pd.get("aio", {}))
         self.elasticity_config = ElasticityConfig(**pd.get("elasticity", {}))

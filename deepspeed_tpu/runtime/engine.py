@@ -24,6 +24,9 @@ Reference call-stack parity notes are inline; see SURVEY.md §3.1/§3.2.
 import functools
 import inspect
 import os
+import signal
+import threading
+import time
 from typing import Any, Callable, Optional
 
 import numpy as np
@@ -47,6 +50,20 @@ from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER, BACKWARD_MICRO_TIM
                                        ThroughputTimer)
 
 MEMORY_OPT_ALLREDUCE_SIZE = 500000000
+
+
+class TrainingPreempted(SystemExit):
+    """Raised after a preemption-triggered final checkpoint committed: exits
+    the process with code 143 (the SIGTERM convention) so a supervisor can
+    tell a preemption-safe exit from a crash. Carries the final checkpoint
+    ``tag`` (None when no save directory was known) and the ``step``."""
+
+    EXIT_CODE = 143
+
+    def __init__(self, tag, step):
+        super().__init__(self.EXIT_CODE)
+        self.tag = tag
+        self.step = step
 
 
 def _make_optimizer(name, params_cfg):
@@ -429,6 +446,24 @@ class DeepSpeedEngine:
             from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
             self.curriculum_scheduler = CurriculumScheduler(self._config.curriculum_params_legacy)
 
+        # 12. training fault tolerance (ISSUE 11): loss-anomaly sentinel
+        # (skip-step finite gate + rollback-to-last-good), preemption-safe
+        # exit, and the seeded training chaos injector. All disabled-by-
+        # default; disabled costs one None/bool check per hook.
+        sent_cfg = self._config.anomaly_sentinel_config
+        self._anomaly_guard = sent_cfg.enabled
+        self._sentinel = None
+        if sent_cfg.enabled:
+            from deepspeed_tpu.runtime.sentinel import LossAnomalySentinel
+            self._sentinel = LossAnomalySentinel(sent_cfg)
+        from deepspeed_tpu.runtime.faults import injector_from_env
+        self._train_faults = injector_from_env(os.environ.get("DSTPU_TRAIN_FAULTS"))
+        self._ckpt_save_dir = None
+        self._sentinel_good_step = None
+        self._preempt_event = None
+        self._preempt_cfg = None
+        self._preempt_at = None
+
         self._compiled = {}
         self._flops_profiled = False
         self._last_step_applied = False
@@ -717,19 +752,23 @@ class DeepSpeedEngine:
         offload = self._offload
         param_shardings = self._param_shardings
         grad_shardings = self._grad_shardings
+        # fp16 always gates on finite grads (overflow skip); the anomaly
+        # sentinel arms the same gate for every precision — a NaN/inf step
+        # never touches the weights (skip-step), it only counts as skipped
+        finite_guard = fp16 or self._anomaly_guard
         gas = self._apply_gas_divisor if self._apply_gas_divisor is not None \
             else float(self.gradient_accumulation_steps())
 
         def fn(params, opt_state, acc_grads, scale_state, lr):
             inv = (1.0 / (scale_state.cur_scale * gas))
             grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, acc_grads)
-            finite = tree_all_finite(grads) if fp16 else jnp.asarray(True)
+            finite = tree_all_finite(grads) if finite_guard else jnp.asarray(True)
             norm = global_norm(grads)
             if clip > 0.0:
                 grads, norm = clip_grads_by_global_norm(grads, clip, norm=norm)
             new_params, new_opt = offload.run_update(optimizer, grads, opt_state, params, lr,
                                                      param_shardings, grad_shardings,
-                                                     finite=finite if fp16 else None)
+                                                     finite=finite if finite_guard else None)
             if fp16:
                 scale_state = update_scale(scale_state,
                                            ~finite,
@@ -838,6 +877,7 @@ class DeepSpeedEngine:
                 self._write_monitor()
             if self._telemetry is not None:
                 self._write_telemetry(loss=self._cached_loss)
+            self._after_boundary_step(self._cached_loss)
         self.micro_steps += 1
         self.timers(STEP_MICRO_TIMER).stop()
 
@@ -845,12 +885,172 @@ class DeepSpeedEngine:
         """Advance the LR schedule unless this step overflowed (reference
         _take_model_step, engine.py:2100-2106: overflow-skipped steps must not
         advance warmup/decay). The host read of the overflow flag — a device
-        sync — only happens under fp16; bf16 stays fully async."""
-        if self._fp16 and bool(overflow):
+        sync — only happens under fp16 (or with the anomaly sentinel's
+        all-precision skip-step gate armed); plain bf16 stays fully async."""
+        if (self._fp16 or self._anomaly_guard) and bool(overflow):
             return  # skipped step: schedule frozen; count lives in _overflow_count
         if self.lr_scheduler is not None:
             self.lr_scheduler.step(**lr_kwargs)
             self._current_lr = self.lr_scheduler.get_last_lr()[0]
+
+    # ------------------------------------------------------- fault tolerance --
+    def _after_boundary_step(self, loss):
+        """Fault-tolerance hooks at a COMPLETED optimizer step: sentinel
+        observation (anomaly counting / rollback), chaos kill/sigterm points,
+        and the preemption finalizer — the 'finish the in-flight step, then
+        act' ordering."""
+        if self._sentinel is not None and loss is not None:
+            self._observe_loss(loss)
+        inj = self._train_faults
+        if inj is not None:
+            if inj.fire_step("sigterm_at_step", self.global_steps) is not None:
+                logger.error(f"chaos: SIGTERM at step {self.global_steps}")
+                os.kill(os.getpid(), signal.SIGTERM)
+            if inj.fire_step("kill_at_step", self.global_steps) is not None:
+                logger.error(f"chaos: SIGKILL at step {self.global_steps}")
+                os.kill(os.getpid(), signal.SIGKILL)
+        self._maybe_finalize_preemption()
+
+    def _observe_loss(self, loss):
+        from deepspeed_tpu.runtime import sentinel as _sentinel_mod
+        try:
+            value = float(loss)  # device sync; the sentinel is opt-in
+        except (TypeError, ValueError):
+            return
+        verdict = self._sentinel.observe(value)
+        if verdict == _sentinel_mod.OK:
+            # the rollback horizon: checkpoints at-or-before this step hold
+            # pre-anomaly weights (a spike APPLIES its update — a loop that
+            # saves every step would otherwise checkpoint the divergence and
+            # make rolling back to "newest" a no-op)
+            self._sentinel_good_step = self.global_steps
+        elif verdict == _sentinel_mod.ROLLBACK:
+            self._sentinel_rollback()
+
+    def _sentinel_rollback(self):
+        """M consecutive anomalies: reload the newest verified-good
+        checkpoint taken at-or-before the last HEALTHY step (not just the
+        newest — post-divergence saves must not be the rollback target).
+        Candidates are picked by the CHEAP manifest-presence status;
+        load_checkpoint's verify_on_load does the single authoritative CRC
+        pass, and a tag it rejects just advances to the next candidate."""
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import (
+            CheckpointCorruptionError, list_tags)
+        cfg = self._sentinel.config
+        save_dir = self._ckpt_save_dir
+        if not cfg.rollback or save_dir is None:
+            logger.error(f"anomaly sentinel: escalation without rollback "
+                         f"(rollback={cfg.rollback}, checkpoint dir known="
+                         f"{save_dir is not None}); training continues on the "
+                         f"anomalous state")
+            return
+        horizon = self._sentinel_good_step
+        for entry in list_tags(save_dir):
+            step = (entry["manifest"] or {}).get("global_steps")
+            if entry["status"] != "committed":
+                continue
+            if horizon is not None and (step is None or step > horizon):
+                continue  # saved after the divergence started
+            logger.error(f"anomaly sentinel: rolling back to {entry['tag']} "
+                         f"under {save_dir} (last healthy step: {horizon})")
+            self.zero_grad()
+            try:
+                path, _ = self.load_checkpoint(save_dir, tag=entry["tag"])
+            except CheckpointCorruptionError as e:
+                logger.error(f"anomaly sentinel: rollback target bad "
+                             f"({e}); trying the next older tag")
+                continue
+            logger.warning(f"anomaly sentinel: resumed from {path} "
+                           f"(step {self.global_steps})")
+            return
+        # no committed tag at-or-before the divergence: loading anything
+        # newer would "roll back" INTO the diverged state — refuse instead
+        logger.error(f"anomaly sentinel: no usable checkpoint at-or-before "
+                     f"the last healthy step {horizon} under {save_dir}; "
+                     f"NOT rolling back — training continues")
+
+    def install_preemption_handler(self, save_dir=None, grace_s=None,
+                                   signals=(signal.SIGTERM, )):
+        """Convert a preemption notice (SIGTERM by default) into a safe exit:
+        the in-flight step finishes, any async (nebula) save drains, a final
+        SYNCHRONOUS checkpoint commits within ``grace_s``
+        (``checkpoint.preemption_grace_s`` when unset), a resume marker
+        (``PREEMPTED.json``) lands next to ``latest``, and the process exits
+        via :class:`TrainingPreempted` (code 143). ``save_dir`` defaults to
+        the last ``save_checkpoint`` directory. Must be called from the main
+        thread (signal module constraint)."""
+        self._preempt_cfg = {
+            "save_dir": os.path.abspath(save_dir) if save_dir else None,
+            "grace_s": float(grace_s) if grace_s is not None
+            else self._config.checkpoint_config.preemption_grace_s,
+        }
+        self._preempt_event = threading.Event()
+
+        def _on_preempt(signum, frame):
+            # async-signal-safe: flag + timestamp only; logging happens at
+            # the next step boundary on the training thread
+            self._preempt_at = time.monotonic()
+            self._preempt_event.set()
+
+        for sig in signals:
+            signal.signal(sig, _on_preempt)
+        return self
+
+    @property
+    def preemption_requested(self) -> bool:
+        """True once a preemption signal arrived (the finalizer runs at the
+        next step boundary; loops with long gaps between steps can poll this
+        and call :meth:`finalize_preemption` themselves)."""
+        return self._preempt_event is not None and self._preempt_event.is_set()
+
+    def _maybe_finalize_preemption(self):
+        if self.preemption_requested:
+            self.finalize_preemption()
+
+    def finalize_preemption(self):
+        """The preemption-safe exit sequence (does not return): drain any
+        async save, write the final synchronous checkpoint + resume marker,
+        then raise :class:`TrainingPreempted`."""
+        import json as _json
+
+        import jax
+        cfg = self._preempt_cfg or {}
+        grace = cfg.get("grace_s") or self._config.checkpoint_config.preemption_grace_s
+        started = self._preempt_at or time.monotonic()
+        save_dir = cfg.get("save_dir") or self._ckpt_save_dir
+        tag = f"preempt_step{self.global_steps}"
+        logger.warning(f"preemption: draining async saves, final checkpoint "
+                       f"{tag} (grace {grace:.0f}s)")
+        if save_dir is not None:
+            from deepspeed_tpu.runtime.checkpoint_engine.engine import (
+                PREEMPT_MARKER, save_engine_state)
+            # save_engine_state takes the checkpoint barrier itself: the
+            # in-flight async commit lands before the final sync save starts
+            save_engine_state(self, save_dir, tag, {"preempted": True},
+                              save_latest=True, async_save=False)
+            used = time.monotonic() - started
+            if jax.process_index() == 0:
+                with open(os.path.join(save_dir, PREEMPT_MARKER), "w") as f:
+                    _json.dump({"tag": tag, "global_steps": self.global_steps,
+                                "grace_s": grace, "used_s": round(used, 3),
+                                "resume_dir": save_dir}, f)
+            level = logger.error if used > grace else logger.warning
+            level(f"preemption: final checkpoint {tag} committed in "
+                  f"{used:.1f}s (grace budget {grace:.0f}s"
+                  f"{' EXCEEDED' if used > grace else ''})")
+        else:
+            logger.error("preemption: no checkpoint directory known (pass "
+                         "save_dir to install_preemption_handler, or "
+                         "save_checkpoint once first); exiting WITHOUT a "
+                         "final checkpoint")
+        from deepspeed_tpu import telemetry as _tel
+        if _tel.is_active():
+            _tel.get_registry().counter(
+                "train_preemptions_total",
+                "Preemption notices converted into a final checkpoint + "
+                "clean exit").inc()
+        raise TrainingPreempted(tag if save_dir is not None else None,
+                                self.global_steps)
 
     def _apply_curriculum(self, batch):
         """Truncate the sequence dim to the current curriculum difficulty
@@ -922,6 +1122,9 @@ class DeepSpeedEngine:
         yielding micro-batches, or a pre-staged batch) → one jitted
         accumulate+step program."""
         import jax
+        # a preemption notice that arrived between steps exits BEFORE paying
+        # for another one (mid-step notices finalize at this step's end)
+        self._maybe_finalize_preemption()
         gas = self.gradient_accumulation_steps()
         if isinstance(batch, StagedBatch):
             batch = batch.tree
@@ -941,6 +1144,10 @@ class DeepSpeedEngine:
                     data_iter=itertools.chain([nxt], data_iter)).tree
         else:
             batch = self.stage_train_batch(batch=batch).tree
+        if self._train_faults is not None and \
+                self._train_faults.fire_step("nan_inject", self.global_steps) is not None:
+            logger.error(f"chaos: NaN injected into the batch for step {self.global_steps}")
+            batch = self._train_faults.poison_batch(batch)
         self._maybe_profile_flops(batch, micro_stacked=True)
         if self._telemetry is not None:
             _tel_t0 = _tel_now_us()
@@ -976,6 +1183,7 @@ class DeepSpeedEngine:
             self._write_monitor(loss=loss)
         if self._telemetry is not None:
             self._write_telemetry(loss=loss)
+        self._after_boundary_step(loss)
         return loss
 
     def _micro_stack_sharding(self, leaf):
@@ -997,6 +1205,14 @@ class DeepSpeedEngine:
 
     def destroy(self):
         """Release engine resources (reference engine.py destroy)."""
+        # the last async (nebula) save must commit — or surface its failure —
+        # before teardown tears orbax down (a torn state dir otherwise)
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import close_async_checkpointer
+        try:
+            close_async_checkpointer(self)
+        except Exception:
+            logger.exception("async checkpoint drain at destroy failed "
+                             "(the checkpoint is cleanly absent, never torn)")
         if hasattr(self._offload, "swapper"):
             self._offload.swapper.close()
         if self.monitor is not None and hasattr(self.monitor, "close"):
@@ -1599,15 +1815,21 @@ class DeepSpeedEngine:
                         exclude_frozen_parameters=False):
         """Reference engine.py:3052. One logical sharded checkpoint (orbax/tensorstore)
         replaces the reference's per-rank zero_pp_rank_* shard files; every chip
-        writes only its partition."""
+        writes only its partition. The commit is sealed by a ``MANIFEST.json``
+        (per-array + per-file CRC32) written last — see checkpoint_engine."""
         from deepspeed_tpu.runtime.checkpoint_engine.engine import save_engine_state
         tag = str(tag) if tag is not None else f"global_step{self.global_steps}"
         self._checkpoint_tag_validation(tag)
         # nebula.enabled → async (Nebula-class) save: commit overlaps the next
-        # train steps; durable-marker ordering preserved (checkpoint_engine)
+        # train steps; durable-marker ordering preserved (checkpoint_engine).
+        # (The preemption finalizer bypasses this method and calls
+        # save_engine_state synchronously — no cross-host tag broadcast while
+        # peers may already be dying.)
         async_save = bool(self._config.nebula_config.get("enabled", False))
         save_engine_state(self, save_dir, tag, client_state or {}, save_latest,
                           async_save=async_save)
+        # the sentinel's rollback target and the preemption handler's default
+        self._ckpt_save_dir = os.path.abspath(save_dir)
         return True
 
     def checkpoint_wait(self):
@@ -1619,12 +1841,19 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False, custom_load_fn=None):
         """Reference engine.py:2688. Restoring into the *current* mesh/sharding
-        reshards automatically — the universal-checkpoint path (SURVEY.md §5.4)."""
+        reshards automatically — the universal-checkpoint path (SURVEY.md §5.4).
+        The manifest is verified first; with ``tag=None`` a torn/corrupt tag
+        falls back LOUDLY to the newest verified-good one (checkpoint_engine)."""
         from deepspeed_tpu.runtime.checkpoint_engine.engine import load_engine_state
-        return load_engine_state(self, load_dir, tag,
-                                 load_optimizer_states=load_optimizer_states,
-                                 load_lr_scheduler_states=load_lr_scheduler_states,
-                                 load_module_only=load_module_only)
+        # NOTE: deliberately does NOT set _ckpt_save_dir — a load source may
+        # be a read-only/shared directory; only an actual save_checkpoint
+        # (or install_preemption_handler's save_dir) marks where the
+        # preemption finalizer and sentinel rollback are allowed to write.
+        return load_engine_state(
+            self, load_dir, tag,
+            load_optimizer_states=load_optimizer_states,
+            load_lr_scheduler_states=load_lr_scheduler_states,
+            load_module_only=load_module_only)
 
     def _checkpoint_tag_validation(self, tag):
         """All ranks must be saving the SAME tag (reference engine.py:3035

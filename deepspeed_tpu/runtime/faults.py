@@ -1,0 +1,258 @@
+"""Deterministic fault injection for the TRAINING path (the training chaos
+harness — sibling of ``fleet/faults.py``, which covers serving).
+
+Every recovery path the training fault-tolerance subsystem claims — torn/
+corrupt checkpoint fallback, preemption-safe exit, supervisor auto-resume,
+anomaly skip-step — must be *provable* on the tier-1 CPU mesh, reproducibly.
+Like the fleet injector, a fault here is a pure function of
+``(seed, point, index)``: identical seed ⇒ identical fault schedule
+(:meth:`would_fire` is the replayable oracle), and the step-indexed points
+(kill/sigterm/nan) key on the GLOBAL step number, so a resumed run sees the
+same schedule an uninterrupted one would.
+
+Injection points:
+
+- ``kill_at_step`` — SIGKILL the process after completing a global step (the
+  hard crash the supervisor's restart+resume path exists for);
+- ``sigterm_at_step`` — SIGTERM after a global step (exercises the engine's
+  preemption handler: drain → final checkpoint → resume marker → exit);
+- ``nan_inject`` — poison the step's batch with NaNs (exercises the anomaly
+  sentinel's skip-step and rollback paths);
+- ``checkpoint_corrupt`` — flip a byte inside a just-committed checkpoint's
+  sealed files (the CRC-mismatch → fallback path);
+- ``checkpoint_truncate`` — delete a just-committed checkpoint's manifest
+  (the torn-commit → fallback path).
+
+Kill/sigterm points default to **first life only** (``only_first_life``): a
+deterministic kill at step *j* replayed after resume would crash-loop the
+supervisor forever; the supervisor exports ``DSTPU_RESTART_COUNT`` so
+restarted lives suppress them.
+
+Armed only via the ``DSTPU_TRAIN_FAULTS`` env var (a JSON
+:class:`TrainFaultConfig` body) or an explicit injector handed to the engine;
+disabled costs one ``is None`` check per hook.
+"""
+
+import os
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+from pydantic import Field
+
+# one seeded-hash schedule primitive across BOTH chaos harnesses: a tweak to
+# the derivation must change serving and training schedules together
+from deepspeed_tpu.fleet.faults import _u64, _uniform
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+from deepspeed_tpu.utils.logging import logger
+
+POINTS = ("kill_at_step", "sigterm_at_step", "nan_inject",
+          "checkpoint_corrupt", "checkpoint_truncate")
+
+# step-indexed points consult would_fire(point, global_step); the checkpoint
+# points consume a sequential per-point event counter (one event per save)
+STEP_POINTS = ("kill_at_step", "sigterm_at_step", "nan_inject")
+
+_EVENT_LOG_CAP = 512
+
+
+class TrainFaultConfig(DeepSpeedConfigModel):
+    """Training chaos knobs. Step lists fire deterministically at exactly
+    those global steps; probabilities fire per event (per step for the step
+    points, per save for the checkpoint points)."""
+
+    enabled: bool = False
+    """Master switch; False = no injector is constructed at all."""
+
+    seed: int = 0
+    """The schedule seed: identical seed = identical fault schedule."""
+
+    only_first_life: bool = True
+    """Suppress kill/sigterm points when ``DSTPU_RESTART_COUNT`` (exported by
+    the train supervisor) says this process is a restarted life — a
+    deterministic kill replayed after resume would crash-loop forever."""
+
+    kill_at_steps: Tuple[int, ...] = ()
+    sigterm_at_steps: Tuple[int, ...] = ()
+    nan_at_steps: Tuple[int, ...] = ()
+    """Explicit global-step schedules (union'd with the probabilities)."""
+
+    kill_at_step_p: float = Field(0.0, ge=0, le=1)
+    sigterm_at_step_p: float = Field(0.0, ge=0, le=1)
+    nan_inject_p: float = Field(0.0, ge=0, le=1)
+    checkpoint_corrupt_p: float = Field(0.0, ge=0, le=1)
+    checkpoint_truncate_p: float = Field(0.0, ge=0, le=1)
+
+
+def first_life() -> bool:
+    """True when this process is the supervisor's first spawn (or
+    unsupervised)."""
+    return int(os.environ.get("DSTPU_RESTART_COUNT", "0") or 0) == 0
+
+
+class TrainFaultInjector:
+    """Seed-driven fault schedule over the training injection points."""
+
+    def __init__(self, config: TrainFaultConfig):
+        self.config = config
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}       # checkpoint points
+        self._step_fired: Dict[str, set] = {}     # step points: once per step
+        self._fired: Dict[str, int] = {}
+        self._events: deque = deque(maxlen=_EVENT_LOG_CAP)
+
+    # ---------------------------------------------------------------- schedule --
+    def _steps(self, point: str) -> Tuple[int, ...]:
+        return {"kill_at_step": self.config.kill_at_steps,
+                "sigterm_at_step": self.config.sigterm_at_steps,
+                "nan_inject": self.config.nan_at_steps}.get(point, ())
+
+    def _p(self, point: str) -> float:
+        return getattr(self.config,
+                       "nan_inject_p" if point == "nan_inject" else f"{point}_p")
+
+    def would_fire(self, point: str, n: int) -> bool:
+        """Pure schedule oracle: does event ``n`` (a global step for the step
+        points, a save index for the checkpoint points) fault?"""
+        if point not in POINTS:
+            raise ValueError(f"unknown injection point {point!r} (know {POINTS})")
+        if n in self._steps(point):
+            return True
+        p = self._p(point)
+        return p > 0.0 and _uniform(self.config.seed, point, n) < p
+
+    def schedule(self, point: str, count: int) -> List[int]:
+        """Firing indices among the first ``count`` events — the replayable
+        whole-schedule view for reports and tests."""
+        return [n for n in range(count) if self.would_fire(point, n)]
+
+    # -------------------------------------------------------------------- fire --
+    def fire(self, point: str) -> Optional[int]:
+        """Consume the next sequential event at a checkpoint point; returns
+        the index when it faults, None otherwise."""
+        with self._lock:
+            n = self._counters.get(point, 0)
+            self._counters[point] = n + 1
+            if self.would_fire(point, n):
+                self._record(point, n)
+                return n
+        return None
+
+    def fire_step(self, point: str, step: int) -> Optional[int]:
+        """Step-indexed firing: fires at most once per (point, step) per
+        process life, and kill/sigterm only on the first life (see
+        ``only_first_life``)."""
+        if point in ("kill_at_step", "sigterm_at_step") \
+                and self.config.only_first_life and not first_life():
+            return None
+        with self._lock:
+            seen = self._step_fired.setdefault(point, set())
+            if step in seen or not self.would_fire(point, step):
+                return None
+            seen.add(step)
+            self._record(point, step)
+            return step
+
+    def _record(self, point, n):
+        # caller holds the lock
+        self._fired[point] = self._fired.get(point, 0) + 1
+        self._events.append({"point": point, "n": n})
+        tm = _train_metrics()
+        if tm is not None:
+            tm.inc()
+
+    # ---------------------------------------------------- fault-shape helpers --
+    def poison_batch(self, batch):
+        """A NaN-poisoned copy of a host batch (first float leaf gets NaN in
+        its first element): grads go non-finite — the anomaly sentinel's
+        skip-step territory."""
+        import jax
+
+        done = [False]
+
+        def poison(x):
+            arr = np.asarray(x)
+            if not done[0] and np.issubdtype(arr.dtype, np.floating) and arr.size:
+                arr = np.array(arr, copy=True)
+                arr.flat[0] = np.nan
+                done[0] = True
+            return arr
+
+        return jax.tree.map(poison, batch)
+
+    def corrupt_checkpoint(self, tag_path: str, n: int) -> Optional[str]:
+        """Flip one byte inside the LARGEST sealed file of a committed
+        checkpoint (deterministic position from the seed): the manifest's
+        CRC32 must catch it — a loud fallback, never silently wrong state."""
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import (
+            MANIFEST_FILE, read_manifest)
+        try:
+            manifest = read_manifest(tag_path)
+        except ValueError:
+            manifest = None
+        files = (manifest or {}).get("files", {})
+        candidates = sorted(((info["size"], rel) for rel, info in files.items()
+                             if info["size"] > 0 and rel != MANIFEST_FILE),
+                            reverse=True)
+        if not candidates:
+            return None
+        size, rel = candidates[0]
+        pos = _u64(self.config.seed, "checkpoint_corrupt", n, "pos") % size
+        fp = os.path.join(tag_path, rel)
+        with open(fp, "r+b") as f:
+            f.seek(pos)
+            byte = f.read(1)
+            f.seek(pos)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        logger.error(f"chaos: corrupted checkpoint {tag_path} "
+                     f"({rel} @ byte {pos})")
+        return rel
+
+    def truncate_checkpoint(self, tag_path: str) -> bool:
+        """Delete a committed checkpoint's manifest — the crashed-mid-commit
+        (torn) shape the fallback path must survive."""
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import MANIFEST_FILE
+        mf = os.path.join(tag_path, MANIFEST_FILE)
+        if not os.path.isfile(mf):
+            return False
+        os.unlink(mf)
+        logger.error(f"chaos: truncated checkpoint {tag_path} "
+                     f"(manifest removed — torn commit)")
+        return True
+
+    # ------------------------------------------------------------------ report --
+    def report(self) -> dict:
+        with self._lock:
+            return {"seed": self.config.seed,
+                    "fired": dict(self._fired),
+                    "events_seen": dict(self._counters),
+                    "recent": list(self._events)}
+
+
+def _train_metrics():
+    """``train_faults_injected_total`` counter; None when telemetry is off."""
+    from deepspeed_tpu import telemetry
+    if not telemetry.is_active():
+        return None
+    return telemetry.get_registry().counter(
+        "train_faults_injected_total",
+        "Faults injected by the training chaos harness (all points)")
+
+
+def config_from_env(env_value: Optional[str]) -> Optional[TrainFaultConfig]:
+    """Parse ``DSTPU_TRAIN_FAULTS`` (a JSON ``TrainFaultConfig`` body, e.g.
+    ``{"enabled": true, "kill_at_steps": [5]}``). None when unset; malformed
+    JSON raises — a chaos run with a typo'd config must not silently run
+    clean."""
+    if not env_value:
+        return None
+    import json
+    return TrainFaultConfig(**json.loads(env_value))
+
+
+def injector_from_env(env_value: Optional[str]) -> Optional[TrainFaultInjector]:
+    """An armed injector from ``DSTPU_TRAIN_FAULTS``; None when unset or
+    disabled."""
+    config = config_from_env(env_value)
+    return TrainFaultInjector(config) if config is not None and config.enabled else None

@@ -1,0 +1,121 @@
+"""Loss-anomaly sentinel: NaN/inf/spike → skip-step, then rollback-to-last-
+good after M consecutive anomalies.
+
+The device side of skip-step is the engine's finite gate: with the sentinel
+enabled, ``_apply_fn_inner`` checks ``tree_all_finite(grads)`` in EVERY
+precision mode (not just fp16), so a non-finite step never touches the
+weights — the same select the fp16 overflow path uses. The host side (this
+module) watches the per-boundary loss scalar: a non-finite loss, or one that
+spikes past ``spike_factor ×`` the running EMA of healthy losses, counts as
+an anomaly (``train_anomalies_total``). ``max_consecutive`` anomalies in a
+row escalate to a ROLLBACK: the engine reloads the newest verified-good
+checkpoint (``train_rollbacks_total``) and training continues from known-good
+state instead of chasing a diverged run.
+
+Reading the loss scalar is a per-boundary device sync — the sentinel, like
+telemetry, is opt-in (``anomaly_sentinel.enabled``).
+"""
+
+import math
+from typing import Optional
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+from deepspeed_tpu.utils.logging import logger
+
+OK = "ok"
+ANOMALY = "anomaly"
+ROLLBACK = "rollback"
+
+
+class AnomalySentinelConfig(DeepSpeedConfigModel):
+    """``anomaly_sentinel`` config block (runtime/config.py)."""
+
+    enabled: bool = False
+    """Master switch. Enabling also arms the engine's all-precision finite
+    gate (non-finite grads skip the optimizer step, fp16-style)."""
+
+    spike_factor: float = Field(10.0, gt=1.0)
+    """A finite loss above ``spike_factor * ema`` counts as an anomaly."""
+
+    ema_beta: float = Field(0.9, ge=0.0, lt=1.0)
+    """EMA smoothing over healthy losses (anomalous losses never update it)."""
+
+    warmup_steps: int = Field(5, ge=0)
+    """Healthy observations before spike detection arms (early-training loss
+    is legitimately wild; NaN/inf detection is active from step one)."""
+
+    max_consecutive: int = Field(3, ge=1)
+    """Consecutive anomalies that escalate to a rollback."""
+
+    rollback: bool = True
+    """False = escalation only logs (and counts) instead of reloading the
+    last good checkpoint — for loops that handle recovery themselves."""
+
+
+class LossAnomalySentinel:
+    """Per-engine anomaly state machine; driven by the engine at every
+    gradient-accumulation boundary."""
+
+    def __init__(self, config: AnomalySentinelConfig):
+        self.config = config
+        self.ema: Optional[float] = None
+        self.healthy_seen = 0
+        self.consecutive = 0
+        self.anomalies = 0
+        self.rollbacks = 0
+        self._metrics = None
+
+    def _counters(self):
+        from deepspeed_tpu import telemetry
+        if not telemetry.is_active():
+            return None
+        if self._metrics is None:
+            reg = telemetry.get_registry()
+            self._metrics = {
+                "anomalies": reg.counter(
+                    "train_anomalies_total",
+                    "Loss anomalies (NaN/inf/spike) seen by the sentinel"),
+                "rollbacks": reg.counter(
+                    "train_rollbacks_total",
+                    "Sentinel rollbacks to the last good checkpoint"),
+            }
+        return self._metrics
+
+    def observe(self, loss: float) -> str:
+        """Classify one boundary-step loss: ``ok`` | ``anomaly`` |
+        ``rollback`` (the latter also counts as an anomaly; the caller
+        performs the actual checkpoint reload)."""
+        cfg = self.config
+        finite = math.isfinite(loss)
+        spike = (finite and self.ema is not None
+                 and self.healthy_seen >= cfg.warmup_steps
+                 and loss > cfg.spike_factor * max(abs(self.ema), 1e-12))
+        if finite and not spike:
+            self.healthy_seen += 1
+            self.consecutive = 0
+            self.ema = loss if self.ema is None \
+                else cfg.ema_beta * self.ema + (1.0 - cfg.ema_beta) * loss
+            return OK
+        self.anomalies += 1
+        self.consecutive += 1
+        m = self._counters()
+        if m is not None:
+            m["anomalies"].inc()
+        kind = "non-finite" if not finite else "spike"
+        logger.warning(f"anomaly sentinel: {kind} loss {loss!r} "
+                       f"(ema={self.ema}, consecutive="
+                       f"{self.consecutive}/{cfg.max_consecutive})")
+        if self.consecutive >= cfg.max_consecutive:
+            self.consecutive = 0
+            self.rollbacks += 1
+            if m is not None:
+                m["rollbacks"].inc()
+            return ROLLBACK
+        return ANOMALY
+
+    def describe(self) -> dict:
+        return {"ema": self.ema, "healthy_seen": self.healthy_seen,
+                "consecutive": self.consecutive, "anomalies": self.anomalies,
+                "rollbacks": self.rollbacks}

@@ -22,6 +22,18 @@ METRIC_FAMILIES = {
     "train_skipped_steps": "overflow-skipped optimizer steps",
     "train_global_steps": "optimizer steps taken",
     "train_samples_total": "samples consumed",
+    # training fault tolerance (runtime/checkpoint_engine/engine.py,
+    # runtime/engine.py, runtime/sentinel.py, runtime/faults.py,
+    # elasticity/train_supervisor.py)
+    "checkpoint_saves_total": "committed (manifest-sealed) checkpoint saves",
+    "checkpoint_verify_failures_total": "checkpoint tags that failed manifest verification (torn/corrupt)",
+    "checkpoint_load_fallbacks_total": "loads that skipped a bad tag and fell back to an older good one",
+    "checkpoint_pruned_total": "checkpoint tags deleted by keep-last-K retention",
+    "train_preemptions_total": "preemption notices converted into a final checkpoint + clean exit",
+    "train_anomalies_total": "loss anomalies (NaN/inf/spike) seen by the sentinel",
+    "train_rollbacks_total": "sentinel rollbacks to the last good checkpoint",
+    "train_restarts_total": "training process restarts by the supervisor after a crash",
+    "train_faults_injected_total": "faults injected by the training chaos harness",
     # comms layer (telemetry/__init__.record_comm_op)
     "comm_op_latency_seconds": "per-collective wall latency",
     "comm_op_bytes": "per-collective message size",

@@ -34,6 +34,68 @@ class MLP(nn.Module):
         return jnp.mean((nn.Dense(1)(h).squeeze(-1) - y) ** 2)
 
 
+def batch_for_step(step, batch=32, dim=64):
+    """Deterministic pure-function-of-step data: a resumed run replays the
+    exact batches an uninterrupted run would see (the chaos-equivalence
+    contract — real loaders checkpoint their cursor via client_state)."""
+    rng = np.random.default_rng(1000 + step)
+    x = rng.normal(size=(batch, dim)).astype(np.float32)
+    y = (x[:, 0] * 0.5 - x[:, 1]).astype(np.float32)
+    return x, y
+
+
+def main_fault_tolerant():
+    """DSTPU_CKPT_DIR mode: crash-consistent checkpoint per step, resume from
+    the latest good tag, preemption-safe SIGTERM exit — and, under
+    DSTPU_KILL_AT_STEP=N, a chaos SIGKILL after step N (first life only; the
+    supervisor's DSTPU_RESTART_COUNT suppresses the replay). Run it under
+    ``bin/dstpu_train`` and the killed-and-resumed run reaches a final
+    loss/params numerically identical to an uninterrupted one."""
+    import json
+
+    ckdir = os.environ["DSTPU_CKPT_DIR"]
+    total_steps = int(os.environ.get("DSTPU_TOTAL_STEPS", "8"))
+    kill_at = os.environ.get("DSTPU_KILL_AT_STEP")
+    if kill_at and "DSTPU_TRAIN_FAULTS" not in os.environ:
+        os.environ["DSTPU_TRAIN_FAULTS"] = json.dumps(
+            {"enabled": True, "kill_at_steps": [int(kill_at)]})
+
+    model = MLP()
+    params = model.init(jax.random.PRNGKey(0),
+                        (jnp.asarray(batch_for_step(0)[0]),
+                         jnp.asarray(batch_for_step(0)[1])))["params"]
+    config = {
+        "train_micro_batch_size_per_gpu": 32,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "checkpoint": {"keep_last_k": 3, "verify_arrays_on_load": True},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=config)
+    engine.install_preemption_handler(save_dir=ckdir)
+    path, _ = engine.load_checkpoint(ckdir)  # (None, None) on a fresh dir
+    life = os.environ.get("DSTPU_RESTART_COUNT", "0")
+    print(f"life {life}: {'resumed from ' + path if path else 'fresh start'} "
+          f"at step {engine.global_steps}", flush=True)
+
+    loss = None
+    while engine.global_steps < total_steps:
+        loss = engine.train_batch(batch=batch_for_step(engine.global_steps))
+        engine.save_checkpoint(ckdir)
+
+    if loss is None:  # resumed life found training already complete
+        print(f"final step {engine.global_steps} (already complete)")
+    else:
+        print(f"final step {engine.global_steps} loss {float(loss):.10f}")
+    out = os.environ.get("DSTPU_FINAL_PARAMS")
+    if out:
+        flat = jax.tree_util.tree_flatten_with_path(jax.device_get(engine.params))[0]
+        np.savez(out, **{jax.tree_util.keystr(k): np.asarray(v) for k, v in flat})
+    engine.destroy()
+    print("OK")
+
+
 def main():
     model = MLP()
     rng = np.random.default_rng(0)
@@ -88,4 +150,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("DSTPU_CKPT_DIR"):
+        main_fault_tolerant()
+    else:
+        main()

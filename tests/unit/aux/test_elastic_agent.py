@@ -49,6 +49,25 @@ def test_agent_restarts_after_failure(tmp_path):
     assert (tmp_path / "rank0_restart1.txt").exists(), "second attempt must have run"
 
 
+def test_agent_exports_restart_count_for_chaos_one_shot(tmp_path):
+    """DSTPU_RESTART_COUNT drives the training chaos injector's one-shot
+    kill/sigterm suppression (runtime/faults.first_life): every relaunch
+    must see its life number or a deterministic kill replays forever."""
+    path = tmp_path / "worker.py"
+    path.write_text(textwrap.dedent(f"""
+        import os, pathlib, sys
+        d = pathlib.Path({str(repr(str(tmp_path)))})
+        life = os.environ["DSTPU_ELASTIC_RESTART"]
+        (d / f"rc{{life}}").write_text(os.environ.get("DSTPU_RESTART_COUNT", "missing"))
+        sys.exit(3 if life == "0" else 0)
+    """))
+    agent = DSElasticAgent([sys.executable, str(path)], num_processes=1,
+                           max_restarts=2, monitor_interval=0.05)
+    assert agent.run() == 0
+    assert (tmp_path / "rc0").read_text() == "0"
+    assert (tmp_path / "rc1").read_text() == "1"
+
+
 def test_agent_gives_up_after_max_restarts(tmp_path):
     path = tmp_path / "always_fail.py"
     path.write_text("import sys; sys.exit(1)")

@@ -1,0 +1,137 @@
+"""TrainSupervisor: restart-on-crash with backoff, crash-window quarantine,
+preemption-aware exit, SIGTERM forwarding (elasticity/train_supervisor.py)."""
+
+import os
+import signal
+import sys
+import textwrap
+import threading
+import time
+
+from deepspeed_tpu.elasticity import TrainSupervisor
+from deepspeed_tpu.fleet.breaker import backoff_delay
+
+
+def _script(tmp_path, body):
+    path = tmp_path / "child.py"
+    path.write_text(textwrap.dedent(body))
+    return [sys.executable, str(path)]
+
+
+def _fast(cmd, tmp_path=None, **kw):
+    kw.setdefault("backoff_base_s", 0.01)
+    kw.setdefault("backoff_cap_s", 0.05)
+    kw.setdefault("jitter_frac", 0.0)
+    kw.setdefault("grace_s", 5.0)
+    return TrainSupervisor(cmd, ckpt_dir=str(tmp_path) if tmp_path else None, **kw)
+
+
+def test_crash_then_restart_resumes_next_life(tmp_path):
+    """First life crashes (no flag yet), second succeeds — and sees
+    DSTPU_RESTART_COUNT=1 plus the exported DSTPU_CKPT_DIR."""
+    cmd = _script(tmp_path, f"""
+        import os, pathlib, sys
+        flag = pathlib.Path({str(repr(str(tmp_path / 'flag')))})
+        log = pathlib.Path({str(repr(str(tmp_path / 'lives')))})
+        log.write_text(os.environ["DSTPU_RESTART_COUNT"] + " " +
+                       os.environ.get("DSTPU_CKPT_DIR", "?"))
+        if not flag.exists():
+            flag.write_text("1")
+            sys.exit(17)
+        sys.exit(0)
+    """)
+    sup = _fast(cmd, tmp_path)
+    assert sup.run() == 0
+    assert sup.restarts == 1 and not sup.quarantined
+    life, ckdir = (tmp_path / "lives").read_text().split()
+    assert life == "1" and ckdir == str(tmp_path)
+
+
+def test_crash_loop_quarantines_with_childs_exit_code(tmp_path):
+    cmd = _script(tmp_path, "import sys; sys.exit(9)")
+    sup = _fast(cmd, max_crashes=3, crash_window_s=60.0)
+    assert sup.run() == 9
+    assert sup.quarantined
+    assert sup.restarts == 2  # 3 crashes = 2 restarts before giving up
+
+
+def test_preempt_exit_code_is_not_restarted(tmp_path):
+    cmd = _script(tmp_path, "import sys; sys.exit(143)")
+    sup = _fast(cmd)
+    assert sup.run() == 143
+    assert sup.restarts == 0 and not sup.quarantined
+
+
+def test_restart_on_preempt_override(tmp_path):
+    cmd = _script(tmp_path, f"""
+        import pathlib, sys
+        flag = pathlib.Path({str(repr(str(tmp_path / 'flag')))})
+        if not flag.exists():
+            flag.write_text("1")
+            sys.exit(143)
+        sys.exit(0)
+    """)
+    sup = _fast(cmd, restart_on_preempt=True)
+    assert sup.run() == 0
+    assert sup.restarts == 1
+
+
+def test_stop_request_forwards_sigterm_and_never_restarts(tmp_path):
+    """Operator/preemptor stop: child's SIGTERM handler runs (the engine's
+    preemption path in real jobs) and the supervisor exits with its code."""
+    cmd = _script(tmp_path, """
+        import signal, sys, time
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+        time.sleep(60)
+        sys.exit(1)
+    """)
+    sup = _fast(cmd)
+    result = {}
+
+    def run():
+        result["rc"] = sup.run()
+
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.monotonic() + 10
+    while sup._proc is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.3)  # let the child install its handler
+    sup.request_stop()
+    t.join(timeout=15)
+    assert not t.is_alive()
+    assert result["rc"] == 143
+    assert sup.restarts == 0
+
+
+def test_grace_exhaustion_kills_a_wedged_child(tmp_path):
+    """A child that ignores SIGTERM dies by SIGKILL after the grace budget."""
+    cmd = _script(tmp_path, """
+        import signal, time
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        time.sleep(60)
+    """)
+    sup = _fast(cmd, grace_s=0.5)
+    result = {}
+
+    def run():
+        result["rc"] = sup.run()
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.5)  # child boots + ignores SIGTERM
+    sup.request_stop()
+    t.join(timeout=15)
+    assert not t.is_alive()
+    assert result["rc"] == 128 + signal.SIGKILL  # shell convention, not -9
+
+
+def test_backoff_schedule_is_the_shared_fleet_policy():
+    """Restart spacing reuses fleet/breaker.backoff_delay: exponential,
+    capped, bounded jitter."""
+    assert backoff_delay(0, 0.5, 30.0) == 0.5
+    assert backoff_delay(3, 0.5, 30.0) == 4.0
+    assert backoff_delay(10, 0.5, 30.0) == 30.0  # capped
+    lo = backoff_delay(1, 1.0, 30.0, jitter_frac=0.5, u=0.0)
+    hi = backoff_delay(1, 1.0, 30.0, jitter_frac=0.5, u=1.0 - 1e-9)
+    assert lo == 1.0 and 2.9 < hi < 3.0  # bounded, never unbounded-full-jitter

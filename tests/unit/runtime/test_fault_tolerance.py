@@ -1,0 +1,483 @@
+"""Training fault tolerance (ISSUE 11): crash-consistent checkpoints,
+preemption-safe exit, anomaly sentinel, training chaos injector.
+
+The two acceptance gates live here and in test_examples.py:
+
+- a deliberately corrupted NEWEST checkpoint makes ``load_checkpoint`` fall
+  back to the previous good tag LOUDLY (telemetry-counted), never silently;
+- a save/load-interrupted run reaches step-exact, bitwise-identical final
+  params versus an uninterrupted run (the kill-under-supervisor formulation
+  is the subprocess gate in test_examples.py).
+"""
+
+import glob
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.runtime.checkpoint_engine.engine import (
+    MANIFEST_FILE, CheckpointCorruptionError, ReferenceCheckpointError,
+    list_tags, read_manifest, retention_plan, verify_checkpoint)
+from deepspeed_tpu.runtime.engine import TrainingPreempted
+from deepspeed_tpu.runtime.faults import (TrainFaultConfig, TrainFaultInjector,
+                                          injector_from_env)
+from deepspeed_tpu.utils import groups
+
+from ..simple_model import make_simple_model
+
+HIDDEN = 16
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry_and_signals():
+    """Telemetry is process-global and the preemption test rebinds SIGTERM:
+    leave both exactly as found."""
+    telemetry.shutdown()
+    telemetry.state.registry = None
+    old_term = signal.getsignal(signal.SIGTERM)
+    yield
+    signal.signal(signal.SIGTERM, old_term)
+    telemetry.shutdown()
+    telemetry.state.registry = None
+
+
+def _config(extra=None):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 8,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 0.01, "weight_decay": 0.0}},
+        "zero_optimization": {"stage": 2},
+    }
+    if extra:
+        cfg.update(extra)
+    return cfg
+
+
+def _engine(extra=None):
+    groups.initialize_mesh(force=True)
+    model, params0 = make_simple_model(hidden_dim=HIDDEN, batch_size=8)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params0, config=_config(extra))
+    return engine
+
+
+def _batch(step):
+    rng = np.random.default_rng(100 + step)
+    x = rng.normal(size=(8, HIDDEN)).astype(np.float32)
+    return x, (x[:, 0] - 0.5 * x[:, 1]).astype(np.float32)
+
+
+def _train_and_save(engine, save_dir, steps, start=0):
+    for s in range(start, steps):
+        engine.train_batch(batch=_batch(s))
+        engine.save_checkpoint(str(save_dir))
+
+
+def _corrupt_largest_state_file(tag_dir):
+    files = [f for f in glob.glob(os.path.join(tag_dir, "state", "**"),
+                                  recursive=True) if os.path.isfile(f)]
+    target = max(files, key=os.path.getsize)
+    with open(target, "r+b") as f:
+        f.seek(0)
+        byte = f.read(1)
+        f.seek(0)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    return target
+
+
+def _counter_value(name):
+    reg = telemetry.get_registry()
+    return reg.counter(name).value
+
+
+# ------------------------------------------------------------- manifest core --
+def test_manifest_seals_the_commit(tmp_path):
+    e = _engine()
+    _train_and_save(e, tmp_path, 1)
+    tag_dir = os.path.join(str(tmp_path), "global_step1")
+    manifest = read_manifest(tag_dir)
+    assert manifest is not None and manifest["format"] == 1
+    assert manifest["global_steps"] == 1
+    assert manifest["rng"] is not None          # step-exact resume state
+    assert manifest["world"]["device_count"] >= 1
+    assert manifest["files"], "file seals missing"
+    assert manifest["arrays"], "per-array CRC32s missing"
+    assert any("params" in k for k in manifest["arrays"])
+    assert verify_checkpoint(tag_dir) == ("good", f"{len(manifest['files'])} files verified")
+
+
+def test_corrupted_and_torn_tags_fall_back_loudly(tmp_path):
+    """THE acceptance gate: corrupt the newest tag (CRC mismatch) AND tear
+    the middle one (manifest removed) → load lands on the oldest GOOD tag,
+    telemetry-counted, never silently. An empty dir beforehand is a fresh
+    start, not an error."""
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+    e = _engine()
+    path, client = e.load_checkpoint(str(tmp_path))  # nothing committed yet
+    assert path is None and client is None
+
+    _train_and_save(e, tmp_path, 3)
+    _corrupt_largest_state_file(os.path.join(str(tmp_path), "global_step3"))
+    os.unlink(os.path.join(str(tmp_path), "global_step2", MANIFEST_FILE))
+    assert verify_checkpoint(os.path.join(str(tmp_path), "global_step3"))[0] == "corrupt"
+    assert verify_checkpoint(os.path.join(str(tmp_path), "global_step2"))[0] == "torn"
+
+    groups.destroy_mesh()
+    e2 = _engine()
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path is not None and path.endswith("global_step1")
+    assert e2.global_steps == 1
+    assert _counter_value("checkpoint_verify_failures_total") >= 2
+    assert _counter_value("checkpoint_load_fallbacks_total") >= 2
+
+
+def test_bad_tags_raise_instead_of_silent_none(tmp_path):
+    """An explicit corrupt tag raises; with every tag bad, tag=None raises
+    too — never a silent (None, None) over real-but-unusable state."""
+    e = _engine()
+    _train_and_save(e, tmp_path, 1)
+    _corrupt_largest_state_file(os.path.join(str(tmp_path), "global_step1"))
+    groups.destroy_mesh()
+    e2 = _engine()
+    with pytest.raises(CheckpointCorruptionError, match="CORRUPT"):
+        e2.load_checkpoint(str(tmp_path), tag="global_step1")
+    with pytest.raises(CheckpointCorruptionError, match="no verified-good"):
+        e2.load_checkpoint(str(tmp_path))
+
+
+def test_verify_arrays_on_load_catches_sub_file_corruption(tmp_path):
+    """Per-array CRC re-check on the restored tree (defense below the file
+    layer): tamper with the manifest's array seal → the restore refuses."""
+    e = _engine(extra={"checkpoint": {"verify_arrays_on_load": True}})
+    _train_and_save(e, tmp_path, 1)
+    tag_dir = os.path.join(str(tmp_path), "global_step1")
+    manifest = read_manifest(tag_dir)
+    key = next(k for k in manifest["arrays"] if "params" in k)
+    # tamper with one array seal only; the file seals stay truthful, so the
+    # FILE layer passes and only the array layer can catch it
+    manifest["arrays"][key]["crc32"] ^= 0xFF
+    with open(os.path.join(tag_dir, MANIFEST_FILE), "w") as f:
+        json.dump(manifest, f)
+
+    groups.destroy_mesh()
+    e2 = _engine(extra={"checkpoint": {"verify_arrays_on_load": True}})
+    with pytest.raises(CheckpointCorruptionError, match="per-array"):
+        e2.load_checkpoint(str(tmp_path), tag="global_step1")
+
+
+# ---------------------------------------------------------------- retention --
+def test_retention_keeps_last_k(tmp_path):
+    e = _engine(extra={"checkpoint": {"keep_last_k": 2}})
+    _train_and_save(e, tmp_path, 4)
+    tags = {t["tag"] for t in list_tags(str(tmp_path))}
+    assert tags == {"global_step3", "global_step4"}
+
+
+def test_retention_never_deletes_last_good(tmp_path):
+    e = _engine()
+    _train_and_save(e, tmp_path, 3)
+    # newest two torn (e.g. chaos-truncated): the only good one is oldest
+    for tag in ("global_step2", "global_step3"):
+        os.unlink(os.path.join(str(tmp_path), tag, MANIFEST_FILE))
+    keep, drop = retention_plan(str(tmp_path), keep_last_k=1)
+    kept = {e["tag"] for e in keep}
+    assert "global_step1" in kept, "the last good tag must survive retention"
+    assert "global_step3" in kept  # the newest stays in-window
+    assert {e["tag"] for e in drop} == {"global_step2"}
+
+
+# ------------------------------------------------- reference-format rejection --
+def test_reference_torch_checkpoint_rejected_loudly(tmp_path):
+    """ROADMAP item 5 (reject half): zero_pp_rank_*/mp_rank_* shards name the
+    migration path instead of dying inside orbax."""
+    ref = tmp_path / "global_step100"
+    ref.mkdir()
+    (ref / "zero_pp_rank_0_mp_rank_00_optim_states.pt").write_bytes(b"torch")
+    (ref / "mp_rank_00_model_states.pt").write_bytes(b"torch")
+    (tmp_path / "latest").write_text("global_step100")
+
+    e = _engine()
+    with pytest.raises(ReferenceCheckpointError, match="ds_to_universal"):
+        e.load_checkpoint(str(tmp_path))
+    # an explicit tag is rejected the same way
+    with pytest.raises(ReferenceCheckpointError, match="ds_to_universal"):
+        e.load_checkpoint(str(tmp_path), tag="global_step100")
+
+
+# --------------------------------------------------------- step-exact resume --
+def test_save_load_resume_is_step_exact(tmp_path):
+    """Interrupted-at-step-2 + resumed reaches BITWISE the params/rng an
+    uninterrupted run reaches (the in-process half of the chaos-equivalence
+    gate; the kill-under-supervisor half lives in test_examples.py)."""
+    import jax
+    e1 = _engine()
+    _train_and_save(e1, tmp_path, 2)
+    rng_at_save = np.asarray(e1._rng)
+    for s in range(2, 5):
+        e1.train_batch(batch=_batch(s))
+    want = jax.device_get(e1.params)
+
+    groups.destroy_mesh()
+    e2 = _engine()
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path is not None and e2.global_steps == 2
+    assert np.array_equal(np.asarray(e2._rng), rng_at_save), \
+        "the per-step rng stream must resume exactly"
+    for s in range(2, 5):
+        e2.train_batch(batch=_batch(s))
+    got = jax.device_get(e2.params)
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+        assert np.array_equal(a, b), "resumed run diverged from uninterrupted"
+
+
+# ------------------------------------------------------- async save draining --
+def test_async_save_commits_on_destroy(tmp_path):
+    """Satellite: an in-flight async (nebula) save must not be torn by engine
+    close/interpreter teardown — destroy() drains the commit."""
+    e = _engine(extra={"nebula": {"enabled": True}})
+    e.train_batch(batch=_batch(0))
+    e.save_checkpoint(str(tmp_path))
+    e.destroy()  # joins the commit thread + closes the async checkpointer
+    tag_dir = os.path.join(str(tmp_path), "global_step1")
+    status, detail = verify_checkpoint(tag_dir)
+    assert status == "good", f"async save torn by destroy: {detail}"
+    assert getattr(e, "_async_ckpt")["ckptr"] is None
+
+
+def test_async_manifest_seals_dispatch_time_state(tmp_path, monkeypatch):
+    """The manifest an async commit writes must describe the DISPATCH-time
+    snapshot, not whatever steps the training thread took while the commit
+    was in flight."""
+    import threading
+
+    from deepspeed_tpu.runtime.checkpoint_engine import engine as ck_mod
+    gate = threading.Event()
+    real_finish = ck_mod.OrbaxCheckpointEngine.finish
+
+    def gated_finish(self):
+        gate.wait(timeout=60)
+        real_finish(self)
+
+    monkeypatch.setattr(ck_mod.OrbaxCheckpointEngine, "finish", gated_finish)
+    e = _engine(extra={"nebula": {"enabled": True}})
+    e.train_batch(batch=_batch(0))
+    e.save_checkpoint(str(tmp_path))   # snapshot at step 1, commit gated open
+    e.train_batch(batch=_batch(1))     # training continues to step 2
+    gate.set()
+    e.checkpoint_wait()
+    manifest = read_manifest(os.path.join(str(tmp_path), "global_step1"))
+    assert manifest["global_steps"] == 1, \
+        "manifest must seal the dispatch-time step, not the commit-time one"
+    e.destroy()
+
+
+def test_dangling_latest_with_no_tags_is_a_fresh_start(tmp_path):
+    """An operator who wiped the tag dirs but left `latest` behind gets a
+    fresh start, not a supervisor crash loop."""
+    (tmp_path / "latest").write_text("global_step9")
+    e = _engine()
+    path, client = e.load_checkpoint(str(tmp_path))
+    assert path is None and client is None
+
+
+def test_crash_during_first_ever_save_is_a_fresh_start(tmp_path):
+    """SIGKILL mid-way through the very FIRST save leaves a torn partial tag
+    and no `latest`/manifest anywhere: nothing was ever committed, so resume
+    is a fresh start — not a raise that quarantines the supervisor."""
+    partial = tmp_path / "global_step1" / "state"
+    partial.mkdir(parents=True)
+    (partial / "partial_write").write_bytes(b"torn")
+    e = _engine()
+    path, client = e.load_checkpoint(str(tmp_path))
+    assert path is None and client is None
+
+
+# ------------------------------------------------------------ preemption path --
+def test_preemption_sigterm_final_checkpoint_and_marker(tmp_path):
+    """SIGTERM → the in-flight step finishes, a final synchronous checkpoint
+    commits, PREEMPTED.json lands, and the process exits 143 — then a fresh
+    engine resumes from the preempt tag."""
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+    e = _engine()
+    e.install_preemption_handler(save_dir=str(tmp_path))
+    e.train_batch(batch=_batch(0))
+    os.kill(os.getpid(), signal.SIGTERM)  # the preemption notice
+    with pytest.raises(TrainingPreempted) as exc:
+        e.train_batch(batch=_batch(1))
+    assert exc.value.code == 143
+    assert exc.value.tag == f"preempt_step{exc.value.step}"
+
+    marker = json.load(open(os.path.join(str(tmp_path), "PREEMPTED.json")))
+    assert marker["tag"] == exc.value.tag
+    tag_dir = os.path.join(str(tmp_path), marker["tag"])
+    assert verify_checkpoint(tag_dir)[0] == "good"
+    assert _counter_value("train_preemptions_total") == 1
+
+    groups.destroy_mesh()
+    e2 = _engine()
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path is not None and path.endswith(marker["tag"])
+    assert e2.global_steps == marker["global_steps"]
+
+
+# ------------------------------------------------------------ anomaly sentinel --
+def test_sentinel_skips_nonfinite_steps_and_rolls_back(tmp_path):
+    """NaN grads: (1) skip-step — params untouched, counted as skipped — in a
+    NON-fp16 mode; (2) M consecutive anomalies → rollback to last good."""
+    import jax
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+    e = _engine(extra={"anomaly_sentinel": {"enabled": True, "max_consecutive": 2,
+                                            "warmup_steps": 0},
+                       "bf16": {"enabled": True}})
+    e.train_batch(batch=_batch(0))
+    e.save_checkpoint(str(tmp_path))
+    good_params = jax.device_get(e.params)
+
+    x, y = _batch(1)
+    bad = (np.full_like(x, np.nan), y)
+    e.train_batch(batch=bad)  # anomaly 1: skipped, no rollback yet
+    assert e.skipped_steps == 1, "non-finite step must be skip-stepped"
+    for a, b in zip(jax.tree.leaves(jax.device_get(e.params)),
+                    jax.tree.leaves(good_params)):
+        assert np.array_equal(a, b), "skip-step must leave params untouched"
+    assert _counter_value("train_anomalies_total") == 1
+
+    e.train_batch(batch=bad)  # anomaly 2: rollback to the step-1 checkpoint
+    assert _counter_value("train_rollbacks_total") == 1
+    assert e.global_steps == 1, "rollback must land on the checkpointed step"
+    for a, b in zip(jax.tree.leaves(jax.device_get(e.params)),
+                    jax.tree.leaves(good_params)):
+        assert np.array_equal(a, b)
+    # healthy training continues after the rollback
+    loss = e.train_batch(batch=_batch(1))
+    assert np.isfinite(float(loss))
+
+
+def test_sentinel_spike_rollback_targets_pre_divergence_tag(tmp_path):
+    """A SPIKE (finite loss) still applies its update — and a loop that
+    checkpoints every step then saves the diverged weights. Rollback must
+    land on the newest tag at-or-before the last HEALTHY step, not the
+    newest tag outright."""
+    import jax
+    e = _engine(extra={"anomaly_sentinel": {"enabled": True, "max_consecutive": 2,
+                                            "warmup_steps": 0, "spike_factor": 5.0}})
+    e.train_batch(batch=_batch(0))       # step 1: healthy
+    e.save_checkpoint(str(tmp_path))     # pre-divergence tag global_step1
+    good_params = jax.device_get(e.params)
+
+    x, y = _batch(1)
+    spike = (x * 100.0, y)               # finite but enormous loss
+    e.train_batch(batch=spike)           # step 2: anomaly 1, update APPLIED
+    e.save_checkpoint(str(tmp_path))     # the DIVERGED state gets checkpointed
+    assert e._sentinel.anomalies == 1
+    e.train_batch(batch=spike)           # step 3: anomaly 2 → rollback
+    assert e._sentinel.rollbacks == 1
+    assert e.global_steps == 1, \
+        "rollback must target the pre-divergence tag, not the newest save"
+    for a, b in zip(jax.tree.leaves(jax.device_get(e.params)),
+                    jax.tree.leaves(good_params)):
+        assert np.array_equal(a, b)
+
+    # with NO tag at-or-before the healthy horizon left, rollback must
+    # REFUSE (loading the newest would restore the diverged state)
+    import shutil
+    shutil.rmtree(os.path.join(str(tmp_path), "global_step1"))
+    e.train_batch(batch=spike)
+    e.train_batch(batch=spike)  # anomalies 3+4 → rollback verdict again
+    assert e._sentinel.rollbacks == 2
+    assert e.global_steps == 3, "no pre-divergence tag: must not load anything"
+
+
+# ---------------------------------------------------------- chaos injector --
+def test_train_fault_injector_is_deterministic():
+    cfg = TrainFaultConfig(enabled=True, seed=7, nan_inject_p=0.3,
+                           kill_at_steps=(5, ))
+    a, b = TrainFaultInjector(cfg), TrainFaultInjector(cfg)
+    assert a.schedule("nan_inject", 200) == b.schedule("nan_inject", 200)
+    assert a.schedule("nan_inject", 200), "p=0.3 over 200 events must fire"
+    assert a.would_fire("kill_at_step", 5) and not a.would_fire("kill_at_step", 4)
+    # live fire == the pure oracle
+    fired = [n for n in range(50) if a.fire("checkpoint_corrupt") is not None]
+    assert fired == a.schedule("checkpoint_corrupt", 50)
+    with pytest.raises(ValueError, match="unknown injection point"):
+        a.would_fire("nope", 0)
+
+
+def test_injector_kill_points_are_first_life_only(monkeypatch):
+    cfg = TrainFaultConfig(enabled=True, kill_at_steps=(3, ))
+    inj = TrainFaultInjector(cfg)
+    monkeypatch.setenv("DSTPU_RESTART_COUNT", "1")
+    assert inj.fire_step("kill_at_step", 3) is None, \
+        "a restarted life must not replay the kill"
+    monkeypatch.setenv("DSTPU_RESTART_COUNT", "0")
+    assert inj.fire_step("kill_at_step", 3) == 3
+    assert inj.fire_step("kill_at_step", 3) is None  # once per step
+
+
+def test_injector_env_arming(monkeypatch):
+    assert injector_from_env(None) is None
+    assert injector_from_env(json.dumps({"enabled": False})) is None
+    inj = injector_from_env(json.dumps({"enabled": True, "seed": 3,
+                                        "sigterm_at_steps": [2]}))
+    assert inj is not None and inj.would_fire("sigterm_at_step", 2)
+    with pytest.raises(Exception):
+        injector_from_env("{not json")
+
+
+def test_injector_corrupts_sealed_checkpoint(tmp_path):
+    """The corrupt helper flips a byte the manifest CRC must catch."""
+    e = _engine()
+    _train_and_save(e, tmp_path, 1)
+    tag_dir = os.path.join(str(tmp_path), "global_step1")
+    inj = TrainFaultInjector(TrainFaultConfig(enabled=True, seed=1))
+    rel = inj.corrupt_checkpoint(tag_dir, 0)
+    assert rel is not None
+    status, detail = verify_checkpoint(tag_dir)
+    assert status == "corrupt" and "crc32 mismatch" in detail
+    # truncate removes the manifest: the torn-commit shape
+    assert inj.truncate_checkpoint(tag_dir) is True
+    assert verify_checkpoint(tag_dir)[0] == "torn"
+    assert inj.truncate_checkpoint(tag_dir) is False  # nothing left to tear
+
+
+def test_nan_inject_through_the_engine_env(tmp_path, monkeypatch):
+    """End-to-end chaos: DSTPU_TRAIN_FAULTS nan_at_steps poisons the batch,
+    the sentinel's finite gate skip-steps it."""
+    monkeypatch.setenv("DSTPU_TRAIN_FAULTS",
+                       json.dumps({"enabled": True, "nan_at_steps": [1]}))
+    e = _engine(extra={"anomaly_sentinel": {"enabled": True,
+                                            "max_consecutive": 10}})
+    e.train_batch(batch=_batch(0))
+    assert e.skipped_steps == 0
+    e.train_batch(batch=_batch(1))  # global step 1: poisoned
+    assert e.skipped_steps == 1
+    assert e._sentinel.anomalies == 1
+    e.train_batch(batch=_batch(2))
+    assert e.skipped_steps == 1
+
+
+# ------------------------------------------------------------ report tooling --
+def test_checkpoint_report_lists_statuses_and_survivors(tmp_path, capsys):
+    """Satellite: ``dstpu_report --checkpoint`` verdicts + retention view."""
+    from deepspeed_tpu.env_report import checkpoint_report
+    e = _engine(extra={"checkpoint": {"keep_last_k": 3}})
+    _train_and_save(e, tmp_path, 3)
+
+    # every tag good → rc 0
+    assert checkpoint_report(str(tmp_path)) == 0
+    assert "all tags verified" in capsys.readouterr().out
+
+    _corrupt_largest_state_file(os.path.join(str(tmp_path), "global_step3"))
+    os.unlink(os.path.join(str(tmp_path), "global_step2", MANIFEST_FILE))
+    rc = checkpoint_report(str(tmp_path))
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "corrupt" in out and "torn" in out and "good" in out
+    assert "crc32 mismatch" in out
+    assert "latest" in out and "kept" in out
+    assert "keep_last_k=3" in out

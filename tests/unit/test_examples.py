@@ -64,6 +64,46 @@ def test_serve_v2_supervised_mode():
     assert "restarted sup-mixed-0 automatically (restarts=1)" in r.stdout
 
 
+def test_train_zero3_kill_resume_chaos_equivalence(tmp_path):
+    """THE training chaos-equivalence gate (ISSUE 11): a run SIGKILLed at a
+    seeded step and auto-resumed by bin/dstpu_train reaches a step-exact,
+    bitwise-identical final loss AND params versus an uninterrupted run."""
+    import numpy as np
+
+    steps = "6"
+    base = _run_example("train_zero3.py", extra_env={
+        "DSTPU_CKPT_DIR": str(tmp_path / "base_ck"),
+        "DSTPU_TOTAL_STEPS": steps,
+        "DSTPU_FINAL_PARAMS": str(tmp_path / "base.npz")})
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    env.update({"DSTPU_CKPT_DIR": str(tmp_path / "kill_ck"),
+                "DSTPU_TOTAL_STEPS": steps,
+                "DSTPU_KILL_AT_STEP": "3",
+                "DSTPU_FINAL_PARAMS": str(tmp_path / "kill.npz")})
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bin", "dstpu_train"),
+                        "--backoff-base", "0.05", "--",
+                        sys.executable, os.path.join(REPO, "examples", "train_zero3.py")],
+                       capture_output=True, text=True, timeout=900, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "OK" in r.stdout
+    assert "dstpu_train: exit rc=0 restarts=1" in r.stdout  # it really died once
+    assert "resumed from" in r.stdout  # ...and resumed from a checkpoint
+
+    final = [ln for ln in base.stdout.splitlines() if ln.startswith("final step")]
+    final_kill = [ln for ln in r.stdout.splitlines() if ln.startswith("final step")]
+    assert final and final_kill
+    assert final[-1] == final_kill[-1], \
+        f"killed+resumed final loss diverged: {final[-1]!r} vs {final_kill[-1]!r}"
+    with np.load(tmp_path / "base.npz") as a, np.load(tmp_path / "kill.npz") as b:
+        assert set(a.files) == set(b.files)
+        for key in a.files:
+            assert np.array_equal(a[key], b[key]), \
+                f"param {key} not bitwise-identical after kill+resume"
+
+
 def test_train_zero3_with_telemetry(tmp_path):
     _run_example("train_zero3.py", extra_env={"DSTPU_TELEMETRY_DIR": str(tmp_path)})
 
