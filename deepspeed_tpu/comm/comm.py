@@ -89,13 +89,55 @@ def init_distributed(dist_backend="xla",
         proc_id = os.environ["OMPI_COMM_WORLD_RANK"]
         coord = f"{os.environ.get('MASTER_ADDR', 'localhost')}:{distributed_port}"
     if coord is not None and nproc > 1:
+        _enable_cpu_cross_process_collectives()
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nproc,
                                    process_id=int(proc_id or 0))
         if verbose:
             logger.info(f"jax.distributed initialized: process {jax.process_index()}/{jax.process_count()}")
+    # initialize() blocks until every process joined the (freshly bound)
+    # coordinator, so this instant is gang-synchronized to within the release
+    # skew — monitored_barrier uses it to reject a PREVIOUS job's leftover
+    # rendezvous files when no DSTPU_JOB_ID scopes the rendezvous dir
+    global _init_done_unix
+    _init_done_unix = time.time()
     cdb = XLABackend()
     return cdb
+
+
+_init_done_unix = None  # set by init_distributed (gang-synchronized instant)
+
+
+def _enable_cpu_cross_process_collectives():
+    """CPU gangs need an explicit cross-process collectives backend: the
+    default CPU client refuses multi-process computations outright
+    ("Multiprocess computations aren't implemented on the CPU backend"), which
+    is what broke ``test_local_two_process_training`` from seed. jaxlib ships
+    gloo; selecting it *before* ``jax.distributed.initialize`` makes a
+    multi-process CPU mesh a real gang — the tier-1 formulation every gang
+    fault-tolerance gate trains on. TPU/GPU platforms are untouched (their
+    collectives ride ICI/DCN/NCCL natively)."""
+    import jax
+    platforms = (getattr(jax.config, "jax_platforms", None)
+                 or os.environ.get("JAX_PLATFORMS") or "")
+    if not platforms:
+        # unset = jax autodetects; guessing CPU here would break TPU/GPU
+        # hosts, but a CPU-only host WILL hit "Multiprocess computations
+        # aren't implemented on the CPU backend" — say so up front
+        logger.warning("multi-process init with JAX_PLATFORMS unset: if this "
+                       "host resolves to the CPU backend, set "
+                       "JAX_PLATFORMS=cpu so the gloo cross-process "
+                       "collectives backend is selected")
+        return
+    if platforms.split(",")[0].strip().lower() != "cpu":
+        return
+    try:
+        if getattr(jax.config, "jax_cpu_collectives_implementation", None) != "gloo":
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            logger.info("CPU gang: cross-process collectives backend = gloo")
+    except Exception as e:  # older jaxlibs without the option: surface, don't die
+        logger.warning(f"could not select gloo CPU collectives ({e}); "
+                       f"multi-process CPU computations may be unavailable")
 
 
 def destroy_process_group(group=None):
@@ -498,7 +540,182 @@ def barrier(group=None):
     jax.effects_barrier()
 
 
-def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
+class BarrierTimeoutError(RuntimeError):
+    """``monitored_barrier`` expired its deadline; the message names the
+    absent ranks (the reference raises the first absent rank unless
+    ``wait_all_ranks`` — here the full set is always collected, it costs
+    nothing with a file rendezvous)."""
+
+
+DEFAULT_BARRIER_TIMEOUT_S = 300.0
+
+# per-(name) generation counters: barrier semantics require every rank to
+# reach every barrier, so per-process counters agree across the gang
+_barrier_generations = {}
+
+
+def _barrier_timeouts_metric():
+    from deepspeed_tpu import telemetry
+    if not telemetry.is_active():
+        return None
+    return telemetry.get_registry().counter(
+        "barrier_timeouts_total",
+        "monitored_barrier deadline expiries (absent ranks named in the error)")
+
+
+def _barrier_rendezvous_dir():
+    """Where ranks rendezvous: the gang dir when the elastic agent armed one
+    (shared-fs multi-host gangs set it explicitly), else a coordinator-keyed
+    tempdir — same-host CPU gangs (the tier-1 formulation) share /tmp."""
+    from deepspeed_tpu.elasticity.gang import GANG_DIR_ENV
+    gang_dir = os.environ.get(GANG_DIR_ENV)
+    if gang_dir:
+        return os.path.join(gang_dir, "barriers")
+    coord = os.environ.get("DSTPU_COORDINATOR") or os.environ.get("COORDINATOR_ADDRESS")
+    if not coord:
+        return None
+    import hashlib
+    import tempfile
+    # key by coordinator AND the per-launch job nonce (launcher/launch.py,
+    # DSElasticAgent both export one): a later job reusing the same
+    # coordinator address must never rendezvous against this job's leftovers
+    job = os.environ.get("DSTPU_JOB_ID", "")
+    key = hashlib.sha1(f"{coord}|{job}".encode()).hexdigest()[:12]
+    return os.path.join(tempfile.gettempdir(), f"dstpu_barrier_{key}")
+
+
+def _file_barrier(bdir, name, generation, rank, world, timeout_s, poll_s=0.02,
+                  min_unix=None, on_wait=None):
+    """Rendezvous: every rank drops ``<name>.g<gen>.rank<k>`` and polls until
+    all ``world`` files of this generation exist. Deadline expiry raises
+    :class:`BarrierTimeoutError` naming the absent ranks. Files persist one
+    generation (a rank may observe completion and race ahead before a slow
+    peer has read the files), then each rank reaps its own older ones.
+
+    ``min_unix``: only accept peer files stamped at or after it — the guard
+    against a PREVIOUS job's leftovers in a shared rendezvous dir (a stale
+    file predates the current job's coordinator bind, so any stamp from this
+    gang's init epoch onward is fresh; only meaningful when all ranks share
+    one clock). None = accept any file. ``on_wait`` is called once per poll
+    iteration while waiting (liveness reporting)."""
+    import time as _time
+    os.makedirs(bdir, exist_ok=True)
+
+    def fname(g, r):
+        return os.path.join(bdir, f"{name}.g{g}.rank{r}")
+
+    accepted = set()  # a once-fresh file can only be replaced by a fresher one
+
+    def present(g, r):
+        if r in accepted:
+            return True
+        fp = fname(g, r)
+        if not os.path.exists(fp):
+            return False
+        if min_unix is not None:
+            try:
+                with open(fp) as f:
+                    import json as _json
+                    if _json.load(f).get("unix", 0) < min_unix:
+                        return False
+            except (OSError, ValueError):
+                return False  # torn/stale: the owner rewrites it atomically
+        accepted.add(r)
+        return True
+
+    from deepspeed_tpu.elasticity.gang import atomic_write_json
+    atomic_write_json(fname(generation, rank), {"rank": rank, "unix": _time.time()})
+    deadline = _time.monotonic() + timeout_s
+    while True:
+        absent = [r for r in range(world) if not present(generation, r)]
+        if not absent:
+            break
+        if _time.monotonic() > deadline:
+            m = _barrier_timeouts_metric()
+            if m is not None:
+                m.inc()
+            raise BarrierTimeoutError(
+                f"monitored_barrier {name!r} (generation {generation}) timed "
+                f"out after {timeout_s:.1f}s: rank {rank} waited on absent "
+                f"ranks {absent} of world {world}")
+        if on_wait is not None:
+            on_wait()
+        _time.sleep(poll_s)
+    # reap this rank's file from two generations back — old enough that every
+    # peer has necessarily left that barrier (they are at generation-1+)
+    if generation >= 2:
+        try:
+            os.unlink(fname(generation - 2, rank))
+        except OSError:
+            pass
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks=False, name="monitored"):
+    """A barrier that actually enforces its ``timeout`` (the reference's
+    torch.distributed ``monitored_barrier``; the seed version silently
+    dropped it — a dead rank wedged its peers forever). ``timeout`` is
+    seconds or a ``datetime.timedelta``; expiry raises
+    :class:`BarrierTimeoutError` naming the absent ranks and counts
+    ``barrier_timeouts_total``.
+
+    Multi-process gangs rendezvous through files (the gang dir when the
+    elastic agent armed one, else a coordinator-keyed tempdir — CPU gangs
+    share a host). Single-process worlds reduce to an effects barrier. When
+    no rendezvous dir is derivable (no gang dir, no coordinator), the
+    deadline is unenforceable; that is logged loudly and the call falls
+    back to the plain barrier."""
+    import datetime
+    import jax
+    world = jax.process_count()
+    if world <= 1:
+        barrier(group)
+        return
+    if isinstance(timeout, datetime.timedelta):
+        timeout_s = timeout.total_seconds()
+    else:
+        timeout_s = DEFAULT_BARRIER_TIMEOUT_S if timeout is None else float(timeout)
+    bdir = _barrier_rendezvous_dir()
+    if bdir is None:
+        logger.warning("monitored_barrier: no rendezvous dir (set "
+                       "DSTPU_GANG_DIR or DSTPU_COORDINATOR); the timeout "
+                       "cannot be enforced — falling back to a plain barrier")
+        barrier(group)
+        return
+    rank = jax.process_index()
+    # scope by supervision life: a relaunched gang starts at generation 0
+    # again, and the previous life's rendezvous files must not satisfy it
+    name = f"{name}.l{os.environ.get('DSTPU_RESTART_COUNT', '0') or '0'}"
+    generation = _barrier_generations.get(name, 0)
+    _barrier_generations[name] = generation + 1
+    # collective entry is a liveness event: a rank blocked here past the
+    # deadline raises; a rank that never *arrives* shows a stale heartbeat.
+    # While WAITING, keep beating (throttled): a rank legitimately parked at
+    # a barrier behind a slow peer is making supervised progress — the hang
+    # watchdog must not tear down a healthy gang for it
+    from deepspeed_tpu.elasticity.gang import GANG_DIR_ENV, GangHeartbeat
+    hb = GangHeartbeat.from_env(rank=rank)
+    on_wait = None
+    if hb is not None:
+        hb.beat(phase=f"barrier:{name}")
+        last_beat = [time.monotonic()]
+
+        def on_wait():
+            now = time.monotonic()
+            if now - last_beat[0] >= 1.0:
+                last_beat[0] = now
+                hb.beat(phase=f"barrier:{name}")
+    # without a job-scoped dir (manual launches: no DSTPU_JOB_ID) a previous
+    # job on the same coordinator left files here; anything stamped before
+    # this gang's init epoch (minus clock slack) is stale — a dead rank must
+    # time the barrier out, not be impersonated by a leftover. Only armed on
+    # the host-local tempdir path: a shared-fs gang dir spans hosts whose
+    # wall clocks must not be compared
+    min_unix = None
+    if not os.environ.get("DSTPU_JOB_ID") and not os.environ.get(GANG_DIR_ENV) \
+            and _init_done_unix is not None:
+        min_unix = _init_done_unix - 5.0
+    _file_barrier(bdir, name, generation, rank, world, timeout_s,
+                  min_unix=min_unix, on_wait=on_wait)
     barrier(group)
 
 

@@ -1,4 +1,6 @@
 from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent, ElasticAgentError
 from deepspeed_tpu.elasticity.elasticity import (ElasticityConfig, ElasticityError,
                                                  compute_elastic_config, elasticity_enabled)
+from deepspeed_tpu.elasticity.gang import (GangHeartbeat, read_gang_state,
+                                           read_heartbeats, write_gang_state)
 from deepspeed_tpu.elasticity.train_supervisor import TrainSupervisor

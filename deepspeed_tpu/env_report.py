@@ -217,8 +217,92 @@ def checkpoint_report(save_dir, keep_last_k=None):
     return 0 if all_good else 1
 
 
+def gang_report(gang_dir):
+    """``dstpu_report --gang <dir>``: render the elastic agent's gang state —
+    per-rank liveness (heartbeat age/step/phase, pid, exit code), crash/hang
+    history, current vs valid world sizes and the last shrink event. Returns
+    0 when the gang is running/done with no recorded failures, 1 otherwise."""
+    import os
+    import time
+
+    from deepspeed_tpu.elasticity.gang import read_gang_state, read_heartbeats
+
+    gang_dir = os.path.abspath(gang_dir)
+    state = read_gang_state(gang_dir)
+    beats = read_heartbeats(gang_dir)
+    print("-" * 78)
+    print(f"gang dir ............... {gang_dir}")
+    if state is None and not beats:
+        print("no gang state or heartbeats found (is this a DSTPU_GANG_DIR?)")
+        return 2
+    state = state or {}
+    age = time.time() - state["updated_unix"] if "updated_unix" in state else None
+    print(f"phase .................. {state.get('phase', '?')}"
+          f"{f'  (state written {age:.1f}s ago)' if age is not None else ''}")
+    print(f"world .................. {state.get('world', '?')} of initial "
+          f"{state.get('initial_world', '?')} "
+          f"(valid: {state.get('valid_worlds', '?')})")
+    print(f"restarts ............... {state.get('restart_count', '?')}"
+          f"/{state.get('max_restarts', '?')}  crashes in window: "
+          f"{state.get('crashes_in_window', '?')}/{state.get('max_crashes', '?')} "
+          f"(window {state.get('crash_window_s', '?')}s)")
+    hang = state.get("hang_timeout_s")
+    print(f"hang watchdog .......... "
+          f"{f'{hang}s heartbeat staleness' if hang else 'off'}")
+    shrink = state.get("last_shrink")
+    if shrink:
+        print(f"last shrink ............ world {shrink.get('from')} → "
+              f"{shrink.get('to')} after {shrink.get('crashes')} crash(es) "
+              f"(life {shrink.get('life')})")
+    print("-" * 78)
+    ranks = state.get("ranks") or {str(r): {"heartbeat": hb}
+                                   for r, hb in beats.items()}
+    failures = 0
+    for rank in sorted(ranks, key=int):
+        doc = ranks[rank] or {}
+        hb = beats.get(int(rank)) or doc.get("heartbeat")
+        alive = doc.get("alive")
+        rc = doc.get("exit_code")
+        if alive:
+            live = GREEN_OK + " alive"
+        elif alive is None:
+            live = "?  unknown"
+        elif rc == 143:
+            # the agent's preemption contract: 143 = TrainingPreempted with
+            # the final checkpoint committed — a clean drain, not a failure
+            live = GREEN_OK + " exit=143 (preempted)"
+        else:
+            live = (GREEN_OK if rc == 0 else RED_NO) + f" exit={rc}"
+        if rc not in (None, 0, 143):
+            failures += 1
+        if hb:
+            beat = (f"beat {hb.get('age_s', 0):.1f}s ago  "
+                    f"step={hb.get('step')}  phase={hb.get('phase')}")
+        else:
+            beat = "no heartbeat this life"
+        print(f"rank {rank:<4} {live:<18} {beat}")
+    events = state.get("events") or []
+    if events:
+        print("-" * 78)
+        for ev in events[-10:]:
+            print(f"life {ev.get('life'):<3} world={ev.get('world'):<3} "
+                  f"{ev.get('kind'):<8} {ev.get('detail') or ''}")
+    print("-" * 78)
+    bad = failures or any(ev.get("kind") in ("crash", "hang") for ev in events) \
+        or state.get("phase") == "failed"
+    print(f"verdict ................ "
+          f"{RED_NO + ' failures recorded' if bad else GREEN_OK + ' gang healthy'}")
+    return 1 if bad else 0
+
+
 def main(argv=None):
     argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if "--gang" in argv:
+        idx = argv.index("--gang")
+        if idx + 1 >= len(argv):
+            print("usage: dstpu_report --gang <dir>")
+            return 2
+        return gang_report(argv[idx + 1])
     if "--checkpoint" in argv:
         idx = argv.index("--checkpoint")
         if idx + 1 >= len(argv):

@@ -71,12 +71,18 @@ def main(argv=None):
     signal.signal(signal.SIGINT, kill_children)
     signal.signal(signal.SIGTERM, kill_children)
 
+    # one nonce per launch, shared by every rank: rendezvous artifacts keyed
+    # by it (monitored_barrier's file barrier) can never be satisfied by a
+    # previous job's leftovers on the same coordinator address
+    import time as _time
+    job_id = os.environ.get("DSTPU_JOB_ID", f"{os.getpid()}.{_time.time():.0f}")
     for local_rank, global_rank in enumerate(local_ranks):
         env = os.environ.copy()
         env.update({
             "DSTPU_COORDINATOR": coordinator,
             "DSTPU_NUM_PROCESSES": str(world_size),
             "DSTPU_PROCESS_ID": str(global_rank),
+            "DSTPU_JOB_ID": job_id,
             # torch-compatible aliases (reference launch.py exports these)
             "RANK": str(global_rank),
             "LOCAL_RANK": str(local_rank),

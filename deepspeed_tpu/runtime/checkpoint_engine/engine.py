@@ -171,6 +171,73 @@ def _atexit_barrier(engine_ref):
                      f"exit: {e}")
 
 
+# -------------------------------------------------------------- gang seals --
+SEAL_DIR = ".seals"
+
+
+def _seal_path(path, rank):
+    return os.path.join(path, SEAL_DIR, f"rank{int(rank)}.sealed")
+
+
+def _clear_rank_seal(path, rank):
+    """Drop this rank's seal from a previous save of the same tag (rollback
+    replays re-save tags): while the state dir is being rewritten, a stale
+    seal must not satisfy rank 0's all-ranks-sealed check."""
+    try:
+        os.unlink(_seal_path(path, rank))
+    except OSError:
+        pass
+
+
+def _write_rank_seal(path, rank):
+    """This rank's array commit is durable. Written atomically AFTER the
+    orbax commit and BEFORE rank 0 may write the manifest — the per-rank half
+    of the gang commit protocol."""
+    import time
+    from deepspeed_tpu.elasticity.gang import atomic_write_json
+    os.makedirs(os.path.join(path, SEAL_DIR), exist_ok=True)
+    atomic_write_json(_seal_path(path, rank),
+                      {"rank": int(rank), "pid": os.getpid(), "unix": time.time()})
+
+
+def _await_gang_seals(path, process_count, timeout_s, poll_s=0.05):
+    """Rank 0's half of the gang commit: block until EVERY rank's shard seal
+    exists, then (and only then) is the manifest allowed to be written. A
+    rank that died mid-save never seals, the deadline expires, and the tag
+    stays torn — which ``load_checkpoint`` already falls back past loudly.
+    Raises RuntimeError naming the absent ranks on expiry."""
+    import time
+    deadline = time.monotonic() + timeout_s
+    while True:
+        absent = [r for r in range(process_count)
+                  if not os.path.isfile(_seal_path(path, r))]
+        if not absent:
+            return
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"gang checkpoint commit: ranks {absent} never sealed their "
+                f"shards within {timeout_s:.1f}s — leaving {path} torn "
+                f"(no manifest); a peer likely died mid-save")
+        time.sleep(poll_s)
+
+
+def _maybe_die_during_save(engine, path):
+    """``die_during_save`` chaos point (runtime/faults.py): the targeted rank
+    SIGKILLs itself between its array commit and its shard seal — the
+    mid-save death whose only acceptable outcome is a torn tag."""
+    inj = getattr(engine, "_train_faults", None)
+    if inj is None:
+        return
+    import jax
+    rank = jax.process_index()
+    n = inj.fire_rank("die_during_save", rank)
+    if n is not None:
+        import signal
+        logger.error(f"chaos: rank {rank} dying during save #{n} of {path} "
+                     f"(array commit done, shard seal withheld)")
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
 # --------------------------------------------------------------- checksums --
 def _crc32_bytes(data, crc=0):
     return zlib.crc32(data, crc) & 0xFFFFFFFF
@@ -446,6 +513,29 @@ def _manifest_meta(engine, tag, host_state, arrays_crc, keep_last_k):
     }
 
 
+def _gang_commit(engine, path, save_dir, tag, host_state, save_latest,
+                 manifest_meta, keep_last_k):
+    """Cross-rank commit atomicity (ISSUE 12c): per-rank shard seals land
+    FIRST — each rank seals only after its own array commit is durable — and
+    rank 0 writes the manifest LAST, after a deadline-bounded all-ranks-sealed
+    check. A rank dying mid-save therefore yields a manifest-less (torn) tag,
+    never a sealed manifest over missing shards. Single-process worlds reduce
+    to seal-then-commit with no wait."""
+    import jax
+    rank = jax.process_index()
+    count = jax.process_count()
+    _maybe_die_during_save(engine, path)
+    _write_rank_seal(path, rank)
+    if rank != 0:
+        return
+    if count > 1:
+        ck_cfg = getattr(engine._config, "checkpoint_config", None)
+        timeout_s = float(getattr(ck_cfg, "gang_seal_timeout_s", 60.0) or 60.0)
+        _await_gang_seals(path, count, timeout_s)
+    _commit_host_side(engine, path, save_dir, tag, host_state, save_latest,
+                      manifest_meta, keep_last_k)
+
+
 def _commit_host_side(engine, path, save_dir, tag, host_state, save_latest,
                       manifest_meta, keep_last_k):
     """The durable-marker tail of a save, strictly ordered AFTER the array
@@ -514,11 +604,24 @@ def save_engine_state(engine, save_dir, tag, client_state, save_latest,
     # re-saving an existing tag (e.g. replaying steps after a sentinel
     # rollback): drop the stale manifest FIRST, synchronously — while the
     # state dir is being rewritten the tag must read as torn, never as a
-    # valid-looking seal over mismatched files
+    # valid-looking seal over mismatched files. Rank 0 drops the WHOLE seal
+    # dir (not just its own seal): a peer delayed entering this save must
+    # never have its previous-save seal satisfy the all-ranks-sealed check.
+    # Orbax's save itself barriers the gang before any rank reaches
+    # _gang_commit, so fresh seals are always written after this wipe; if
+    # that ordering ever breaks, the failure mode is a seal-wait timeout
+    # (torn tag, loud fallback) — never a manifest over mismatched shards.
     import jax as _jax
     stale_manifest = os.path.join(path, MANIFEST_FILE)
-    if _jax.process_index() == 0 and os.path.isfile(stale_manifest):
-        os.unlink(stale_manifest)
+    if _jax.process_index() == 0:
+        if os.path.isfile(stale_manifest):
+            os.unlink(stale_manifest)
+        shutil.rmtree(os.path.join(path, SEAL_DIR), ignore_errors=True)
+    else:
+        _clear_rank_seal(path, _jax.process_index())
+    hb = getattr(engine, "_gang_hb", None)
+    if hb is not None:
+        hb.beat(step=engine.global_steps, phase="save")
 
     arrays = {
         "params": engine.params,
@@ -553,8 +656,8 @@ def save_engine_state(engine, save_dir, tag, client_state, save_latest,
         ck = OrbaxCheckpointEngine()
         ck.save(arrays, os.path.join(path, "state"))
         ck.wait()  # checkpoint must be durable before save_checkpoint returns
-        _commit_host_side(engine, path, save_dir, tag, host_state, save_latest,
-                          manifest_meta, keep_last_k)
+        _gang_commit(engine, path, save_dir, tag, host_state, save_latest,
+                     manifest_meta, keep_last_k)
         logger.info(f"Saved checkpoint to {path}")
         return True
 
@@ -576,8 +679,8 @@ def save_engine_state(engine, save_dir, tag, client_state, save_latest,
     def finalize():
         try:
             ck.finish()
-            _commit_host_side(engine, path, save_dir, tag, host_state,
-                              save_latest, manifest_meta, keep_last_k)
+            _gang_commit(engine, path, save_dir, tag, host_state,
+                         save_latest, manifest_meta, keep_last_k)
             logger.info(f"Async checkpoint committed to {path}")
         except BaseException as e:  # surfaced at the next checkpoint_barrier
             st["error"] = (tag, e)
@@ -686,6 +789,38 @@ def load_engine_state(engine, load_dir, tag, load_optimizer_states=True, load_lr
         f"no verified-good checkpoint under {load_dir}: " + "; ".join(failures))
 
 
+def _put_restored(tree, shardings):
+    """Multiprocess-safe placement of a restored tree: orbax restored every
+    leaf against the engine's CURRENT shardings, so a leaf that is already a
+    non-fully-addressable global array is on the right mesh and passes
+    through — ``device_put`` would refuse it (it only accepts addressable
+    shardings as targets). Fully-addressable leaves (the single-process
+    path, and host scalars) keep the defensive device_put."""
+    import jax
+
+    def put(leaf, sh):
+        if leaf is None:
+            return None
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            return leaf
+        try:
+            return jax.device_put(leaf, sh)
+        except ValueError:
+            # a host value bound for a sharding that spans non-addressable
+            # devices (e.g. the replicated loss-scale scalars on a
+            # multi-process mesh): place it SPMD via a jitted constant —
+            # every process executes this load path at the same point, and
+            # the value is identical everywhere (it came from the manifest-
+            # sealed checkpoint both read)
+            import jax.numpy as jnp
+            host = np.asarray(jax.device_get(leaf))
+            return jax.jit(lambda: jnp.asarray(host), out_shardings=sh)()
+
+    if isinstance(shardings, jax.sharding.Sharding):
+        return jax.tree.map(lambda leaf: put(leaf, shardings), tree)
+    return jax.tree.map(put, tree, shardings)
+
+
 def _restore_into_engine(engine, path, load_optimizer_states,
                          load_lr_scheduler_states, load_module_only,
                          verify_arrays):
@@ -709,7 +844,7 @@ def _restore_into_engine(engine, path, load_optimizer_states,
                 f"checkpoint {path}: restored arrays fail the manifest's "
                 f"per-array CRC32 ({bad[:4]}{'...' if len(bad) > 4 else ''})")
 
-    engine.params = jax.device_put(restored["params"], engine._param_shardings)
+    engine.params = _put_restored(restored["params"], engine._param_shardings)
     if load_optimizer_states and not load_module_only:
         # restore straight into the at-rest placement (pinned host when
         # offloaded, NVMe files under ZeRO-Infinity)
@@ -720,8 +855,9 @@ def _restore_into_engine(engine, path, load_optimizer_states,
         # scalars must live on the CURRENT mesh (restored under a different
         # topology they'd sit on one device and poison the jitted step)
         rep = NamedSharding(engine.mesh, P())
-        engine.scale_state = LossScaleState(**{k: jax.device_put(restored["scale_state"][k], rep)
-                                               for k in ("cur_scale", "good_steps", "hysteresis")})
+        engine.scale_state = LossScaleState(
+            **{k: _put_restored(restored["scale_state"][k], rep)
+               for k in ("cur_scale", "good_steps", "hysteresis")})
 
     with open(os.path.join(path, "host_state.pkl"), "rb") as f:
         host_state = pickle.load(f)

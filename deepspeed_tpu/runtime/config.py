@@ -86,6 +86,11 @@ class CheckpointConfig(DeepSpeedConfigModel):
     the final synchronous checkpoint inside this window
     (``engine.install_preemption_handler``)."""
 
+    gang_seal_timeout_s: float = Field(60.0, gt=0)
+    """Multi-process commit atomicity: how long rank 0 waits for every
+    rank's shard seal before abandoning the commit (the tag stays torn — a
+    peer that died mid-save must never be sealed over)."""
+
 
 class DataTypesConfig(DeepSpeedConfigModel):
     grad_accum_dtype: Optional[str] = None

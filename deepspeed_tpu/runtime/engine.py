@@ -458,6 +458,13 @@ class DeepSpeedEngine:
             self._sentinel = LossAnomalySentinel(sent_cfg)
         from deepspeed_tpu.runtime.faults import injector_from_env
         self._train_faults = injector_from_env(os.environ.get("DSTPU_TRAIN_FAULTS"))
+        # gang liveness: when launched under the elastic agent's watchdog
+        # (DSTPU_GANG_DIR armed) every rank heartbeats from the train loop so
+        # a wedged collective is detectable; disabled = one env read here
+        from deepspeed_tpu.elasticity.gang import GangHeartbeat
+        import jax as _jax_rank
+        self._gang_rank = _jax_rank.process_index()
+        self._gang_hb = GangHeartbeat.from_env(rank=self._gang_rank)
         self._ckpt_save_dir = None
         self._sentinel_good_step = None
         self._preempt_event = None
@@ -894,11 +901,30 @@ class DeepSpeedEngine:
             self._current_lr = self.lr_scheduler.get_last_lr()[0]
 
     # ------------------------------------------------------- fault tolerance --
+    def _pre_step_fault_hooks(self):
+        """Step-entry gang hooks: heartbeat (this rank is alive AND making
+        train-loop progress — the signal the elastic agent's watchdog reads),
+        then the ``hang_rank_at_step`` chaos point — a sleep *inside* the
+        step, after the beat, so the wedge develops exactly like a stuck
+        collective: process alive, heartbeat going stale, peers blocking."""
+        if self._gang_hb is not None:
+            self._gang_hb.beat(step=self.global_steps, phase="step")
+        inj = self._train_faults
+        if inj is not None and inj.fire_step_rank(
+                "hang_rank_at_step", self.global_steps, self._gang_rank) is not None:
+            import time as _time
+            logger.error(f"chaos: rank {self._gang_rank} hanging "
+                         f"{inj.config.hang_seconds:.0f}s at step "
+                         f"{self.global_steps} (wedged-collective shape)")
+            _time.sleep(inj.config.hang_seconds)
+
     def _after_boundary_step(self, loss):
         """Fault-tolerance hooks at a COMPLETED optimizer step: sentinel
         observation (anomaly counting / rollback), chaos kill/sigterm points,
         and the preemption finalizer — the 'finish the in-flight step, then
         act' ordering."""
+        if self._gang_hb is not None:
+            self._gang_hb.beat(step=self.global_steps, phase="idle")
         if self._sentinel is not None and loss is not None:
             self._observe_loss(loss)
         inj = self._train_faults
@@ -908,6 +934,11 @@ class DeepSpeedEngine:
                 os.kill(os.getpid(), signal.SIGTERM)
             if inj.fire_step("kill_at_step", self.global_steps) is not None:
                 logger.error(f"chaos: SIGKILL at step {self.global_steps}")
+                os.kill(os.getpid(), signal.SIGKILL)
+            if inj.fire_step_rank("kill_rank_at_step", self.global_steps,
+                                  self._gang_rank) is not None:
+                logger.error(f"chaos: SIGKILL rank {self._gang_rank} at step "
+                             f"{self.global_steps} (gang-death shape)")
                 os.kill(os.getpid(), signal.SIGKILL)
         self._maybe_finalize_preemption()
 
@@ -1125,6 +1156,7 @@ class DeepSpeedEngine:
         # a preemption notice that arrived between steps exits BEFORE paying
         # for another one (mid-step notices finalize at this step's end)
         self._maybe_finalize_preemption()
+        self._pre_step_fault_hooks()
         gas = self.gradient_accumulation_steps()
         if isinstance(batch, StagedBatch):
             batch = batch.tree

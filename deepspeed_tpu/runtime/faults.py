@@ -48,11 +48,23 @@ from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 from deepspeed_tpu.utils.logging import logger
 
 POINTS = ("kill_at_step", "sigterm_at_step", "nan_inject",
-          "checkpoint_corrupt", "checkpoint_truncate")
+          "checkpoint_corrupt", "checkpoint_truncate",
+          "kill_rank_at_step", "hang_rank_at_step", "die_during_save")
 
 # step-indexed points consult would_fire(point, global_step); the checkpoint
 # points consume a sequential per-point event counter (one event per save)
-STEP_POINTS = ("kill_at_step", "sigterm_at_step", "nan_inject")
+STEP_POINTS = ("kill_at_step", "sigterm_at_step", "nan_inject",
+               "kill_rank_at_step", "hang_rank_at_step")
+
+# rank-scoped points (the gang chaos vocabulary, ISSUE 12): the schedule is
+# still a pure function of (seed, point, index) — the rank is a *scope*, so
+# an identical seed replays the identical gang-wide fault schedule
+RANK_POINTS = ("kill_rank_at_step", "hang_rank_at_step", "die_during_save")
+
+# points suppressed on restarted lives under only_first_life (a deterministic
+# kill/hang/die replayed after resume would crash-loop the supervision)
+ONE_SHOT_POINTS = ("kill_at_step", "sigterm_at_step",
+                   "kill_rank_at_step", "hang_rank_at_step", "die_during_save")
 
 _EVENT_LOG_CAP = 512
 
@@ -84,6 +96,34 @@ class TrainFaultConfig(DeepSpeedConfigModel):
     checkpoint_corrupt_p: float = Field(0.0, ge=0, le=1)
     checkpoint_truncate_p: float = Field(0.0, ge=0, le=1)
 
+    # -- rank-scoped gang points (ISSUE 12) --
+    kill_rank: int = Field(0, ge=0)
+    """Which rank ``kill_rank_at_step`` targets (the gang-death shape: one
+    rank SIGKILLed leaves its peers wedged in the next collective)."""
+
+    kill_rank_at_steps: Tuple[int, ...] = ()
+    kill_rank_at_step_p: float = Field(0.0, ge=0, le=1)
+
+    hang_rank: int = Field(0, ge=0)
+    """Which rank ``hang_rank_at_step`` targets."""
+
+    hang_rank_at_steps: Tuple[int, ...] = ()
+    hang_rank_at_step_p: float = Field(0.0, ge=0, le=1)
+
+    hang_seconds: float = Field(3600.0, gt=0)
+    """How long a hung rank sleeps inside the step — long enough that the
+    watchdog (not the sleep's end) must resolve the wedge."""
+
+    die_during_save_rank: int = Field(0, ge=0)
+    """Which rank ``die_during_save`` targets (rank 0 = the manifest writer;
+    any other rank = a missing shard seal — both must yield a torn tag)."""
+
+    die_during_save_at: Tuple[int, ...] = ()
+    """Save indices (sequential per process life) at which the targeted rank
+    SIGKILLs itself between its array commit and its shard seal."""
+
+    die_during_save_p: float = Field(0.0, ge=0, le=1)
+
 
 def first_life() -> bool:
     """True when this process is the supervisor's first spawn (or
@@ -106,7 +146,10 @@ class TrainFaultInjector:
     def _steps(self, point: str) -> Tuple[int, ...]:
         return {"kill_at_step": self.config.kill_at_steps,
                 "sigterm_at_step": self.config.sigterm_at_steps,
-                "nan_inject": self.config.nan_at_steps}.get(point, ())
+                "nan_inject": self.config.nan_at_steps,
+                "kill_rank_at_step": self.config.kill_rank_at_steps,
+                "hang_rank_at_step": self.config.hang_rank_at_steps,
+                "die_during_save": self.config.die_during_save_at}.get(point, ())
 
     def _p(self, point: str) -> float:
         return getattr(self.config,
@@ -141,9 +184,9 @@ class TrainFaultInjector:
 
     def fire_step(self, point: str, step: int) -> Optional[int]:
         """Step-indexed firing: fires at most once per (point, step) per
-        process life, and kill/sigterm only on the first life (see
-        ``only_first_life``)."""
-        if point in ("kill_at_step", "sigterm_at_step") \
+        process life, and the lethal points (kill/sigterm/hang/die) only on
+        the first life (see ``only_first_life``)."""
+        if point in ONE_SHOT_POINTS \
                 and self.config.only_first_life and not first_life():
             return None
         with self._lock:
@@ -153,6 +196,44 @@ class TrainFaultInjector:
             seen.add(step)
             self._record(point, step)
             return step
+
+    # ------------------------------------------------------------- rank scope --
+    def target_rank(self, point: str) -> int:
+        """The rank a rank-scoped point targets (schedule stays rank-blind:
+        the rank is config, not part of the seeded derivation)."""
+        return {"kill_rank_at_step": self.config.kill_rank,
+                "hang_rank_at_step": self.config.hang_rank,
+                "die_during_save": self.config.die_during_save_rank}[point]
+
+    def fire_step_rank(self, point: str, step: int, rank: int) -> Optional[int]:
+        """Rank-scoped step firing: like :meth:`fire_step`, but only the
+        targeted rank fires — every other rank (including ranks that only
+        exist at a larger world size) sees None. A schedule targeting rank 1
+        therefore goes quiet by construction after a shrink to world=1."""
+        if point not in RANK_POINTS:
+            raise ValueError(f"{point!r} is not rank-scoped (know {RANK_POINTS})")
+        if int(rank) != self.target_rank(point):
+            return None
+        return self.fire_step(point, step)
+
+    def fire_rank(self, point: str, rank: int) -> Optional[int]:
+        """Rank-scoped sequential-event firing (``die_during_save``: one
+        event per save). EVERY rank consumes the event index — the schedule
+        is gang-wide and save-indexed — but only the targeted rank fires."""
+        if point not in RANK_POINTS:
+            raise ValueError(f"{point!r} is not rank-scoped (know {RANK_POINTS})")
+        if point in ONE_SHOT_POINTS \
+                and self.config.only_first_life and not first_life():
+            return None
+        with self._lock:
+            n = self._counters.get(point, 0)
+            self._counters[point] = n + 1
+            if int(rank) != self.target_rank(point):
+                return None
+            if self.would_fire(point, n):
+                self._record(point, n)
+                return n
+        return None
 
     def _record(self, point, n):
         # caller holds the lock

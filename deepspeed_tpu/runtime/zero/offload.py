@@ -156,9 +156,18 @@ class OptimizerOffloadPlan:
         return opt_state
 
     def accept_restored(self, opt_state):
-        """Place a freshly restored state tree into its at-rest home."""
+        """Place a freshly restored state tree into its at-rest home. Leaves
+        that are already non-fully-addressable global arrays (a multi-process
+        restore: orbax placed them against the current shardings) pass
+        through — device_put refuses non-addressable targets."""
         import jax
-        return jax.device_put(opt_state, self.rest_shardings)
+
+        def put(leaf, sh):
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                return leaf
+            return jax.device_put(leaf, sh)
+
+        return jax.tree.map(put, opt_state, self.rest_shardings)
 
     # -- choreography path (no-ops when host_compute or disabled) ----------------
     def stage_in(self, opt_state):

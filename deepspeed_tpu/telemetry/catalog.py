@@ -34,6 +34,14 @@ METRIC_FAMILIES = {
     "train_rollbacks_total": "sentinel rollbacks to the last good checkpoint",
     "train_restarts_total": "training process restarts by the supervisor after a crash",
     "train_faults_injected_total": "faults injected by the training chaos harness",
+    # gang fault tolerance (elasticity/elastic_agent.py, comm/comm.py)
+    "train_gang_crashes_total": "rank crashes observed by the gang watchdog",
+    "train_gang_hangs_total": "wedged ranks detected via stale heartbeat",
+    "train_gang_teardowns_total": "whole-gang teardowns (SIGTERM-grace-SIGKILL)",
+    "train_gang_relaunches_total": "gang relaunches by the elastic agent",
+    "train_gang_shrinks_total": "crash-budget shrinks to a smaller world size",
+    "train_gang_world_size": "current gang world size (processes)",
+    "barrier_timeouts_total": "monitored_barrier deadline expiries (absent ranks named in the error)",
     # comms layer (telemetry/__init__.record_comm_op)
     "comm_op_latency_seconds": "per-collective wall latency",
     "comm_op_bytes": "per-collective message size",

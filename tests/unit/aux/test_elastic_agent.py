@@ -98,6 +98,134 @@ def test_agent_no_valid_world_raises():
         agent.next_world_size(capacity=2)
 
 
+def test_kill_escalation_sigterm_grace_sigkill_reap(tmp_path):
+    """A worker that ignores SIGTERM must be SIGKILLed within the grace
+    budget and reaped — teardown can never wait forever on a wedged rank."""
+    import subprocess
+    import time
+
+    path = tmp_path / "stubborn.py"
+    path.write_text(textwrap.dedent("""
+        import signal, time
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        print("armed", flush=True)
+        time.sleep(600)
+    """))
+    agent = DSElasticAgent([sys.executable, str(path)], num_processes=1,
+                           term_grace_s=0.5)
+    proc = subprocess.Popen([sys.executable, str(path)],
+                            stdout=subprocess.PIPE)
+    proc.stdout.readline()  # SIGTERM handler installed
+    t0 = time.monotonic()
+    agent._kill([proc])
+    assert time.monotonic() - t0 < 5.0, "escalation must be bounded by grace"
+    assert proc.poll() is not None, "the straggler must be reaped"
+    assert proc.returncode == -9, "SIGTERM ignored -> SIGKILL"
+
+
+def test_preempt_143_drains_gang_without_counting_a_crash(tmp_path):
+    """One rank exiting 143 (TrainingPreempted: final checkpoint committed)
+    drains the peers via SIGTERM — their preemption handlers run — and the
+    agent exits 143 with zero restarts (the PR-11 contract at gang scope)."""
+    path = tmp_path / "worker.py"
+    path.write_text(textwrap.dedent(f"""
+        import os, pathlib, signal, sys, time
+        d = pathlib.Path({str(repr(str(tmp_path)))})
+        rank = os.environ["DSTPU_PROCESS_ID"]
+        if rank == "0":
+            time.sleep(0.3)
+            sys.exit(143)
+        def on_term(signum, frame):
+            (d / f"drained{{rank}}").write_text("1")
+            sys.exit(0)
+        signal.signal(signal.SIGTERM, on_term)
+        time.sleep(600)
+    """))
+    agent = DSElasticAgent([sys.executable, str(path)], num_processes=2,
+                           max_restarts=3, monitor_interval=0.05,
+                           term_grace_s=5.0)
+    assert agent.run() == 143
+    assert agent.restart_count == 0, "preemption is not a crash"
+    assert (tmp_path / "drained1").exists(), \
+        "the surviving rank's preemption handler must have run"
+
+
+def test_crash_budget_shrinks_then_succeeds_at_smaller_world(tmp_path):
+    """max_crashes at world=2 exhausts the budget -> relaunch at world=1
+    (elasticity off: world-1), where the workers succeed."""
+    path = tmp_path / "worker.py"
+    path.write_text(textwrap.dedent("""
+        import os, sys
+        sys.exit(7 if os.environ["DSTPU_NUM_PROCESSES"] == "2" else 0)
+    """))
+    agent = DSElasticAgent([sys.executable, str(path)], num_processes=2,
+                           max_restarts=5, monitor_interval=0.05,
+                           max_crashes=2, crash_window_s=600.0,
+                           gang_dir=str(tmp_path / "gang"))
+    assert agent.run() == 0
+    assert agent.restart_count == 2 and agent.world == 1
+    assert agent.last_shrink == {**agent.last_shrink, "from": 2, "to": 1}
+    from deepspeed_tpu.elasticity.gang import read_gang_state
+    state = read_gang_state(agent.gang_dir)
+    assert state["phase"] == "done" and state["world"] == 1
+    assert [ev["kind"] for ev in state["events"]].count("crash") == 2
+
+
+def test_watchdog_detects_stale_heartbeat_and_relaunches(tmp_path):
+    """A rank that beats once and then wedges (process alive, no train-loop
+    progress) is detected via heartbeat staleness; the gang is torn down and
+    the relaunch succeeds. Pure stdlib workers — the watchdog mechanism is
+    independent of JAX."""
+    path = tmp_path / "worker.py"
+    path.write_text(textwrap.dedent(f"""
+        import json, os, pathlib, sys, time
+        d = pathlib.Path(os.environ["DSTPU_GANG_DIR"])
+        rank = os.environ["DSTPU_PROCESS_ID"]
+        life = os.environ["DSTPU_RESTART_COUNT"]
+        tmp = d / f"rank{{rank}}.hb.tmp"
+        tmp.write_text(json.dumps({{"rank": int(rank), "unix": time.time(),
+                                    "step": 1, "phase": "step"}}))
+        os.replace(tmp, d / f"rank{{rank}}.hb")
+        if life == "0" and rank == "1":
+            time.sleep(600)  # wedged: alive, never beats again
+        sys.exit(0)
+    """))
+    agent = DSElasticAgent([sys.executable, str(path)], num_processes=2,
+                           max_restarts=2, monitor_interval=0.05,
+                           gang_dir=str(tmp_path / "gang"),
+                           hang_timeout_s=0.6, term_grace_s=0.5)
+    assert agent.run() == 0
+    assert agent.restart_count == 1
+    from deepspeed_tpu.elasticity.gang import read_gang_state
+    state = read_gang_state(agent.gang_dir)
+    hangs = [ev for ev in state["events"] if ev["kind"] == "hang"]
+    assert hangs and "rank(s) [1]" in hangs[0]["detail"]
+
+
+def test_watchdog_boot_deadline_catches_never_beaten_gang(tmp_path):
+    """A gang wedged BEFORE its first heartbeat (e.g. stuck inside the
+    coordination-service rendezvous) is invisible to exit polling and to
+    staleness; the boot deadline bounds it."""
+    path = tmp_path / "worker.py"
+    path.write_text(textwrap.dedent("""
+        import os, sys, time
+        if os.environ["DSTPU_RESTART_COUNT"] == "0":
+            time.sleep(600)  # wedged at boot: alive, never heartbeats
+        sys.exit(0)
+    """))
+    agent = DSElasticAgent([sys.executable, str(path)], num_processes=2,
+                           max_restarts=2, monitor_interval=0.05,
+                           gang_dir=str(tmp_path / "gang"),
+                           hang_timeout_s=5.0, boot_timeout_s=0.8,
+                           term_grace_s=0.5)
+    assert agent.run() == 0
+    assert agent.restart_count == 1
+    from deepspeed_tpu.elasticity.gang import read_gang_state
+    state = read_gang_state(agent.gang_dir)
+    hangs = [ev for ev in state["events"] if ev["kind"] == "hang"]
+    assert hangs and "wedged at boot" in hangs[0]["detail"]
+
+
 def test_agent_restart_shrinks_world_end_to_end(tmp_path):
     """Failure + reduced capacity → relaunch with a *smaller, valid* world;
     workers observe the shrunken DSTPU_NUM_PROCESSES."""

@@ -1,0 +1,251 @@
+"""Gang fault tolerance (ISSUE 12): the flagship CPU gates.
+
+Real 2-process CPU gangs (gloo collectives, per-rank subprocess JAX runtimes)
+under the elastic agent's watchdog:
+
+- a rank SIGKILLed at a seeded step is detected, the gang is torn down and
+  auto-resumed — same world on the first crash, shrink-to-world=1 after the
+  crash budget — from the last sealed checkpoint, and the final loss AND
+  params are **bitwise-identical** to an uninterrupted run at the resumed
+  configuration;
+- a rank *hung* inside a step (the wedged-collective shape, invisible to
+  exit-code polling) is detected via stale heartbeat within the deadline and
+  the gang recovers at the same world;
+- a rank killed mid-save leaves a torn tag (per-rank seals land first, the
+  manifest last) that resume loudly falls back past;
+- identical seed/config ⇒ identical chaos schedule.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+from deepspeed_tpu.elasticity import DSElasticAgent
+from deepspeed_tpu.elasticity.gang import read_gang_state
+from tests.unit.gang_harness import (base_env, params_npz_equal, read_marker,
+                                     write_gang_script)
+
+pytestmark = pytest.mark.nightly
+
+
+def _agent(script, env, tmp_path, **kw):
+    kw.setdefault("num_processes", 2)
+    kw.setdefault("monitor_interval", 0.1)
+    kw.setdefault("term_grace_s", 2.0)
+    kw.setdefault("gang_dir", str(tmp_path / "gang"))
+    return DSElasticAgent([sys.executable, script], env=env, **kw)
+
+
+def test_flagship_kill_rank_shrink_resume_bitwise(tmp_path):
+    """Rank 1 SIGKILLed after step 3, every life. Life 0 (world=2) crashes →
+    relaunch at the SAME world (first crash); life 1 crashes the same way →
+    crash budget spent → shrink to world=1; life 2 (world=1) never fires the
+    rank-1 kill (the rank does not exist) and completes. Final loss and
+    params must be bitwise-identical to an uninterrupted world=1 run resumed
+    from the same last-sealed checkpoint."""
+    script = write_gang_script(tmp_path)
+    ckdir = tmp_path / "ck"
+    marker = tmp_path / "marker.json"
+    params = tmp_path / "params.npz"
+    env = base_env(tmp_path, ckdir, total_steps=6,
+                   DSTPU_GANG_MARKER=marker, DSTPU_FINAL_PARAMS=params)
+    env["DSTPU_TRAIN_FAULTS"] = json.dumps(
+        {"enabled": True, "kill_rank_at_steps": [3], "kill_rank": 1,
+         "only_first_life": False})
+
+    agent = _agent(script, env, tmp_path, max_restarts=4,
+                   max_crashes=2, crash_window_s=600.0)
+    assert agent.run() == 0
+
+    assert agent.restart_count == 2, "one same-world retry, then the shrink"
+    assert agent.world == 1
+    assert agent.last_shrink and agent.last_shrink["from"] == 2 \
+        and agent.last_shrink["to"] == 1
+    doc = read_marker(marker)
+    assert doc["world"] == 1 and doc["final_step"] == 6
+    assert doc["loss"] is not None
+
+    state = read_gang_state(agent.gang_dir)
+    kinds = [ev["kind"] for ev in state["events"]]
+    assert kinds.count("crash") == 2 and "shrink" in kinds and kinds[-1] == "done"
+
+    # ---- the uninterrupted comparison run at the resumed configuration ----
+    # resume from the same last-sealed checkpoint (global_step2: the step-3
+    # kill fires inside train_batch, before the script's save of step 3)
+    ctrl = tmp_path / "ctrl_ck"
+    ctrl.mkdir()
+    shutil.copytree(ckdir / "global_step2", ctrl / "global_step2")
+    (ctrl / "latest").write_text("global_step2")
+    ctrl_marker = tmp_path / "ctrl_marker.json"
+    ctrl_params = tmp_path / "ctrl_params.npz"
+    ctrl_env = base_env(tmp_path, ctrl, total_steps=6,
+                        DSTPU_GANG_MARKER=ctrl_marker,
+                        DSTPU_FINAL_PARAMS=ctrl_params,
+                        DSTPU_NUM_PROCESSES=1, DSTPU_PROCESS_ID=0)
+    r = subprocess.run([sys.executable, script], env=ctrl_env, timeout=240,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "resumed_step=2" in r.stdout
+
+    ctrl_doc = read_marker(ctrl_marker)
+    assert ctrl_doc["loss"] == doc["loss"], \
+        "chaos-resumed final loss must be bitwise-identical to uninterrupted"
+    assert params_npz_equal(params, ctrl_params), \
+        "chaos-resumed final params must be bitwise-identical to uninterrupted"
+
+
+def test_hang_rank_detected_within_deadline_and_recovered(tmp_path):
+    """Rank 1 sleeps inside step 3 (wedged-collective shape): its process
+    stays alive — and rank 0, blocked in the collective, stops progressing
+    too — so only the heartbeat watchdog can see it. Detection must land
+    within the staleness deadline (not the 300 s sleep), the gang is torn
+    down, and the relaunch (kill suppressed: first-life-only) completes at
+    the same world."""
+    script = write_gang_script(tmp_path)
+    ckdir = tmp_path / "ck"
+    marker = tmp_path / "marker.json"
+    env = base_env(tmp_path, ckdir, total_steps=4, DSTPU_GANG_MARKER=marker)
+    env["DSTPU_TRAIN_FAULTS"] = json.dumps(
+        {"enabled": True, "hang_rank_at_steps": [2], "hang_rank": 1,
+         "hang_seconds": 300.0})
+
+    agent = _agent(script, env, tmp_path, max_restarts=2,
+                   hang_timeout_s=8.0)
+    t0 = time.monotonic()
+    assert agent.run() == 0
+    elapsed = time.monotonic() - t0
+    assert elapsed < 150.0, \
+        f"watchdog must beat the 300s hang by a wide margin (took {elapsed:.0f}s)"
+
+    assert agent.restart_count == 1
+    state = read_gang_state(agent.gang_dir)
+    hangs = [ev for ev in state["events"] if ev["kind"] == "hang"]
+    assert hangs and "stale" in hangs[0]["detail"]
+    doc = read_marker(marker)
+    assert doc["world"] == 2 and doc["final_step"] == 4
+
+
+def test_die_during_save_leaves_torn_tag_resume_falls_back_loudly(tmp_path):
+    """Rank 1 SIGKILLed between its array commit and its shard seal on the
+    third save (tag global_step3): rank 0 must never seal over the missing
+    shard — the tag stays torn (no MANIFEST.json) — and a resume walks past
+    it LOUDLY to the newest verified-good tag."""
+    from deepspeed_tpu.elasticity import ElasticAgentError
+    script = write_gang_script(tmp_path)
+    ckdir = tmp_path / "ck"
+    env = base_env(tmp_path, ckdir, total_steps=6)
+    env["DSTPU_TRAIN_FAULTS"] = json.dumps(
+        {"enabled": True, "die_during_save_at": [2], "die_during_save_rank": 1})
+
+    agent = _agent(script, env, tmp_path, max_restarts=0)
+    with pytest.raises(ElasticAgentError):
+        agent.run()  # the mid-save death is a crash; no restarts allowed
+
+    torn = ckdir / "global_step3"
+    assert torn.is_dir(), "the array commit ran before the death"
+    assert not (torn / "MANIFEST.json").exists(), \
+        "a mid-save rank death must never be sealed over"
+    assert (ckdir / "global_step2" / "MANIFEST.json").exists()
+
+    # resume at world=1 with the `latest` pointer gone: the walk meets the
+    # torn step-3 tag first and must fall back past it loudly
+    os.unlink(ckdir / "latest")
+    env1 = base_env(tmp_path, ckdir, total_steps=4,
+                    DSTPU_NUM_PROCESSES=1, DSTPU_PROCESS_ID=0)
+    r = subprocess.run([sys.executable, script], env=env1, timeout=240,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "resumed_step=2" in r.stdout, "must land on the newest GOOD tag"
+    assert "TORN" in (r.stdout + r.stderr), "the fallback must be loud"
+
+
+def test_rank_chaos_schedule_is_seed_deterministic():
+    """Identical seed/config ⇒ identical gang-wide schedule, and the rank is
+    a scope (not part of the derivation): only the targeted rank fires."""
+    from deepspeed_tpu.runtime.faults import TrainFaultConfig, TrainFaultInjector
+    cfg = dict(enabled=True, seed=7, kill_rank_at_step_p=0.3, kill_rank=1,
+               hang_rank_at_step_p=0.2, hang_rank=0, die_during_save_p=0.5,
+               die_during_save_rank=1, only_first_life=False)
+    a = TrainFaultInjector(TrainFaultConfig(**cfg))
+    b = TrainFaultInjector(TrainFaultConfig(**cfg))
+    for point in ("kill_rank_at_step", "hang_rank_at_step", "die_during_save"):
+        assert a.schedule(point, 64) == b.schedule(point, 64)
+        assert a.schedule(point, 64), f"p>0 must fire somewhere in 64 ({point})"
+    other_seed = TrainFaultInjector(TrainFaultConfig(**{**cfg, "seed": 8}))
+    assert any(a.schedule(p, 64) != other_seed.schedule(p, 64)
+               for p in ("kill_rank_at_step", "die_during_save"))
+
+    # rank scoping: the untargeted rank never fires but (die_during_save)
+    # still consumes the gang-wide event index
+    step = a.schedule("kill_rank_at_step", 64)[0]
+    fresh = TrainFaultInjector(TrainFaultConfig(**cfg))
+    assert fresh.fire_step_rank("kill_rank_at_step", step, 0) is None
+    assert fresh.fire_step_rank("kill_rank_at_step", step, 1) == step
+    save_idx = a.schedule("die_during_save", 64)[0]
+    fresh = TrainFaultInjector(TrainFaultConfig(**cfg))
+    for _ in range(save_idx):
+        assert fresh.fire_rank("die_during_save", 0) is None
+    assert fresh.fire_rank("die_during_save", 0) is None, "wrong rank: no fire"
+    fresh2 = TrainFaultInjector(TrainFaultConfig(**cfg))
+    for _ in range(save_idx):
+        fresh2.fire_rank("die_during_save", 1)
+    assert fresh2.fire_rank("die_during_save", 1) == save_idx
+
+
+def test_gang_report_renders_state_and_liveness(tmp_path, capsys):
+    """``dstpu_report --gang <dir>``: per-rank liveness, crash history,
+    current/valid worlds, last shrink — from the agent's state document and
+    the live heartbeat files."""
+    from deepspeed_tpu.elasticity.gang import GangHeartbeat, write_gang_state
+    from deepspeed_tpu.env_report import gang_report, main
+
+    gang_dir = tmp_path / "gang"
+    GangHeartbeat(str(gang_dir), 0).beat(step=5, phase="step")
+    write_gang_state(str(gang_dir), {
+        "phase": "running", "world": 1, "initial_world": 2,
+        "valid_worlds": [1, 2], "restart_count": 2, "max_restarts": 4,
+        "crashes_in_window": 0, "max_crashes": 2, "crash_window_s": 600.0,
+        "hang_timeout_s": 8.0,
+        "last_shrink": {"from": 2, "to": 1, "crashes": 2, "life": 1},
+        "events": [{"kind": "crash", "world": 2, "life": 0,
+                    "detail": "rank(s) [1] exited [-9]"},
+                   {"kind": "crash", "world": 2, "life": 1,
+                    "detail": "rank(s) [1] exited [-9]"},
+                   {"kind": "shrink", "world": 2, "life": 1,
+                    "detail": {"from": 2, "to": 1}}],
+        "ranks": {"0": {"alive": True, "exit_code": None, "pid": 123},
+                  "1": {"alive": False, "exit_code": -9}},
+    })
+    rc = gang_report(str(gang_dir))
+    out = capsys.readouterr().out
+    assert rc == 1, "recorded crashes -> non-zero verdict"
+    assert "world 2 → 1" in out and "valid: [1, 2]" in out
+    assert "rank 0" in out and "step=5" in out
+    assert "exit=-9" in out and "failures recorded" in out
+
+    # through the CLI front-end, and the empty-dir edge
+    assert main(["--gang", str(gang_dir)]) == 1
+    capsys.readouterr()
+    assert main(["--gang", str(tmp_path / "nope")]) == 2
+
+
+def test_lethal_rank_points_suppressed_on_restarted_lives(monkeypatch):
+    """only_first_life (default) suppresses kill/hang/die on a restarted
+    life — a deterministic gang kill replayed after resume would crash-loop
+    the agent forever."""
+    from deepspeed_tpu.runtime.faults import TrainFaultConfig, TrainFaultInjector
+    cfg = TrainFaultConfig(enabled=True, kill_rank_at_steps=[3], kill_rank=1,
+                           die_during_save_at=[0], die_during_save_rank=1)
+    monkeypatch.setenv("DSTPU_RESTART_COUNT", "1")
+    inj = TrainFaultInjector(cfg)
+    assert inj.fire_step_rank("kill_rank_at_step", 3, 1) is None
+    assert inj.fire_rank("die_during_save", 1) is None
+    monkeypatch.setenv("DSTPU_RESTART_COUNT", "0")
+    inj = TrainFaultInjector(cfg)
+    assert inj.fire_step_rank("kill_rank_at_step", 3, 1) == 3
+    assert inj.fire_rank("die_during_save", 1) == 0

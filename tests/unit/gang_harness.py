@@ -1,0 +1,156 @@
+"""Shared harness for multi-process (gang) training tests.
+
+Real 2-process CPU gangs: each rank is a subprocess with its own JAX runtime
+(2 virtual CPU devices via ``--xla_force_host_platform_device_count``), a
+coordination-service rendezvous on a per-life port, and gloo cross-process
+collectives (selected by ``comm.init_distributed`` on CPU platforms). The
+training script is deliberately the same shape as ``examples/train_zero3.py``
+fault-tolerant mode: data is a pure function of the global step, one
+checkpoint per step, resume-from-latest-good at start — the
+chaos-equivalence contract every gate in this suite leans on.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Env contract (beyond the agent's DSTPU_NUM_PROCESSES/DSTPU_PROCESS_ID):
+#   DSTPU_PORT_BASE      coordinator port for life 0; life k uses base+k so a
+#                        relaunch never races a dying coordinator's socket
+#   DSTPU_GANG_CKPT      checkpoint dir (resume authority = the child)
+#   DSTPU_TOTAL_STEPS    train until global_steps reaches this
+#   DSTPU_GANG_STAGE     ZeRO stage (default 2)
+#   DSTPU_GANG_MARKER    rank 0 writes {world, final_step, loss} on completion
+#   DSTPU_FINAL_PARAMS   world=1 runs dump final params (bitwise-compare file)
+GANG_SCRIPT = """
+import os, sys, json
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=2"
+nproc = int(os.environ.get("DSTPU_NUM_PROCESSES", "1") or 1)
+if nproc > 1:
+    base = int(os.environ["DSTPU_PORT_BASE"])
+    life = int(os.environ.get("DSTPU_ELASTIC_RESTART", "0") or 0)
+    os.environ["DSTPU_COORDINATOR"] = f"127.0.0.1:{base + life}"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import deepspeed_tpu
+deepspeed_tpu.comm.init_distributed()
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+class Loss(nn.Module):
+    @nn.compact
+    def __call__(self, batch):
+        x, y = batch
+        return jnp.mean((nn.Dense(4)(x).sum(-1) - y) ** 2)
+
+
+def batch_for_step(step):
+    # pure function of the global step: a resumed run replays the exact
+    # batches an uninterrupted one would see (the chaos-equivalence contract)
+    rng = np.random.default_rng(1000 + step)
+    x = rng.normal(size=(8, 8)).astype(np.float32)
+    y = (x[:, 0] * 0.5 - x[:, 1]).astype(np.float32)
+    return x, y
+
+
+model = Loss()
+params = model.init(jax.random.PRNGKey(0),
+                    tuple(map(jnp.asarray, batch_for_step(0))))["params"]
+cfg = {
+    # a GLOBAL batch size: the config re-derives the per-device micro-batch
+    # from the current device count, so a shrunk/grown world keeps the
+    # effective batch constant (the micro-batch-rescale contract)
+    "train_batch_size": 8,
+    "gradient_accumulation_steps": 1,
+    "optimizer": {"type": "AdamW", "params": {"lr": 0.01}},
+    "zero_optimization": {"stage": int(os.environ.get("DSTPU_GANG_STAGE", "2"))},
+    "checkpoint": {"verify_arrays_on_load": True, "gang_seal_timeout_s": 20.0},
+}
+engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                           config=cfg)
+ckdir = os.environ["DSTPU_GANG_CKPT"]
+path, _ = engine.load_checkpoint(ckdir)
+print(f"GANG life={os.environ.get('DSTPU_RESTART_COUNT', '0')} "
+      f"world={jax.process_count()} resumed_step={engine.global_steps} "
+      f"from={'fresh' if path is None else path}", flush=True)
+total = int(os.environ.get("DSTPU_TOTAL_STEPS", "6"))
+loss = None
+while engine.global_steps < total:
+    loss = engine.train_batch(batch=batch_for_step(engine.global_steps))
+    engine.save_checkpoint(ckdir)
+if jax.process_index() == 0 and os.environ.get("DSTPU_GANG_MARKER"):
+    with open(os.environ["DSTPU_GANG_MARKER"], "w") as f:
+        json.dump({"world": jax.process_count(),
+                   "final_step": engine.global_steps,
+                   "loss": None if loss is None else f"{float(loss):.17g}"}, f)
+out = os.environ.get("DSTPU_FINAL_PARAMS")
+if out and jax.process_count() == 1:
+    flat = jax.tree_util.tree_flatten_with_path(jax.device_get(engine.params))[0]
+    np.savez(out, **{jax.tree_util.keystr(k): np.asarray(v) for k, v in flat})
+engine.destroy()
+print("GANG done", flush=True)
+"""
+
+
+def write_gang_script(tmp_path):
+    script = tmp_path / "gang_train.py"
+    script.write_text(GANG_SCRIPT)
+    return str(script)
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def base_env(tmp_path, ckpt_dir, total_steps, **extra):
+    env = os.environ.copy()
+    env.pop("XLA_FLAGS", None)
+    env.pop("DSTPU_TRAIN_FAULTS", None)
+    env.pop("DSTPU_GANG_DIR", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DSTPU_PORT_BASE"] = str(free_port())
+    env["DSTPU_GANG_CKPT"] = str(ckpt_dir)
+    env["DSTPU_TOTAL_STEPS"] = str(total_steps)
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def run_gang_once(script, env, world, timeout=240):
+    """One gang life WITHOUT the agent (the cross-world matrix runs): spawn
+    ``world`` rank subprocesses directly and wait for all. Returns the list
+    of ``CompletedProcess`` (check=False; callers assert)."""
+    procs = []
+    for rank in range(world):
+        rank_env = dict(env)
+        rank_env["DSTPU_NUM_PROCESSES"] = str(world)
+        rank_env["DSTPU_PROCESS_ID"] = str(rank)
+        procs.append(subprocess.Popen(
+            [sys.executable, script], env=rank_env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    out = []
+    for p in procs:
+        stdout, stderr = p.communicate(timeout=timeout)
+        out.append(subprocess.CompletedProcess(p.args, p.returncode, stdout, stderr))
+    return out
+
+
+def read_marker(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def params_npz_equal(path_a, path_b):
+    import numpy as np
+    a, b = np.load(path_a), np.load(path_b)
+    if sorted(a.files) != sorted(b.files):
+        return False
+    return all(np.array_equal(a[k], b[k]) for k in a.files)

@@ -1,0 +1,73 @@
+"""Cross-world save/load matrix (VERDICT missing #4 — the reference's
+``DistributedFixture`` pattern, ``tests/unit/common.py:239``): a checkpoint
+saved at one world size must load at another, both directions, because the
+elastic agent's shrink-to-fit (and grow-back) resume IS this path.
+
+Real process gangs (the reference fixture's spirit, through the actual
+launch contract): save at world=2 (two subprocesses, gloo collectives, 2
+virtual devices each), load at world=1 — and 1→2 — for ZeRO stages 1 and 3.
+The shrink direction additionally proves **bitwise-deterministic resume**:
+two independent world=1 resumes of the same world=2 checkpoint finish with
+identical final loss and identical final params, byte for byte (the
+correctness anchor the flagship gang gate builds on).
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+from tests.unit.gang_harness import (base_env, params_npz_equal, read_marker,
+                                     run_gang_once, write_gang_script)
+
+pytestmark = pytest.mark.nightly
+
+
+def _resume_world1(script, tmp_path, ckdir, stage, total, name):
+    marker = tmp_path / f"{name}.json"
+    params = tmp_path / f"{name}.npz"
+    env = base_env(tmp_path, ckdir, total_steps=total, DSTPU_GANG_STAGE=stage,
+                   DSTPU_GANG_MARKER=marker, DSTPU_FINAL_PARAMS=params,
+                   DSTPU_NUM_PROCESSES=1, DSTPU_PROCESS_ID=0)
+    r = subprocess.run([sys.executable, script], env=env, timeout=240,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return r.stdout, read_marker(marker), params
+
+
+@pytest.mark.parametrize("stage", [1, 3])
+def test_save_world2_load_world1_bitwise_and_grow_back(tmp_path, stage):
+    script = write_gang_script(tmp_path)
+
+    # ---- save at world=2 (the elastic gang's native formulation) ----
+    ckdir = tmp_path / f"ck_s{stage}"
+    env = base_env(tmp_path, ckdir, total_steps=2, DSTPU_GANG_STAGE=stage)
+    results = run_gang_once(script, env, world=2)
+    for r in results:
+        assert r.returncode == 0, r.stderr[-2000:]
+    assert "world=2" in results[0].stdout
+    assert (ckdir / "global_step2" / "MANIFEST.json").exists()
+
+    # ---- load at world=1 (shrink): two INDEPENDENT resumes, each on its
+    # own copy of the world=2 checkpoint dir — bitwise-identical outcome ----
+    import shutil
+    dir_b = tmp_path / f"ck_s{stage}_b"
+    shutil.copytree(ckdir, dir_b)
+    out, doc_a, params_a = _resume_world1(script, tmp_path, ckdir, stage,
+                                          total=4, name=f"s{stage}_resume_a")
+    assert "resumed_step=2" in out and "world=1" in out
+    assert doc_a["final_step"] == 4 and doc_a["loss"] is not None
+    _, doc_b, params_b = _resume_world1(script, tmp_path, dir_b, stage,
+                                        total=4, name=f"s{stage}_resume_b")
+    assert doc_a["loss"] == doc_b["loss"], \
+        "two resumes of the same cross-world checkpoint must agree bitwise"
+    assert params_npz_equal(params_a, params_b)
+
+    # ---- load at world=2 (grow-back): the world=1 continuation's newest
+    # tag reshards up onto the two-process mesh and training continues ----
+    env2 = base_env(tmp_path, ckdir, total_steps=6, DSTPU_GANG_STAGE=stage)
+    results = run_gang_once(script, env2, world=2)
+    for r in results:
+        assert r.returncode == 0, r.stderr[-2000:]
+    assert "resumed_step=4" in results[0].stdout and "world=2" in results[0].stdout
+    assert (ckdir / "global_step6" / "MANIFEST.json").exists()

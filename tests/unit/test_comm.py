@@ -110,6 +110,83 @@ def test_p2p_raises_with_guidance():
         dist.recv(np.zeros(4), src=0)
 
 
+def test_monitored_barrier_single_process_passes():
+    # world=1 reduces to an effects barrier; the timeout is trivially met
+    dist.monitored_barrier(timeout=0.5)
+
+
+def test_monitored_barrier_file_rendezvous_all_ranks(tmp_path):
+    """The multi-process rendezvous core: N threads playing N ranks all
+    arrive -> everyone passes; repeated barriers advance the generation."""
+    import threading
+
+    from deepspeed_tpu.comm.comm import _file_barrier
+
+    errors = []
+
+    def rank(r, gen):
+        try:
+            _file_barrier(str(tmp_path), "b", gen, r, 3, timeout_s=5.0)
+        except Exception as e:  # surfaced on the main thread
+            errors.append(e)
+
+    for gen in range(3):  # three consecutive barriers (generation reuse)
+        threads = [threading.Thread(target=rank, args=(r, gen)) for r in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errors
+
+
+def test_monitored_barrier_rejects_previous_jobs_stale_files(tmp_path):
+    """A later job reusing the same rendezvous dir must not be satisfied by
+    a previous job's leftover files: with ``min_unix`` armed (no DSTPU_JOB_ID
+    scoping), anything stamped before this gang's init epoch is stale and a
+    dead rank still times the barrier out."""
+    import json
+    import time
+
+    from deepspeed_tpu.comm.comm import BarrierTimeoutError, _file_barrier
+
+    # the "previous job": rank 1 arrived long ago at the same name/generation
+    stale = tmp_path / "b.g0.rank1"
+    stale.write_text(json.dumps({"rank": 1, "unix": time.time() - 3600}))
+    with pytest.raises(BarrierTimeoutError, match=r"absent ranks \[1\]"):
+        _file_barrier(str(tmp_path), "b", 0, 0, 2, timeout_s=0.3,
+                      min_unix=time.time() - 60)
+    # a FRESH peer file passes the same threshold
+    fresh = tmp_path / "c.g0.rank1"
+    fresh.write_text(json.dumps({"rank": 1, "unix": time.time()}))
+    _file_barrier(str(tmp_path), "c", 0, 0, 2, timeout_s=2.0,
+                  min_unix=time.time() - 60)
+
+
+def test_monitored_barrier_timeout_names_absent_ranks(tmp_path):
+    """The seed bug: monitored_barrier accepted a timeout and ignored it —
+    a dead rank wedged its peers forever. Now the deadline is enforced and
+    the error names exactly who never arrived."""
+    from deepspeed_tpu.comm.comm import BarrierTimeoutError, _file_barrier
+
+    with pytest.raises(BarrierTimeoutError, match=r"absent ranks \[1, 2\]"):
+        _file_barrier(str(tmp_path), "t", 0, 0, 3, timeout_s=0.3)
+
+
+def test_monitored_barrier_timeout_counts_metric(tmp_path):
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.comm.comm import BarrierTimeoutError, _file_barrier
+    from deepspeed_tpu.telemetry import TelemetryConfig
+
+    telemetry.configure(TelemetryConfig(enabled=True))
+    try:
+        with pytest.raises(BarrierTimeoutError):
+            _file_barrier(str(tmp_path), "m", 0, 0, 2, timeout_s=0.1)
+        assert telemetry.get_registry().counter("barrier_timeouts_total").value == 1
+    finally:
+        telemetry.shutdown()
+        telemetry.state.registry = None
+
+
 def test_group_and_capability_surface():
     assert dist.get_world_group() is None
     assert dist.new_group() is None
