@@ -26,6 +26,12 @@ Measurement targets (single chip, v5e):
   float() fetch).
 - Block-sparse attention at 8k seq; evoformer at AF2 MSA shapes.
 
+``bench.py --microbench`` runs ONLY the on-device kernel suite (paged-
+attention decode, int4 unpack, block-sparse, evoformer) — two-point
+differenced like the decode loop, structured-skip safe — so kernel numbers
+accrue automatically whenever a chip is reachable, without paying for the
+full training bench.
+
 FLOPs model: 6*(N - N_embed) dense (fwd+bwd) + 12*L*S*H attention per token
 (PaLM-appendix MFU convention, causal not discounted; embedding lookup
 excluded).
@@ -65,7 +71,7 @@ def _probe_tpu():
     return True, ""
 
 
-def _run_worker(backend, timeout):
+def _run_worker(backend, timeout, microbench=False):
     """Run the measurement body in a subprocess; harvest its checkpoint file.
 
     Returns (result_dict, rc, err_tail). rc -1 = timeout. The checkpoint file
@@ -78,10 +84,12 @@ def _run_worker(backend, timeout):
     if backend == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
     rc, err = 0, ""
+    argv = [sys.executable, os.path.abspath(__file__), "--worker", backend, path]
+    if microbench:
+        argv.append("--microbench")
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--worker", backend, path],
-            capture_output=True, text=True, timeout=timeout, env=env)
+        proc = subprocess.run(argv, capture_output=True, text=True, timeout=timeout,
+                              env=env)
         rc = proc.returncode
         err = (proc.stderr or "").strip()[-400:]
     except subprocess.TimeoutExpired:
@@ -104,6 +112,39 @@ def _run_worker(backend, timeout):
 def _emit(payload):
     print(json.dumps(payload))
     sys.stdout.flush()
+
+
+def main_microbench():
+    """``bench.py --microbench``: on-device kernel microbenches only (paged
+    decode, int4 unpack, block-sparse, evoformer). Same driver contract —
+    one JSON line, exit 0, structured skip when no chip answers. Interpret-
+    mode kernels on CPU are not measurements, so there is no CPU smoke leg."""
+    tpu_ok, why = _probe_tpu()
+    if not tpu_ok:
+        _emit({"metric": "paged_decode_kernel_step_ms", "value": 0.0, "unit": "ms",
+               "vs_baseline": 0.0, "skipped": "tpu_unavailable", "skip_reason": why,
+               "extra": {"mode": "microbench"}})
+        return
+    res, rc, err = _run_worker("tpu", TPU_WORKER_TIMEOUT_S, microbench=True)
+    extra = res.get("extra", {})
+    paged = extra.get("paged_decode", {})
+    out = {
+        "metric": "paged_decode_kernel_step_ms",
+        "value": float(paged.get("kernel_step_ms", 0.0)),
+        "unit": "ms",
+        "vs_baseline": 0.0,
+        "extra": extra,
+    }
+    if "kernel_step_ms" not in paged:
+        # the headline leg errored (or never ran): 0.0 must read as missing,
+        # never as a real measurement
+        out["partial"] = True
+        out["partial_reason"] = (f"paged_decode leg produced no kernel_step_ms "
+                                 f"({paged.get('error', 'leg absent')}); worker rc={rc}: {err}")
+    elif not res.get("done"):
+        out["partial"] = True
+        out["partial_reason"] = f"worker rc={rc}: {err}"
+    _emit(out)
 
 
 def main():
@@ -179,14 +220,16 @@ def _flops_per_token(cfg, n_params, S):
         + 12.0 * cfg.num_hidden_layers * S * cfg.hidden_size
 
 
-def _bench_long_seq(llama, groups, jnp, peak):
-    """Long-sequence training leg (VERDICT r3 #10): S=4096, Pallas flash
-    attention vs dense — flash must win (dense OOMs outright at 8k on 16 GB)."""
+def _bench_attn_compare(llama, groups, jnp, peak, B, S, GAS):
+    """Dense vs Pallas-flash training comparison at one (B, S, GAS) shape —
+    two-point differenced per leg; flash_speedup is the ratio. Reused by the
+    S=4096 long-seq leg AND the S=1024 headline-shape leg (the headline
+    itself now trains with flash; this keeps the dense path selectable and
+    measured for the same shape)."""
     import jax
     import numpy as np
     import deepspeed_tpu
 
-    B, S, GAS = 1, 4096, 4
     out = {}
     for flash in (False, True):
         groups.initialize_mesh(force=True)
@@ -219,6 +262,20 @@ def _bench_long_seq(llama, groups, jnp, peak):
                                  max(out["dense"]["tokens_per_sec"], 1e-9), 2)
     out["seq"] = S
     return out
+
+
+def _bench_long_seq(llama, groups, jnp, peak):
+    """Long-sequence training leg (VERDICT r3 #10): S=4096, Pallas flash
+    attention vs dense — flash must win (dense OOMs outright at 8k on 16 GB)."""
+    return _bench_attn_compare(llama, groups, jnp, peak, B=1, S=4096, GAS=4)
+
+
+def _bench_headline_attention(llama, groups, jnp, peak):
+    """Flash vs dense at the HEADLINE shape (S=1024) — the differenced
+    justification for the headline leg running on the flash kernel (ROADMAP
+    item 1's oldest unpaid debt). GAS shrunk from the headline's 8 to keep
+    the comparison leg short; per-token step time is GAS-independent."""
+    return _bench_attn_compare(llama, groups, jnp, peak, B=8, S=1024, GAS=2)
 
 
 def _bench_inference(llama, groups, jnp):
@@ -552,9 +609,132 @@ def _bench_evoformer(jnp, peak):
             "remat_time_ratio": round(remat / max(plain, 1e-12), 2)}
 
 
-def _worker(backend, result_path):
+def _microbench_paged_decode(jnp, T=8, H=16, KVH=16, D=128, bs=16, S=8, MB=64,
+                             N1=4, N2=20):
+    """Kernel-level paged-attention decode microbench: the Pallas fused
+    KV-insert + blocked-attention kernel vs nothing else — one decode batch
+    (8 sequences x 1 token, 1k context each at the default shape) applied in
+    a chained on-device scan, two-point differenced with a host-fetch
+    barrier (the decode-loop methodology at kernel granularity). Shapes are
+    overridable so the CPU interpret-mode smoke test stays cheap."""
+    import jax
+    from deepspeed_tpu.ops.pallas.paged_attention import paged_attention_update
+
+    NB = S * MB + 1                    # +1: the drop-mode scatter target
+    key = jax.random.PRNGKey(0)
+    mk = lambda i, shape: jax.random.normal(jax.random.fold_in(key, i), shape, jnp.bfloat16)
+    q = mk(0, (T, H, D))
+    k_new = mk(1, (T, KVH, D))
+    v_new = mk(2, (T, KVH, D))
+    cache = mk(3, (1, 2, NB, KVH, bs, D))
+    table = jnp.arange(S * MB, dtype=jnp.int32).reshape(S, MB)
+    token_seq = jnp.arange(T, dtype=jnp.int32)
+    token_pos = jnp.full((T, ), MB * bs - 1, jnp.int32)
+    token_valid = jnp.ones((T, ), bool)
+
+    def make(n):
+        @jax.jit
+        def f(q, cache):
+            def body(carry, _):
+                qq, cache = carry
+                out, cache = paged_attention_update(qq, k_new, v_new, cache, 0, table,
+                                                    token_seq, token_pos, token_valid)
+                # chain through q so the scan cannot be elided or reordered
+                return (q + out * jnp.bfloat16(1e-3), cache), out[0, 0, 0]
+            (_, cache), outs = jax.lax.scan(body, (q, cache), None, length=n)
+            return cache, outs[-1]
+        return f
+
+    f1, f2 = make(N1), make(N2)
+    cache, o = f1(q, cache)
+    float(o)
+    cache, o = f2(q, cache)
+    float(o)
+
+    def t(f, cache):
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cache, o = f(q, cache)
+            float(o)  # host fetch = true barrier
+            best = min(best, time.perf_counter() - t0)
+        return best, cache
+
+    ta, cache = t(f1, cache)
+    tb, cache = t(f2, cache)
+    ms = (tb - ta) / (N2 - N1) * 1e3
+    return {"seqs": S, "context": MB * bs, "heads": H, "head_dim": D,
+            "kernel_step_ms": round(ms, 4),
+            "tokens_per_sec": round(T / max(ms / 1e3, 1e-9), 1)}
+
+
+def _microbench_int4_unpack(jnp, K=4096, N=4096, N1=8, N2=40):
+    """Int4 unpack on the decode critical path: x[1,K] @ W[K,N] with W held
+    bf16 vs packed-int4 (dequantized inside the jit, as the engine does) —
+    the weight-bandwidth story isolated from the rest of the model. Chained
+    scans, two-point differenced."""
+    import jax
+    from deepspeed_tpu.inference.v2.quantization import (_quantize_leaf_int4,
+                                                         dequantize_tree)
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (K, N), jnp.bfloat16)
+    x0 = jax.random.normal(jax.random.fold_in(key, 1), (1, K), jnp.bfloat16)
+    packed = jax.jit(_quantize_leaf_int4)(w)
+
+    def make(n, weights):
+        @jax.jit
+        def f(x):
+            def body(x, _):
+                y = x @ dequantize_tree(weights)   # [1, N] (N == K chains back)
+                # renormalize so the chain neither explodes nor denorms
+                x = (y / (jnp.abs(y).max() + 1e-6)).astype(jnp.bfloat16)
+                return x, y[0, 0]
+            x, ys = jax.lax.scan(body, x, None, length=n)
+            return x, ys[-1]
+        return f
+
+    out = {"K": K, "N": N}
+    for name, weights in (("bf16", w), ("int4", packed)):
+        f1, f2 = make(N1, weights), make(N2, weights)
+        x, y = f1(x0)
+        float(y)
+        x, y = f2(x)
+        float(y)
+
+        def t(f, x):
+            best = 1e9
+            for _ in range(3):
+                t0 = time.perf_counter()
+                x, y = f(x)
+                float(y)
+                best = min(best, time.perf_counter() - t0)
+            return best, x
+
+        ta, x = t(f1, x)
+        tb, x = t(f2, x)
+        out[name] = {"matmul_us": round((tb - ta) / (N2 - N1) * 1e6, 2)}
+    out["int4_speedup"] = round(out["bf16"]["matmul_us"] /
+                                max(out["int4"]["matmul_us"], 1e-9), 2)
+    return out
+
+
+def _microbench_legs(jnp, peak):
+    """The --microbench kernel suite: two-point differenced on-device kernel
+    timings (paged decode, int4 unpack, block-sparse, evoformer) that accrue
+    automatically whenever a chip is reachable."""
+    return (
+        ("paged_decode", lambda: _microbench_paged_decode(jnp)),
+        ("int4_unpack", lambda: _microbench_int4_unpack(jnp)),
+        ("sparse_attention", lambda: _bench_sparse_attention(jnp)),
+        ("evoformer", lambda: _bench_evoformer(jnp, peak)),
+    )
+
+
+def _worker(backend, result_path, microbench=False):
     """Measurement body. Writes the accumulating result dict to result_path
-    after every leg so a mid-leg crash/hang still leaves evidence."""
+    after every leg so a mid-leg crash/hang still leaves evidence.
+    ``microbench`` skips the training/engine legs and runs only the
+    kernel-level suite (``bench.py --microbench``)."""
     if backend == "cpu":
         # site hooks (the axon TPU shim) override JAX_PLATFORMS at startup;
         # re-assert cpu before any backend touch or the smoke worker hangs
@@ -579,12 +759,30 @@ def _worker(backend, result_path):
         os.replace(tmp, result_path)
 
     on_tpu = jax.default_backend() == "tpu"
+
+    if microbench:
+        acc["extra"] = {"mode": "microbench", "backend": jax.default_backend(),
+                        "device": str(jax.devices()[0])}
+        for name, fn in _microbench_legs(jnp, _peak_flops()):
+            try:
+                acc["extra"][name] = fn()
+            except Exception as e:  # noqa: BLE001 — a leg must not kill the bench
+                acc["extra"][name] = {"error": str(e)[:200]}
+            save()
+        acc["done"] = True
+        save()
+        return
     if on_tpu:
         B, S, GAS, STAGE = 8, 1024, 8, 3
+        # the headline leg trains on the Pallas flash kernel (ROADMAP item 1);
+        # DSTPU_BENCH_ATTENTION=dense selects the dense path for A/B runs, and
+        # the headline_attention leg measures both at this shape regardless
+        attention = os.environ.get("DSTPU_BENCH_ATTENTION", "flash")
         cfg = llama.LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5376,
                                 num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=16,
                                 max_position_embeddings=S, remat=True, remat_policy="dots",
-                                dtype=jnp.bfloat16, use_flash_attention=False)
+                                dtype=jnp.bfloat16,
+                                use_flash_attention=(attention != "dense"))
         steps, warmup = 12, 3
     else:  # smoke-test shape for CPU runs
         B, S, GAS, STAGE = 2, 128, 1, 3
@@ -647,6 +845,7 @@ def _worker(backend, result_path):
             "gas": GAS,
             "seq": S,
             "zero_stage": STAGE,
+            "attention": cfg.use_flash_attention and "flash" or "dense",
             "backend": jax.default_backend(),
             "device": str(jax.devices()[0]),
             "loss_final": float(loss),
@@ -658,7 +857,11 @@ def _worker(backend, result_path):
         # free the training engine's HBM before the other legs
         del engine, params
         legs = (
+            ("headline_attention", lambda: _bench_headline_attention(llama, groups, jnp,
+                                                                     _peak_flops())),
             ("long_seq_train", lambda: _bench_long_seq(llama, groups, jnp, _peak_flops())),
+            ("microbench_paged_decode", lambda: _microbench_paged_decode(jnp)),
+            ("microbench_int4_unpack", lambda: _microbench_int4_unpack(jnp)),
             ("inference", lambda: _bench_inference(llama, groups, jnp)),
             ("prefix_cache", lambda: _bench_prefix_cache(llama, groups, jnp)),
             ("int4_weights", lambda: _bench_int4_weights(llama, groups, jnp)),
@@ -678,10 +881,13 @@ def _worker(backend, result_path):
 
 if __name__ == "__main__":
     if len(sys.argv) >= 4 and sys.argv[1] == "--worker":
-        _worker(sys.argv[2], sys.argv[3])
+        _worker(sys.argv[2], sys.argv[3], microbench="--microbench" in sys.argv[4:])
     else:
         try:
-            main()
+            if "--microbench" in sys.argv[1:]:
+                main_microbench()
+            else:
+                main()
         except Exception as e:  # noqa: BLE001 — the driver contract is rc=0 + one JSON line
             _emit({"metric": "llama_train_tokens_per_sec_per_chip", "value": 0.0,
                    "unit": "tokens/s", "vs_baseline": 0.0,

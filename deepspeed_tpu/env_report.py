@@ -316,6 +316,13 @@ def main(argv=None):
                 return 2
             keep = int(argv[kidx + 1])
         return checkpoint_report(argv[idx + 1], keep_last_k=keep)
+    if "--perf" in argv:
+        idx = argv.index("--perf")
+        if idx + 1 >= len(argv):
+            print("usage: dstpu_report --perf <budgets-dir | gate-report.json>")
+            return 2
+        from deepspeed_tpu.perf.reporting import perf_report
+        return perf_report(argv[idx + 1])
     if "--metrics-url" in argv:
         idx = argv.index("--metrics-url")
         if idx + 1 >= len(argv):

@@ -437,6 +437,27 @@ class InferenceEngineV2:
         for uid in list(self._state_manager.tracked_sequences):
             self._state_manager.flush_sequence(uid)
 
+    # ---------------------------------------------------------- lowering hooks --
+    def lowerable_callables(self) -> dict:
+        """The engine's jitted device programs as raw ``jax.jit`` callables
+        (``.lower()``-able), in two buckets: ``forward`` keyed by
+        ``(T, S, MB)`` pad bucket and ``decode_loop`` keyed by
+        ``(bucket, n_steps, sampled)``. This is the official hook for
+        HLO-level analysis (the deepspeed_tpu/perf/ gates); the jit-cache
+        entries themselves may be compile-watch wrappers shared with
+        telemetry and cannot lower."""
+        return self._model.lowerable_callables()
+
+    def lower_forward(self, bucket=None):
+        """``jax.stages.Lowered`` of the ragged forward at ``bucket``
+        (default: the smallest bucket). Never executes."""
+        return self._model.lower_forward(bucket)
+
+    def lower_decode_loop(self, n_steps: int, bucket=None, temperature: float = 0.0):
+        """``jax.stages.Lowered`` of the on-device ``n_steps`` decode scan."""
+        return self._model.lower_decode_loop(n_steps, bucket=bucket,
+                                             temperature=temperature)
+
     # -------------------------------------------------------------- empty_run --
     def empty_run(self) -> None:
         """Participate in EP collectives with zero live tokens (fork

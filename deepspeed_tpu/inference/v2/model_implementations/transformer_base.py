@@ -47,6 +47,7 @@ class DSTransformerModelBase:
         self._engine_config = engine_config
         self._state_manager = None
         self._compiled = {}
+        self._lowerable = {}  # same keys, UNwrapped jit fns (perf-gate hook)
         if state_manager is not None:
             self.set_state_manager(state_manager)
 
@@ -189,6 +190,7 @@ class DSTransformerModelBase:
         import jax
         if bucket not in self._compiled:
             fn = jax.jit(self._forward_impl, donate_argnums=(1, ))
+            self._lowerable[bucket] = fn
             cw = compile_watch.get()
             if cw is not None:
                 # attribute the bucket's XLA compile (and any later internal
@@ -196,6 +198,64 @@ class DSTransformerModelBase:
                 fn = cw.wrap("inference_forward", bucket, fn)
             self._compiled[bucket] = fn
         return self._compiled[bucket]
+
+    # -------------------------------------------------------- lowering hooks --
+    def lowerable_callables(self):
+        """Raw ``jax.jit`` callables (they support ``.lower()``) keyed exactly
+        like ``_compiled``: forward programs by ``(T, S, MB)`` bucket, decode
+        programs by ``(bucket, n_steps, sampled)``. The official hook for
+        HLO-level analysis (deepspeed_tpu/perf/) — the entries in
+        ``_compiled`` may be compile-watch wrappers, which cannot lower."""
+        return {"forward": {k: v for k, v in self._lowerable.items()
+                            if not (isinstance(k, tuple) and len(k) == 3
+                                    and isinstance(k[0], tuple))},
+                "decode_loop": {k: v for k, v in self._lowerable.items()
+                                if isinstance(k, tuple) and len(k) == 3
+                                and isinstance(k[0], tuple)}}
+
+    def _synthetic_batch(self, bucket=None):
+        """Shape/dtype-faithful device-batch arrays for ``bucket`` (default:
+        the smallest bucket the ragged wrapper produces) — lowering needs
+        avals, not live data. Built directly (the wrapper's own pad helpers
+        give the bucket shape): ``RaggedBatchWrapper.finalize`` would report
+        the bucket to the compile watch, and an analysis-only lowering must
+        not pollute the bucket-churn recompile telemetry."""
+        if bucket is None:
+            from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import (_pad_to,
+                                                                          _pow2_pad,
+                                                                          to_padded)
+            bucket = (to_padded(1), _pad_to(1, 8), _pow2_pad(1, 4))
+        T, S, MB = bucket
+        return {"tok_meta": np.zeros((4, T), np.int32),
+                "seq_meta": np.full((S, 4 + MB), -1, np.int32)}
+
+    def lower_forward(self, bucket=None):
+        """Lower the ragged forward at ``bucket`` (``(T, S, MB)``; default
+        smallest) against the live params + paged KV cache and return the
+        ``jax.stages.Lowered``. Never executes; the program is the same
+        ``_forward_impl`` jit :meth:`forward` runs for that bucket."""
+        import jax
+        dev = self._synthetic_batch(bucket)
+        key = (dev["tok_meta"].shape[1], dev["seq_meta"].shape[0],
+               dev["seq_meta"].shape[1] - 4)
+        # reuse the engine's own jit entry when the bucket has run already
+        fn = self._lowerable.get(key) or jax.jit(self._forward_impl, donate_argnums=(1, ))
+        return fn.lower(self._params, self._state_manager.kv_cache.cache, dev)
+
+    def lower_decode_loop(self, n_steps: int, bucket=None, temperature: float = 0.0):
+        """Lower the ``n_steps`` on-device decode program (same
+        ``_decode_loop_impl`` jit as :meth:`decode_loop`)."""
+        import jax
+        import jax.numpy as jnp
+        dev = self._synthetic_batch(bucket)
+        key = ((dev["tok_meta"].shape[1], dev["seq_meta"].shape[0],
+                dev["seq_meta"].shape[1] - 4), int(n_steps), temperature > 0)
+        fn = self._lowerable.get(key) or jax.jit(
+            partial(self._decode_loop_impl, n_steps=int(n_steps),
+                    sampled=temperature > 0),
+            donate_argnums=(1, ))
+        return fn.lower(self._params, self._state_manager.kv_cache.cache, dev,
+                        jnp.float32(temperature), jax.random.PRNGKey(0))
 
     # ------------------------------------------------------------ decode loop --
     def decode_loop(self, ragged_batch, n_steps: int, temperature: float = 0.0,
@@ -230,6 +290,7 @@ class DSTransformerModelBase:
                 partial(self._decode_loop_impl, n_steps=int(n_steps),
                         sampled=temperature > 0),
                 donate_argnums=(1, ))
+            self._lowerable[key] = fn
             cw = compile_watch.get()
             if cw is not None:
                 fn = cw.wrap("inference_decode_loop", key, fn)

@@ -1,0 +1,94 @@
+"""``bin/dstpu_perfgate`` — inspect, diff, and deliberately re-baseline the
+chip-independent perf gates.
+
+Subcommands:
+
+- ``inspect``   build the flagship programs, print stats + roofline (no
+  budget check);
+- ``diff``      current vs checked-in budgets; rc 1 on any violation or a
+  missing budget file; ``--json <out>`` also writes the machine-readable
+  report ``dstpu_report --perf`` renders;
+- ``rebaseline`` rewrite budget files from current measurements (review the
+  diff like code).
+
+The gate environment is pinned here (cpu platform, 8 virtual devices —
+matching tests/conftest.py) BEFORE jax initializes, so CLI numbers and
+tier-1 numbers are the same numbers.
+"""
+
+import argparse
+import os
+import sys
+
+
+def pin_gate_platform() -> None:
+    """Must run before jax touches a backend. Any pre-existing device-count
+    flag is REPLACED, not respected: budgets are only comparable at the
+    tier-1 count (8), and silently lowering on a different mesh would
+    produce bogus collective-key violations (or, worse, rebaseline them)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    kept = [t for t in os.environ.get("XLA_FLAGS", "").split()
+            if not t.startswith("--xla_force_host_platform_device_count")]
+    kept.append("--xla_force_host_platform_device_count=8")
+    os.environ["XLA_FLAGS"] = " ".join(kept)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dstpu_perfgate",
+        description="chip-independent perf gates over the flagship jitted programs")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--program", action="append", default=None,
+                       help="flagship program name (repeatable; default: all)")
+        p.add_argument("--budgets", default=None,
+                       help="budgets directory (default: deepspeed_tpu/perf/budgets)")
+
+    common(sub.add_parser("inspect", help="print stats + roofline, no budget check"))
+    p_diff = sub.add_parser("diff", help="check current programs against budgets")
+    common(p_diff)
+    p_diff.add_argument("--json", default=None, metavar="OUT",
+                        help="also write the gate report JSON here")
+    p_re = sub.add_parser("rebaseline", help="rewrite budget files from current stats")
+    common(p_re)
+    p_re.add_argument("--note", default="", help="recorded in the budget files")
+    args = parser.parse_args(argv)
+
+    pin_gate_platform()
+    from deepspeed_tpu.perf import budgets as budgets_mod
+    from deepspeed_tpu.perf import gate
+    from deepspeed_tpu.perf.programs import FLAGSHIP_PROGRAMS
+    from deepspeed_tpu.perf.reporting import render_gate_report
+
+    names = args.program or list(FLAGSHIP_PROGRAMS)
+    unknown = [n for n in names if n not in FLAGSHIP_PROGRAMS]
+    if unknown:
+        print(f"unknown program(s) {unknown}; known: {sorted(FLAGSHIP_PROGRAMS)}")
+        return 2
+    budgets_dir = args.budgets or budgets_mod.default_budgets_dir()
+
+    if args.cmd == "rebaseline":
+        for path in gate.rebaseline(names, budgets_dir, note=args.note):
+            print(f"wrote {path}")
+        print("review the diff and commit — the ratchet moved on purpose")
+        return 0
+
+    if args.cmd == "inspect":
+        report = gate.GateReport(chip="v5e")
+        for name in names:
+            report.programs[name] = gate.collect_stats(name)
+        print(render_gate_report(report.to_json(), checked=False))
+        return 0
+
+    # diff
+    report = gate.run_gate(names, budgets_dir)
+    if args.json:
+        gate.write_report(report, args.json)
+        print(f"wrote {args.json}")
+    print(render_gate_report(report.to_json()))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

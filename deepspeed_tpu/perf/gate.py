@@ -1,0 +1,166 @@
+"""The perf gate: flagship programs vs checked-in budgets, CPU-only.
+
+``run_gate()`` builds each flagship program (perf/programs.py), extracts its
+:class:`~deepspeed_tpu.perf.hlo_stats.HloStats`, checks them against the
+checked-in budget file (perf/budgets/*.json) and returns a
+:class:`GateReport`. The tier-1 pytest harness
+(tests/unit/perf/test_gate.py, marker ``perfgate``) asserts the report is
+clean; ``bin/dstpu_perfgate`` drives the same entry points interactively and
+``rebaseline()`` rewrites the budget files on purpose.
+
+When telemetry is active the gate also publishes ``perf_*`` gauges so a
+long-lived process (CI sidecar, dev loop) can watch structural perf facts
+drift over time, not just pass/fail.
+"""
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.perf import budgets as budgets_mod
+from deepspeed_tpu.perf.budgets import (Budget, Violation, budget_from_stats, check_stats,
+                                        load_budget, write_budget)
+from deepspeed_tpu.perf.chip_specs import DEFAULT_CHIP
+from deepspeed_tpu.perf.hlo_stats import HloStats, stats_from_lowered
+from deepspeed_tpu.perf.programs import FLAGSHIP_PROGRAMS, BuiltProgram, build_program
+from deepspeed_tpu.perf.roofline import predict
+
+
+@dataclass
+class ProgramResult:
+    name: str
+    stats: HloStats
+    roofline: dict
+    violations: List[Violation] = field(default_factory=list)
+    budget_created: str = ""
+    budget_missing: bool = False
+    meta: dict = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations and not self.budget_missing
+
+
+@dataclass
+class GateReport:
+    chip: str
+    programs: Dict[str, ProgramResult] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return all(r.ok for r in self.programs.values())
+
+    @property
+    def violations(self) -> List[Violation]:
+        return [v for r in self.programs.values() for v in r.violations]
+
+    def to_json(self) -> dict:
+        return {
+            "kind": "dstpu_perfgate_report",
+            "chip": self.chip,
+            "ok": self.ok,
+            "programs": {
+                name: {
+                    "ok": r.ok,
+                    "stats": r.stats.to_dict(),
+                    "roofline": r.roofline,
+                    "budget_created": r.budget_created,
+                    "budget_missing": r.budget_missing,
+                    "meta": r.meta,
+                    "violations": [
+                        {"metric": v.metric, "measured": v.measured,
+                         "budget": v.budget, "limit": v.limit, "detail": v.detail}
+                        for v in r.violations],
+                } for name, r in self.programs.items()
+            },
+        }
+
+
+def collect_stats(name: str, built: Optional[BuiltProgram] = None) -> ProgramResult:
+    """Build one flagship program and extract stats + roofline (no budget
+    check)."""
+    built = built or build_program(name)
+    stats = stats_from_lowered(built.lowered, name=built.name,
+                               analytic_flops=built.analytic_flops)
+    pred = predict(stats, DEFAULT_CHIP)
+    return ProgramResult(name=built.name, stats=stats, roofline=pred.to_dict(),
+                         meta=built.meta)
+
+
+def run_gate(names: Optional[List[str]] = None, budgets_dir: Optional[str] = None,
+             chip: str = DEFAULT_CHIP, publish: bool = True) -> GateReport:
+    budgets_dir = budgets_dir or budgets_mod.default_budgets_dir()
+    report = GateReport(chip=chip)
+    for name in (names or list(FLAGSHIP_PROGRAMS)):
+        result = collect_stats(name)
+        try:
+            budget = load_budget(budgets_dir, name)
+        except FileNotFoundError:
+            result.budget_missing = True
+        else:
+            result.budget_created = budget.created
+            result.violations = check_stats(result.stats, budget)
+        report.programs[name] = result
+        if publish:
+            _publish_telemetry(result, chip)
+    return report
+
+
+def check_program(name: str, stats: HloStats,
+                  budgets_dir: Optional[str] = None) -> List[Violation]:
+    """Check already-extracted stats against ``name``'s checked-in budget
+    (the sensitivity tests feed deliberately-regressed stats through here)."""
+    budget = load_budget(budgets_dir or budgets_mod.default_budgets_dir(), name)
+    return check_stats(stats, budget)
+
+
+def rebaseline(names: Optional[List[str]] = None, budgets_dir: Optional[str] = None,
+               note: str = "") -> List[str]:
+    """Rewrite budget files from current measurements. Deliberate by design:
+    call it from ``bin/dstpu_perfgate rebaseline`` and review the diff."""
+    budgets_dir = budgets_dir or budgets_mod.default_budgets_dir()
+    paths = []
+    for name in (names or list(FLAGSHIP_PROGRAMS)):
+        result = collect_stats(name)
+        budget = budget_from_stats(result.stats, program=name, note=note,
+                                   roofline=result.roofline)
+        paths.append(write_budget(budgets_dir, budget))
+    return paths
+
+
+def _publish_telemetry(result: ProgramResult, chip: str) -> None:
+    """perf_* gauge families (cataloged in telemetry/catalog.py; no-op when
+    telemetry is inactive)."""
+    from deepspeed_tpu import telemetry
+    if not telemetry.is_active():
+        return
+    reg = telemetry.get_registry()
+    labels = {"program": result.name}
+    reg.counter("perf_gate_runs_total", "Perf-gate program checks executed").inc()
+    if result.violations:
+        reg.counter("perf_gate_violations_total",
+                    "Perf-gate budget violations detected").inc(len(result.violations))
+    s = result.stats
+    reg.gauge("perf_program_flops", "HLO cost-analysis FLOPs per program",
+              labels=labels).set(s.flops)
+    reg.gauge("perf_program_bytes_accessed", "HLO cost-analysis bytes moved per program",
+              labels=labels).set(s.bytes_accessed)
+    reg.gauge("perf_program_peak_bytes", "Live-buffer peak per program",
+              labels=labels).set(s.peak_bytes)
+    reg.gauge("perf_program_collective_bytes", "Collective payload bytes per program",
+              labels=labels).set(s.collective_bytes_total)
+    reg.gauge("perf_program_f32_dots", "f32-operand dots on the program's path",
+              labels=labels).set(s.f32_dot_count)
+    rl = result.roofline
+    chip_labels = {"program": result.name, "chip": chip}
+    reg.gauge("perf_predicted_step_seconds", "Roofline step-time lower bound",
+              labels=chip_labels).set(rl["step_s"])
+    reg.gauge("perf_predicted_mfu_bound", "Roofline MFU upper bound",
+              labels=chip_labels).set(rl["mfu_bound"])
+
+
+def write_report(report: GateReport, path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(report.to_json(), f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path

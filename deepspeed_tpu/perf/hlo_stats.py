@@ -1,0 +1,246 @@
+"""HLO-level structural facts about a jitted program.
+
+Extraction runs entirely from XLA's own reporting — no execution, no chip:
+
+- ``Compiled.cost_analysis()`` — FLOPs and bytes moved;
+- ``Compiled.memory_analysis()`` — live-buffer peak components;
+- the compiled HLO text — collective ops with payload bytes and group sizes
+  (GSPMD inserts these only after partitioning, so they exist nowhere
+  earlier), fusion count, entry-computation kernel count;
+- the StableHLO text — the dtype audit. This MUST come from the jax-level
+  lowering, not the compiled module: the CPU backend legalizes bf16 dots to
+  f32 (convert + f32 dot), so every bf16 matmul *looks* upcast in backend
+  HLO. StableHLO records the dtypes the program was written with, which is
+  the chip-independent fact the audit wants (an accidental f32 upcast on a
+  bf16 path happens at the JAX level and shows here on any backend).
+
+All numbers are extracted under whatever platform is active; the gates pin
+``JAX_PLATFORMS=cpu`` + a fixed virtual device count so budgets compare
+like with like.
+"""
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 0.5, "u4": 0.5, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+    "s32": 4, "u32": 4, "s64": 8, "u64": 8, "f8e4m3fn": 1, "f8e5m2": 1,
+    "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1, "f8e5m2fnuz": 1, "bf16": 2,
+    "f16": 2, "f32": 4, "f64": 8, "c64": 8, "c128": 16,
+    # stablehlo spellings
+    "i1": 0.125, "i4": 0.5, "i8": 1, "i16": 2, "i32": 4, "i64": 8,
+    "ui4": 0.5, "ui8": 1, "ui16": 2, "ui32": 4, "ui64": 8,
+}
+
+_COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+                   "collective-permute", "collective-broadcast", "ragged-all-to-all")
+
+# `%name = <shapes> <op>(` definition lines; -start variants are the async
+# halves (count those, skip -done so async pairs aren't double-counted)
+_COLLECTIVE_RE = re.compile(
+    r"=\s*(?P<shapes>[^=]*?)\s(?P<op>" + "|".join(_COLLECTIVE_OPS) + r")(?P<start>-start)?\(")
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+# replica_groups=[4,2]<=[8]  (iota: 4 groups of 2)  |  replica_groups={{0,1},{2,3}}
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
+_GROUPS_LIST_RE = re.compile(r"replica_groups=\{\{([0-9,\s]*)\}")
+
+_FUSION_DEF_RE = re.compile(r"=\s*[^=]*?\sfusion\(")
+
+# every stablehlo op use — the jax-level program-size canary. The CPU
+# backend optimizes through de-fusing injections (barriers, materialized
+# intermediates) so compiled-level counters can miss them; the StableHLO
+# module records the program as written, on any backend.
+_STABLE_OP_RE = re.compile(r"\bstablehlo\.\w+")
+
+# stablehlo.dot_general ... : (tensor<16x64xbf16>, tensor<64x64xbf16>) -> ...
+_STABLE_DOT_RE = re.compile(
+    r"stablehlo\.(?:dot_general|dot|convolution)\b[^\n]*?:\s*"
+    r"\(tensor<([^>]*)>,\s*tensor<([^>]*)>\)")
+
+
+@dataclass
+class CollectiveStats:
+    op: str
+    group_size: int
+    count: int = 0
+    bytes: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.op}/g{self.group_size}"
+
+
+@dataclass
+class HloStats:
+    name: str = "program"
+    platform: str = ""
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    argument_bytes: int = 0
+    output_bytes: int = 0
+    temp_bytes: int = 0
+    alias_bytes: int = 0
+    peak_bytes: int = 0
+    collectives: Dict[str, dict] = field(default_factory=dict)  # key -> {op, group_size, count, bytes}
+    collective_bytes_total: int = 0
+    fusion_count: int = 0
+    entry_instruction_count: int = 0
+    stablehlo_op_count: int = 0
+    dot_count: int = 0
+    f32_dot_count: int = 0
+    dots_by_dtype: Dict[str, int] = field(default_factory=dict)
+    analytic_flops: Optional[float] = None
+    recompute_ratio: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "platform": self.platform, "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "argument_bytes": self.argument_bytes, "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes, "alias_bytes": self.alias_bytes,
+            "peak_bytes": self.peak_bytes, "collectives": self.collectives,
+            "collective_bytes_total": self.collective_bytes_total,
+            "fusion_count": self.fusion_count,
+            "entry_instruction_count": self.entry_instruction_count,
+            "stablehlo_op_count": self.stablehlo_op_count,
+            "dot_count": self.dot_count, "f32_dot_count": self.f32_dot_count,
+            "dots_by_dtype": self.dots_by_dtype,
+            "analytic_flops": self.analytic_flops,
+            "recompute_ratio": self.recompute_ratio,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "HloStats":
+        known = {f for f in HloStats.__dataclass_fields__}
+        return HloStats(**{k: v for k, v in d.items() if k in known})
+
+
+def _shape_bytes(shapes_text: str) -> int:
+    """Sum the byte sizes of every ``dtype[dims]`` token in a result-shape
+    string (handles tuple-shaped collectives)."""
+    total = 0.0
+    for dtype, dims in _SHAPE_RE.findall(shapes_text):
+        size = _DTYPE_BYTES.get(dtype)
+        if size is None:
+            continue  # token/opaque shapes carry no payload
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += size * n
+    return int(total)
+
+
+def _parse_collectives(compiled_text: str) -> Dict[str, dict]:
+    out: Dict[str, CollectiveStats] = {}
+    for line in compiled_text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if m is None:
+            continue
+        op = m.group("op")
+        group_size = 0
+        gi = _GROUPS_IOTA_RE.search(line)
+        if gi is not None:
+            group_size = int(gi.group(2))
+        else:
+            gl = _GROUPS_LIST_RE.search(line)
+            if gl is not None:
+                ids = [t for t in gl.group(1).replace(" ", "").split(",") if t]
+                group_size = len(ids)
+        cs = out.get(f"{op}/g{group_size}")
+        if cs is None:
+            cs = CollectiveStats(op=op, group_size=group_size)
+            out[cs.key] = cs
+        cs.count += 1
+        cs.bytes += _shape_bytes(m.group("shapes"))
+    return {k: {"op": v.op, "group_size": v.group_size, "count": v.count, "bytes": v.bytes}
+            for k, v in out.items()}
+
+
+def _entry_instruction_count(compiled_text: str) -> int:
+    """Instructions in the ENTRY computation — the de-fusing canary (a split
+    kernel adds definitions at the top level)."""
+    in_entry, count = False, 0
+    for line in compiled_text.splitlines():
+        if line.startswith("ENTRY "):
+            in_entry = True
+            continue
+        if in_entry:
+            if line.startswith("}"):
+                break
+            if " = " in line:
+                count += 1
+    return count
+
+
+def _parse_dots(stablehlo_text: str):
+    dots_by_dtype: Dict[str, int] = {}
+    dot_count = f32 = 0
+    for lhs, rhs in _STABLE_DOT_RE.findall(stablehlo_text):
+        lt = lhs.split("x")[-1].strip()
+        rt = rhs.split("x")[-1].strip()
+        dot_count += 1
+        key = lt if lt == rt else f"{lt}*{rt}"
+        dots_by_dtype[key] = dots_by_dtype.get(key, 0) + 1
+        if lt == "f32" or rt == "f32":
+            f32 += 1
+    return dot_count, f32, dots_by_dtype
+
+
+def stats_from_lowered(lowered, name: str = "program",
+                       analytic_flops: Optional[float] = None) -> HloStats:
+    """Extract :class:`HloStats` from a ``jax.stages.Lowered`` (compiles the
+    program — which XLA would do anyway on first call — but never runs it)."""
+    import jax
+
+    stable_text = lowered.as_text()
+    compiled = lowered.compile()
+    compiled_text = compiled.as_text()
+
+    props = compiled.cost_analysis()
+    if isinstance(props, (list, tuple)):
+        props = props[0] if props else {}
+    props = props or {}
+
+    stats = HloStats(name=name, platform=jax.default_backend())
+    stats.flops = float(props.get("flops", 0.0))
+    stats.bytes_accessed = float(props.get("bytes accessed", 0.0))
+
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:  # pragma: no cover - backends without the API
+        mem = None
+    if mem is not None:
+        stats.argument_bytes = int(getattr(mem, "argument_size_in_bytes", 0))
+        stats.output_bytes = int(getattr(mem, "output_size_in_bytes", 0))
+        stats.temp_bytes = int(getattr(mem, "temp_size_in_bytes", 0))
+        stats.alias_bytes = int(getattr(mem, "alias_size_in_bytes", 0))
+        stats.peak_bytes = (stats.argument_bytes + stats.output_bytes +
+                            stats.temp_bytes + stats.alias_bytes)
+
+    stats.collectives = _parse_collectives(compiled_text)
+    stats.collective_bytes_total = sum(c["bytes"] for c in stats.collectives.values())
+    stats.fusion_count = len(_FUSION_DEF_RE.findall(compiled_text))
+    stats.entry_instruction_count = _entry_instruction_count(compiled_text)
+    stats.stablehlo_op_count = len(_STABLE_OP_RE.findall(stable_text))
+    stats.dot_count, stats.f32_dot_count, stats.dots_by_dtype = _parse_dots(stable_text)
+
+    if analytic_flops:
+        stats.analytic_flops = float(analytic_flops)
+        stats.recompute_ratio = stats.flops / float(analytic_flops)
+    return stats
+
+
+def stats_from_callable(fn, *args, name: str = "program",
+                        analytic_flops: Optional[float] = None, **kwargs) -> HloStats:
+    """Lower ``fn`` on ``args`` and extract stats. ``fn`` may be a jitted
+    callable (``jax.jit`` output — used directly, so the analyzed program IS
+    the one the engine runs) or a plain function (jitted here)."""
+    import jax
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn)
+    return stats_from_lowered(fn.lower(*args, **kwargs), name=name,
+                              analytic_flops=analytic_flops)

@@ -472,6 +472,7 @@ class DeepSpeedEngine:
         self._preempt_at = None
 
         self._compiled = {}
+        self._lowerable = {}  # key -> UNwrapped jitted fn (perf-gate lowering hook)
         self._flops_profiled = False
         self._last_step_applied = False
         self._gas_boundary_override = None
@@ -566,8 +567,9 @@ class DeepSpeedEngine:
         self._config.gradient_accumulation_steps = train_batch_size // (self.train_micro_batch_size_per_gpu() *
                                                                         groups.get_data_parallel_world_size())
         # the apply/train_batch programs bake GAS into the grad divisor
-        self._compiled.pop("apply", None)
-        self._compiled.pop("train_batch", None)
+        for cache in (self._compiled, self._lowerable):
+            cache.pop("apply", None)
+            cache.pop("train_batch", None)
 
     def is_gradient_accumulation_boundary(self):
         if self._gas_boundary_override is not None:
@@ -616,10 +618,38 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------- jit builds --
     def _watched_jit(self, fn, key):
         """Put a fresh jit cache entry under the compile watch (telemetry's
-        recompile accounting; a no-op single check when disabled)."""
+        recompile accounting; a no-op single check when disabled). The RAW
+        jitted fn is kept in ``_lowerable`` — the watch wrapper is a plain
+        function, so anything wanting ``.lower()`` (the perf gates) goes
+        through :meth:`lowerable_callables` instead of unwrapping."""
         from deepspeed_tpu.telemetry import compile_watch
+        self._lowerable[key] = fn
         cw = compile_watch.get()
         return cw.wrap("train", key, fn) if cw is not None else fn
+
+    def lowerable_callables(self):
+        """The engine's jitted programs, UNwrapped (``jax.jit`` outputs that
+        support ``.lower()``), keyed by site — ``train_batch``, ``grad``,
+        ``apply``, ``accum``, ``eval_loss`` as built so far. The official
+        hook for HLO-level analysis (deepspeed_tpu/perf/); reaching into
+        ``_compiled`` gets compile-watch wrappers that cannot lower."""
+        return dict(self._lowerable)
+
+    def lower_train_batch(self, batch=None, data_iter=None):
+        """Lower the fused ``train_batch`` program on a real staged batch and
+        return the ``jax.stages.Lowered`` — the EXACT program
+        :meth:`train_batch` runs, with the engine's live params/optimizer
+        state as example args. Nothing executes and no engine state advances
+        (the rng is a fixed same-shape key, not ``self._rng``)."""
+        import jax
+        import jax.numpy as jnp
+        staged = self.stage_train_batch(data_iter=data_iter, batch=batch).tree
+        self._train_batch_fn()  # ensure the raw jit exists in _lowerable
+        fn = self._lowerable["train_batch"]
+        lr = jnp.asarray(self._current_lr, jnp.float32)
+        opt_in = self._offload.stage_in(self.opt_state)
+        return fn.lower(self.params, opt_in, self.scale_state, staged,
+                        jax.random.PRNGKey(0), lr)
 
     def _grad_fn(self):
         import jax
@@ -1253,6 +1283,7 @@ class DeepSpeedEngine:
             self._telemetry.close()  # flushes the Chrome trace + JSONL sink
             self._telemetry = None
         self._compiled.clear()
+        self._lowerable.clear()
         self._cached_grads = None
         self.acc_grads = None
 
@@ -1301,8 +1332,9 @@ class DeepSpeedEngine:
         self._config.train_micro_batch_size_per_gpu = micro_batch_size
         self._config.train_batch_size = (micro_batch_size * self.gradient_accumulation_steps()
                                          * groups.get_data_parallel_world_size())
-        self._compiled.pop("apply", None)
-        self._compiled.pop("train_batch", None)
+        for cache in (self._compiled, self._lowerable):
+            cache.pop("apply", None)
+            cache.pop("train_batch", None)
 
     def set_gradient_accumulation_boundary(self, is_boundary):
         """Reference: user override of the GAS boundary detection."""

@@ -93,6 +93,16 @@ METRIC_FAMILIES = {
     "fleet_handoff_bytes": "KV-handoff payload size",
     "fleet_scale_ups_total": "autoscaler replica additions",
     "fleet_scale_downs_total": "autoscaler replica drains",
+    # perf gates (perf/gate.py _publish_telemetry)
+    "perf_gate_runs_total": "perf-gate program checks executed",
+    "perf_gate_violations_total": "perf-gate budget violations detected",
+    "perf_program_flops": "HLO cost-analysis FLOPs per flagship program",
+    "perf_program_bytes_accessed": "HLO cost-analysis bytes moved per flagship program",
+    "perf_program_peak_bytes": "live-buffer peak per flagship program",
+    "perf_program_collective_bytes": "collective payload bytes per flagship program",
+    "perf_program_f32_dots": "f32-operand dots on the program's (bf16) path",
+    "perf_predicted_step_seconds": "roofline step-time lower bound per program/chip",
+    "perf_predicted_mfu_bound": "roofline MFU upper bound per program/chip",
     # fleet fault tolerance (fleet/breaker.py, fleet/supervisor.py,
     # fleet/router.py, fleet/faults.py)
     "fleet_breaker_opens_total": "circuit-breaker transitions into OPEN",

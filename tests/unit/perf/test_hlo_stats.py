@@ -1,0 +1,171 @@
+"""HLO stats extraction: the facts the perf gates ratchet must be real.
+
+Runs on the tier-1 CPU mesh (8 virtual devices from conftest)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.perf.hlo_stats import (_entry_instruction_count, _parse_collectives,
+                                          _parse_dots, _shape_bytes, stats_from_callable,
+                                          stats_from_lowered)
+
+
+# ---------------------------------------------------------------- extraction --
+def test_matmul_flops_and_bytes():
+    M, K, N = 64, 128, 32
+    x = jnp.ones((M, K), jnp.bfloat16)
+    w = jnp.ones((K, N), jnp.bfloat16)
+    st = stats_from_callable(lambda a, b: a @ b, x, w, name="mm")
+    assert st.name == "mm" and st.platform == "cpu"
+    # XLA counts at least the 2*M*K*N dot flops (plus epsilon for converts)
+    assert st.flops >= 2 * M * K * N
+    assert st.flops < 4 * 2 * M * K * N
+    assert st.bytes_accessed > 0
+    assert st.argument_bytes == x.nbytes + w.nbytes
+    assert st.peak_bytes > 0
+    assert st.dot_count == 1
+    assert st.dots_by_dtype == {"bf16": 1}
+    assert st.f32_dot_count == 0
+
+
+def test_f32_dot_is_audited_from_stablehlo_not_backend_hlo():
+    """The CPU backend legalizes bf16 dots to f32 internally — the audit must
+    NOT see that (chip-independent fact = the dtype the program was written
+    with), but must see a genuine f32 matmul."""
+    x16 = jnp.ones((16, 16), jnp.bfloat16)
+    x32 = jnp.ones((16, 16), jnp.float32)
+    st16 = stats_from_callable(lambda a: a @ a, x16, name="bf16mm")
+    st32 = stats_from_callable(lambda a: a @ a, x32, name="f32mm")
+    assert st16.f32_dot_count == 0
+    assert st32.f32_dot_count == 1
+
+
+def test_analytic_flops_yield_recompute_ratio():
+    x = jnp.ones((32, 32), jnp.float32)
+    st = stats_from_callable(lambda a: a @ a, x, analytic_flops=2 * 32**3)
+    assert st.recompute_ratio == pytest.approx(st.flops / (2 * 32**3))
+
+
+def test_collectives_extracted_with_payload(mesh8):
+    x = jax.device_put(jnp.ones((128, 16), jnp.float32),
+                       NamedSharding(mesh8, P("data", None)))
+
+    def f(x):
+        return jnp.sum(x)  # sharded-in, replicated-out => SPMD all-reduce
+
+    st = stats_from_callable(jax.jit(f, out_shardings=NamedSharding(mesh8, P())),
+                             x, name="psum")
+    keys = [k for k in st.collectives if k.startswith("all-reduce")]
+    assert keys, f"no all-reduce found in {st.collectives}"
+    coll = st.collectives[keys[0]]
+    assert coll["group_size"] == 8
+    assert coll["count"] >= 1
+    assert coll["bytes"] >= 4  # at least the f32 scalar
+    assert st.collective_bytes_total >= coll["bytes"]
+
+
+def test_scan_program_extracts():
+    """decode_loop-shaped programs (lax.scan) must not confuse the parsers."""
+    def f(x):
+        return jax.lax.scan(lambda c, _: (c @ c, c[0, 0]), x, None, length=4)
+
+    st = stats_from_callable(f, jnp.eye(16, dtype=jnp.bfloat16), name="scan")
+    assert st.flops > 0
+    assert st.dot_count >= 1
+
+
+def test_stablehlo_op_count_sees_defusing_injection():
+    """A fusion-breaking injection (optimization_barrier) is invisible to the
+    CPU backend's compiled module — the new emitter optimizes straight
+    through it — so the de-fuse canary is the jax-level program size, which
+    records the barrier on any backend."""
+    x = jnp.ones((256, 256), jnp.float32)
+
+    def fused(a):
+        return jnp.sin(a * 2.0 + 1.0).sum()
+
+    def defused(a):
+        y = a * 2.0 + 1.0
+        y = jax.lax.optimization_barrier(y)
+        return jnp.sin(y).sum()
+
+    st_f = stats_from_callable(fused, x, name="fused")
+    st_d = stats_from_callable(defused, x, name="defused")
+    assert st_f.stablehlo_op_count > 0
+    assert st_d.stablehlo_op_count > st_f.stablehlo_op_count
+    # the compiled-level counters still extract (they ratchet TPU-relevant
+    # structure even when this particular injection doesn't move them on cpu)
+    assert st_d.fusion_count >= 0 and st_d.entry_instruction_count > 0
+
+
+def test_stats_dict_round_trip():
+    st = stats_from_callable(lambda a: a + 1, jnp.ones((4, ), jnp.float32))
+    from deepspeed_tpu.perf.hlo_stats import HloStats
+    again = HloStats.from_dict(st.to_dict())
+    assert again.to_dict() == st.to_dict()
+
+
+def test_lowered_input_accepted_directly():
+    lowered = jax.jit(lambda a: a * 2).lower(jnp.ones((8, ), jnp.float32))
+    st = stats_from_lowered(lowered, name="x2")
+    assert st.name == "x2"
+    assert st.bytes_accessed > 0
+
+
+# ------------------------------------------------------------- text parsers --
+def test_shape_bytes_tuple_and_scalar():
+    assert _shape_bytes("f32[]") == 4
+    assert _shape_bytes("bf16[8,4]{1,0}") == 64
+    assert _shape_bytes("(f32[10]{0}, bf16[4]{0})") == 48
+    assert _shape_bytes("u8[3]") == 3
+
+
+def test_parse_collectives_iota_and_list_groups():
+    text = "\n".join([
+        "  %ar = f32[16]{0} all-reduce(f32[16]{0} %p), channel_id=1, "
+        "replica_groups=[2,4]<=[8], to_apply=%add",
+        "  %ag = (bf16[8]{0}, bf16[8]{0}) all-gather(bf16[1]{0} %a, bf16[1]{0} %b), "
+        "replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}",
+        "  %done = f32[16]{0} all-reduce-done(f32[16]{0} %ar)",
+    ])
+    colls = _parse_collectives(text)
+    assert colls["all-reduce/g4"]["bytes"] == 64
+    assert colls["all-reduce/g4"]["count"] == 1
+    assert colls["all-gather/g8"]["bytes"] == 32
+    assert "all-reduce-done" not in " ".join(colls)
+
+
+def test_parse_collectives_counts_async_start_once():
+    text = ("  %s = f32[4]{0} all-gather-start(f32[1]{0} %p), "
+            "replica_groups=[1,4]<=[4]\n"
+            "  %d = f32[4]{0} all-gather-done(f32[4]{0} %s)\n")
+    colls = _parse_collectives(text)
+    assert list(colls) == ["all-gather/g4"]
+    assert colls["all-gather/g4"]["count"] == 1
+
+
+def test_parse_dots_mixed_dtypes():
+    text = "\n".join([
+        '%3 = stablehlo.dot_general %1, %2, contracting_dims = [1] x [0] '
+        ': (tensor<16x64xbf16>, tensor<64x32xbf16>) -> tensor<16x32xf32>',
+        '%9 = stablehlo.dot_general %7, %8, contracting_dims = [1] x [0] '
+        ': (tensor<4x4xf32>, tensor<4x4xf32>) -> tensor<4x4xf32>',
+    ])
+    count, f32, by = _parse_dots(text)
+    assert count == 2 and f32 == 1
+    assert by == {"bf16": 1, "f32": 1}
+
+
+def test_entry_instruction_count_parses_entry_only():
+    text = ("%helper (a: f32[2]) -> f32[2] {\n"
+            "  %x = f32[2]{0} add(f32[2]{0} %a, f32[2]{0} %a)\n"
+            "}\n"
+            "ENTRY %main (p: f32[2]) -> f32[2] {\n"
+            "  %a = f32[2]{0} parameter(0)\n"
+            "  %b = f32[2]{0} multiply(f32[2]{0} %a, f32[2]{0} %a)\n"
+            "  ROOT %c = f32[2]{0} add(f32[2]{0} %b, f32[2]{0} %a)\n"
+            "}\n")
+    assert _entry_instruction_count(text) == 3

@@ -1,0 +1,118 @@
+"""The engines' official lowering hooks (the perf gates' only entry points —
+no reaching into compile-watch-wrapped jit caches).
+
+Engine builds are consolidated (one training engine, one inference engine)
+— tier-1 runs on a small CPU box and every deepspeed_tpu.initialize pays an
+XLA compile."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.perf.programs import (build_train_engine, build_v2_engine,
+                                         train_batch_example)
+
+
+# ------------------------------------------------------------ training side --
+def test_train_engine_lowering_hooks_end_to_end():
+    """One engine build covers: raw-jit exposure under an ACTIVE compile
+    watch (the wrapped cache entry cannot lower; the hook's raw one can),
+    lowering producing real StableHLO, and engine state staying untouched."""
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.telemetry.config import TelemetryConfig
+
+    telemetry.shutdown()
+    telemetry.state.registry = None
+    try:
+        telemetry.configure(TelemetryConfig(enabled=True))
+        engine, cfg = build_train_engine()
+        rng_before = engine._rng
+        steps_before = engine.global_steps
+
+        lowered = engine.lower_train_batch(batch=train_batch_example(cfg))
+        assert lowered.as_text().startswith("module")
+
+        # state must not advance: lowering is analysis, not a step
+        assert engine.global_steps == steps_before
+        assert (np.asarray(engine._rng) == np.asarray(rng_before)).all(), \
+            "lowering must not consume training rng"
+
+        wrapped = engine._compiled["train_batch"]
+        raw = engine.lowerable_callables()["train_batch"]
+        assert not hasattr(wrapped, "lower")  # the compile-watch wrapper
+        assert hasattr(raw, "lower"), \
+            "lowerable_callables must return raw jax.jit callables"
+    finally:
+        telemetry.shutdown()
+        telemetry.state.registry = None
+
+
+# ----------------------------------------------------------- inference side --
+@pytest.fixture(scope="module")
+def v2():
+    from deepspeed_tpu.utils import groups
+    engine, cfg = build_v2_engine()
+    rng = np.random.default_rng(0)
+    engine.put([0], [rng.integers(0, cfg.vocab_size, 24)])
+    engine.decode_loop([0], [np.asarray([1], np.int32)], 4)
+    yield engine, cfg
+    groups.destroy_mesh()
+
+
+def test_engine_v2_lowerable_callables_track_buckets(v2):
+    engine, _ = v2
+    fns = engine.lowerable_callables()
+    assert len(fns["forward"]) == 1 and len(fns["decode_loop"]) == 1
+    (bucket, fwd), = fns["forward"].items()
+    assert len(bucket) == 3 and hasattr(fwd, "lower")
+    (dkey, dec), = fns["decode_loop"].items()
+    assert dkey[1] == 4 and dkey[2] is False and hasattr(dec, "lower")
+
+
+def test_lower_forward_default_and_explicit_bucket(v2):
+    engine, _ = v2
+    small = engine.lower_forward()
+    big = engine.lower_forward((64, 8, 8))
+    assert small.as_text().startswith("module")
+    # bigger token bucket => more embed rows => different (larger) program
+    assert len(big.as_text()) != len(small.as_text())
+
+
+def test_lowering_does_not_touch_compile_watch_bucket_telemetry(v2):
+    """Analysis-only lowering must not feed the bucket-churn recompile
+    indicator — only executed batches do (via RaggedBatchWrapper.finalize)."""
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.telemetry.config import TelemetryConfig
+
+    engine, _ = v2
+    telemetry.shutdown()
+    telemetry.state.registry = None
+    try:
+        telemetry.configure(TelemetryConfig(enabled=True))
+        watch = telemetry.compile_watch.get()
+        assert watch is not None
+        before = watch._bucket_switches.value
+        buckets_before = dict(watch._recent_buckets)
+        engine.lower_forward()
+        engine.lower_forward((64, 8, 8))
+        engine.lower_decode_loop(2)
+        assert watch._bucket_switches.value == before
+        assert dict(watch._recent_buckets) == buckets_before
+    finally:
+        telemetry.shutdown()
+        telemetry.state.registry = None
+
+
+def test_lower_decode_loop_matches_executed_program(v2):
+    """The lowered decode program and the one decode_loop actually runs must
+    be the same jit (same cache key, identical HLO)."""
+    import jax
+    import jax.numpy as jnp
+
+    engine, _ = v2
+    (dkey, raw), = engine.lowerable_callables()["decode_loop"].items()
+    lowered = engine.lower_decode_loop(4, bucket=dkey[0])
+    model = engine.model
+    dev = model._synthetic_batch(dkey[0])
+    again = raw.lower(model._params, model.state_manager.kv_cache.cache, dev,
+                      jnp.float32(0.0), jax.random.PRNGKey(0))
+    assert lowered.as_text() == again.as_text()
