@@ -295,8 +295,82 @@ def gang_report(gang_dir):
     return 1 if bad else 0
 
 
+def overload_report(path):
+    """``dstpu_report --overload <loadgen-json>``: render the goodput-vs-
+    offered-load table from ``bin/dstpu_loadgen --overload --json`` and flag
+    the knee point — the first ramp step whose goodput drops below 90% of
+    the measured single-replica capacity. Returns 0 when the doc parses and
+    has at least one step (a knee is expected on a real overload ramp, not a
+    failure)."""
+    import json
+    import os
+
+    path = os.path.abspath(path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"cannot read overload report {path}: {e}")
+        return 2
+    steps = doc.get("steps") or []
+    capacity = doc.get("capacity_req_s")
+    if not steps or not capacity:
+        print(f"{path} has no ramp steps / capacity "
+              f"(is this a loadgen --overload --json file?)")
+        return 2
+    knee_floor = 0.9 * capacity
+    # only saturated steps can knee: below capacity, goodput is bounded by
+    # the OFFERED rate, not by overload collapse — a 0.5x step can never
+    # reach 90% of capacity and must not be flagged
+    knee = next((s for s in steps
+                 if s.get("offered_req_s", 0.0) >= knee_floor
+                 and s.get("goodput_req_s", 0.0) < knee_floor), None)
+    print("-" * 78)
+    print(f"overload ramp .......... {path}")
+    print(f"capacity ............... {capacity:.2f} req/s "
+          f"(deadline {doc.get('deadline_s', 0):.2f}s, interactive_frac "
+          f"{doc.get('interactive_frac', '?')}, "
+          f"{doc.get('requests_per_step', '?')} requests/step)")
+    print(f"knee floor ............. {knee_floor:.2f} req/s (90% of capacity)")
+    print("-" * 78)
+    print(f"{'offered':>8} {'req/s':>8} {'goodput':>8} {'ok':>5} "
+          f"{'on-ddl':>6} {'shed':>5} {'degr':>5} {'hedged':>6} "
+          f"{'ttft_i_p99':>11} {'ttft_b_p99':>11}")
+
+    def _p99_ms(step, cls):
+        p99 = ((step.get("ttft") or {}).get(cls) or {}).get("p99_s")
+        return f"{p99 * 1e3:>9.1f}ms" if p99 is not None else f"{'—':>11}"
+
+    for step in steps:
+        marker = "  <- knee" if step is knee else ""
+        print(f"{step.get('offered_x', 0):>7.1f}x "
+              f"{step.get('offered_req_s', 0):>8.2f} "
+              f"{step.get('goodput_req_s', 0):>8.2f} {step.get('ok', 0):>5} "
+              f"{step.get('on_deadline', 0):>6} {step.get('shed', 0):>5} "
+              f"{step.get('degraded', 0):>5} {step.get('hedged', 0):>6} "
+              f"{_p99_ms(step, 'interactive')} {_p99_ms(step, 'batch')}"
+              f"{marker}")
+    print("-" * 78)
+    if knee is None:
+        print(f"verdict ................ {GREEN_OK} goodput held >= 90% of "
+              f"capacity through {steps[-1].get('offered_x', 0):.1f}x offered "
+              f"load (no knee)")
+    else:
+        print(f"verdict ................ knee at "
+              f"{knee.get('offered_x', 0):.1f}x offered load: goodput "
+              f"{knee.get('goodput_req_s', 0):.2f} req/s < "
+              f"{knee_floor:.2f} req/s floor")
+    return 0
+
+
 def main(argv=None):
     argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if "--overload" in argv:
+        idx = argv.index("--overload")
+        if idx + 1 >= len(argv):
+            print("usage: dstpu_report --overload <loadgen-overload.json>")
+            return 2
+        return overload_report(argv[idx + 1])
     if "--gang" in argv:
         idx = argv.index("--gang")
         if idx + 1 >= len(argv):

@@ -14,12 +14,91 @@ from deepspeed_tpu.fleet.breaker import BreakerConfig
 from deepspeed_tpu.fleet.faults import FaultConfig
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 from deepspeed_tpu.serving.config import (DEFAULT_MAX_RESUME_BODY_BYTES,
-                                          PrefixCacheConfig)
+                                          OverloadConfig, PrefixCacheConfig)
 
 ReplicaRole = Literal["mixed", "prefill", "decode"]
 """``mixed`` serves whole requests; ``prefill``/``decode`` replicas form the
 disaggregated pools — a request prefills (plus first token) on a prefill-role
 replica, then its KV hands off to a decode-role replica for the rest."""
+
+
+class GlobalQueueConfig(DeepSpeedConfigModel):
+    """Router global queue (``fleet/global_queue.py``): queued work lives at
+    the router in priority/deadline order and replicas pull it when they have
+    a free dispatch slot (ROADMAP 3c, first half)."""
+
+    enabled: bool = True
+    """False = the pre-queue blind least-loaded push dispatch (the control
+    arm the overload gates compare against)."""
+
+    capacity: int = Field(256, ge=1)
+    """Queue entries beyond which admission answers 429 + ``Retry-After``."""
+
+    max_inflight_per_replica: int = Field(32, ge=1)
+    """Concurrently granted legs per replica (continuous batching happily
+    runs several; the cap keeps a burst from piling onto one replica)."""
+
+    acquire_timeout_s: float = Field(30.0, gt=0)
+    """Queue-wait bound for requests without a deadline (deadline'd requests
+    expire at their own deadline, whichever is sooner)."""
+
+    retry_after_floor_s: float = Field(0.5, gt=0)
+    retry_after_cap_s: float = Field(30.0, gt=0)
+    """Bounds on the grant-rate-derived ``Retry-After`` estimate."""
+
+
+class HedgeConfig(DeepSpeedConfigModel):
+    """Hedged dispatch (``fleet/router.py``): a request whose next token
+    hasn't arrived within the TTFT budget — before the first token OR
+    mid-stream (greedy/seeded legs are token-identical, so a hedge can
+    replay and skip the already-streamed prefix) — is dispatched again on a
+    second replica; the first leg past the prefix wins, the loser is
+    cancelled (KV freed). Off by default — hedging doubles worst-case
+    dispatch cost by design."""
+
+    enabled: bool = False
+
+    ttft_budget_s: Optional[float] = Field(None, gt=0)
+    """Fixed TTFT budget before hedging; None = derive from the router's
+    observed TTFT p95 (``budget_factor`` × p95)."""
+
+    budget_factor: float = Field(1.5, gt=0)
+    """Multiplier on the observed TTFT p95 when deriving the budget."""
+
+    min_samples: int = Field(8, ge=1)
+    """TTFT samples before the p95 derivation is trusted;
+    ``default_budget_s`` applies until then."""
+
+    default_budget_s: float = Field(1.0, gt=0)
+    """Cold-start TTFT budget."""
+
+    deadline_frac: float = Field(0.5, gt=0, le=1)
+    """Cap the per-token hedge wait at this fraction of the request's
+    remaining deadline (deadline'd requests only): a cold-start default
+    budget must not eat the whole deadline before a hedge can still win."""
+
+    min_budget_s: float = Field(0.25, gt=0)
+    """Floor under the p95-derived budget: a lightly-loaded fleet's tiny
+    TTFT p95 must not arm a hair-trigger the first burst then trips (and
+    the floor bounds how often a waiting request wakes to re-evaluate)."""
+
+    max_hedge_frac: float = Field(0.1, ge=0, le=1)
+    """Storm brake: speculative hedges (budget expired but the slow replica
+    is NOT demotion-grade slow vs its peers) are token-bucket limited to
+    this fraction of admitted requests. Evidence-driven hedges — the
+    current replica's TTFT EWMA is demotion-grade — bypass the brake:
+    fleet-wide contention inflates every EWMA together and never looks
+    like evidence, so a storm cannot feed itself; a single stalled
+    replica does, so its victims are always rescued."""
+
+    interactive_only: bool = True
+    """Hedge only interactive-class requests (batch latency is nobody's
+    p99); False hedges everything eligible."""
+
+    slow_demote_factor: float = Field(3.0, gt=1)
+    """A replica whose TTFT EWMA exceeds this multiple of the candidate
+    median is demoted — picked only when nothing faster has capacity. The
+    latency-shaped sibling of the failure-shaped circuit breaker."""
 
 
 class AutoscaleConfig(DeepSpeedConfigModel):
@@ -170,6 +249,17 @@ class FleetConfig(DeepSpeedConfigModel):
 
     prefix_cache_roles: Tuple[ReplicaRole, ...] = ("mixed", "prefill")
     """Replica roles that receive ``prefix_cache`` when it is enabled."""
+
+    global_queue: GlobalQueueConfig = GlobalQueueConfig()
+    """Router global queue + pull dispatch (``fleet/global_queue.py``)."""
+
+    hedge: HedgeConfig = HedgeConfig()
+    """Hedged dispatch against slow-but-alive replicas."""
+
+    overload: Optional[OverloadConfig] = None
+    """Serving-layer overload control (``serving/config.OverloadConfig``)
+    applied to every fleet-built local replica when set; None = each
+    replica keeps whatever its own ``ServingConfig.overload`` says."""
 
     autoscale: AutoscaleConfig = AutoscaleConfig()
     """Elastic scaling policy (``fleet/policy.py``)."""

@@ -21,7 +21,14 @@ land on one replica and exercise its circuit breaker):
 - ``handoff_corrupt`` — the prefill→decode payload is corrupted/truncated in
   transit (for ONE dispatch attempt; the router's buffered copy stays pristine);
 - ``replica_kill`` — the chosen replica is killed outright (the supervisor's
-  restart path).
+  restart path);
+- ``decode_stall`` — a seeded per-token delay on one replica's token stream
+  (``decode_stall_replica`` scopes it): the slow-but-alive replica the
+  circuit breaker never sees, what hedged dispatch exists to beat;
+- ``overload_burst`` — a synthetic admission burst: the router's global queue
+  gains ``overload_burst_requests`` phantom entries held for
+  ``overload_burst_hold_s``, deterministically exercising queue-depth
+  pressure, Retry-After growth and shedding.
 
 Disabled is the default and costs one ``None`` check at every hook; the
 injector only exists when ``FleetConfig.faults.enabled`` (or the
@@ -40,7 +47,7 @@ from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 # every injection point the router consults; would_fire rejects unknown ones
 # so a typo'd hook cannot silently never fire
 POINTS = ("dispatch_delay", "connect_reset", "http_5xx", "stream_truncate",
-          "handoff_corrupt", "replica_kill")
+          "handoff_corrupt", "replica_kill", "decode_stall", "overload_burst")
 
 _EVENT_LOG_CAP = 512  # per injector, for the recovery report
 
@@ -76,6 +83,29 @@ class FaultConfig(DeepSpeedConfigModel):
 
     handoff_corrupt_p: float = Field(0.0, ge=0, le=1)
     replica_kill_p: float = Field(0.0, ge=0, le=1)
+
+    decode_stall_p: float = Field(0.0, ge=0, le=1)
+    """Per-token probability of an injected stall on the leg's token stream
+    (the slow-but-alive replica: latency stretches, the breaker — which keys
+    on failures — never trips)."""
+
+    decode_stall_s: float = Field(0.05, ge=0)
+    """Stall ceiling: each firing sleeps a hash-derived uniform
+    (0, decode_stall_s]."""
+
+    decode_stall_replica: Optional[str] = None
+    """Scope the stall to ONE replica id (the hedging scenario: exactly one
+    slow member stretches fleet p99); None = every replica is subject."""
+
+    overload_burst_p: float = Field(0.0, ge=0, le=1)
+    """Per-admitted-request probability of injecting a synthetic burst into
+    the router's global queue."""
+
+    overload_burst_requests: int = Field(8, ge=1)
+    """Phantom queue entries per burst (batch priority, never granted)."""
+
+    overload_burst_hold_s: float = Field(0.25, ge=0)
+    """How long the phantom entries occupy the queue before expiring."""
 
 
 def _u64(seed: int, key: str, n: int, salt: str = "") -> int:
@@ -164,6 +194,20 @@ class FaultInjector:
         delays the same amount."""
         u = _uniform(self.config.seed, self._key("dispatch_delay", scope), n, "len")
         return self.config.dispatch_delay_max_s * max(u, 1e-3)
+
+    def stalls_replica(self, replica_id: Optional[str]) -> bool:
+        """Is this replica's stream subject to ``decode_stall`` at all? One
+        cheap check before the per-token ``fire`` consult — a scoped stall
+        must not consume schedule indices on unscoped replicas (the oracle
+        and the live run count the same events)."""
+        return (self.config.decode_stall_p > 0
+                and self.config.decode_stall_replica in (None, replica_id))
+
+    def stall_s(self, n: int, scope: Optional[str] = None) -> float:
+        """Injected per-token stall for firing index ``n``: uniform
+        (0, decode_stall_s], hash-derived like :meth:`delay_s`."""
+        u = _uniform(self.config.seed, self._key("decode_stall", scope), n, "len")
+        return self.config.decode_stall_s * max(u, 1e-3)
 
     def truncate_after(self, n: int, scope: Optional[str] = None) -> int:
         """How many tokens a truncated stream yields before dying."""

@@ -71,6 +71,13 @@ class ReplicaManager:
         shared prompts while decode-pool replicas, which only import
         handed-off KV, skip the trie entirely."""
         base = self._serving_config
+        if self._config.overload is not None:
+            # the fleet's overload block is authoritative for every
+            # fleet-built replica: brownout stages and admission estimates
+            # must agree across the pool, or the router's global queue sees
+            # replicas disagreeing on what "overloaded" means
+            base = (base or ServingConfig()).model_copy(
+                update={"overload": self._config.overload})
         fleet_pc = self._config.prefix_cache
         if not fleet_pc.enabled:
             return base

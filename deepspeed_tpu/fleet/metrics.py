@@ -65,6 +65,40 @@ class FleetMetrics:
         self.faults_injected = registry.counter(
             "fleet_faults_injected_total",
             "Faults injected by the chaos harness (all points)")
+        # router global queue (fleet/global_queue.py)
+        self.global_queue_depth = registry.gauge(
+            "fleet_global_queue_depth",
+            "Requests (and chaos phantoms) waiting in the router global queue")
+        self.global_queue_wait = registry.histogram(
+            "fleet_global_queue_wait_seconds",
+            "Queue wait from router admission to replica grant")
+        self.global_queue_grants = registry.counter(
+            "fleet_global_queue_grants_total",
+            "Pull-dispatch grants issued (a replica slot freed and took work)")
+        self.global_queue_expired = registry.counter(
+            "fleet_global_queue_expired_total",
+            "Entries shed at the router queue: admission estimate or "
+            "deadline/wait expiry")
+        # hedged dispatch (fleet/router.py)
+        self.hedge_dispatches = registry.counter(
+            "fleet_hedge_dispatches_total",
+            "Hedge legs dispatched after a first-token budget expiry")
+        self.hedge_wins = registry.counter(
+            "fleet_hedge_wins_total",
+            "Hedged requests where the hedge leg produced the stream")
+        self.hedge_cancellations = registry.counter(
+            "fleet_hedge_cancellations_total",
+            "Hedge losers cancelled first-writer-wins (KV freed upstream)")
+        self.hedge_demotions = registry.counter(
+            "fleet_hedge_slow_demotions_total",
+            "Dispatch picks where a slow replica (TTFT EWMA) was demoted")
+        self.deadline_stream_cuts = registry.counter(
+            "fleet_deadline_stream_cuts_total",
+            "Streams cut at the router because the deadline passed mid-decode")
+        self.hedge_suppressed = registry.counter(
+            "fleet_hedge_suppressed_total",
+            "Hedges suppressed by the storm brake (no replica-specific "
+            "evidence and the speculative bucket was dry)")
 
     @classmethod
     def maybe_create(cls) -> Optional["FleetMetrics"]:

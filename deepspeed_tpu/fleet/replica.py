@@ -53,7 +53,8 @@ from enum import Enum
 from typing import Iterator, Optional
 
 from deepspeed_tpu.fleet.breaker import CircuitBreaker, backoff_delay
-from deepspeed_tpu.serving import (QueueFullError, SchedulerStopped, ServingConfig,
+from deepspeed_tpu.serving import (AdmissionRejected, QueueFullError,
+                                   SchedulerStopped, ServingConfig,
                                    ServingScheduler)
 from deepspeed_tpu.serving.request import Request
 from deepspeed_tpu.serving.scheduler import KILLED_ERROR_PREFIX
@@ -74,11 +75,15 @@ class ReplicaState(Enum):
 
 class ReplicaUnavailable(RuntimeError):
     """This replica cannot admit the request right now (429/503/unreachable);
-    the router fails over to the next candidate."""
+    the router fails over to the next candidate. ``retry_after_s`` carries
+    the replica's drain-rate-derived backoff when its refusal was overload
+    shedding (the router forwards the largest one it saw)."""
 
-    def __init__(self, message: str, status: int = 503):
+    def __init__(self, message: str, status: int = 503,
+                 retry_after_s: Optional[float] = None):
         super().__init__(message)
         self.status = status
+        self.retry_after_s = retry_after_s
 
 
 class ReplicaDied(RuntimeError):
@@ -123,6 +128,18 @@ class Replica:
         self.breaker: Optional[CircuitBreaker] = None  # attached at register
         self.dispatches = 0   # legs the router sent here (router thread)
         self.failures = 0     # legs that raised ReplicaUnavailable here
+        # router-observed first-token latency EWMA: the slow-replica
+        # demotion signal (latency-shaped, where the breaker is
+        # failure-shaped) — a slow-but-alive replica never trips a breaker
+        # but must stop being everyone's least-loaded first pick
+        self.ttft_ewma_s: Optional[float] = None
+        self.ttft_samples = 0
+        # inter-token latency EWMA: the sharper half of the demotion signal
+        # — queue wait contaminates TTFT fleet-wide under load, but a
+        # healthy replica's ITL stays small, so a stalled replica separates
+        # by an order of magnitude instead of a factor
+        self.itl_ewma_s: Optional[float] = None
+        self.itl_samples = 0
         self._probe_lock = threading.Lock()
         self._probe_at = 0.0
         self._probe_doc: Optional[dict] = None
@@ -193,6 +210,19 @@ class Replica:
         doc = self._probe_doc or {}
         return int(doc.get("queue_depth", 0)) + int(doc.get("active", 0))
 
+    def record_ttft(self, sample_s: float, alpha: float = 0.3) -> None:
+        """Feed one router-observed first-token latency into the demotion
+        EWMA (router handler threads; a torn float read is harmless)."""
+        self.ttft_ewma_s = (sample_s if self.ttft_ewma_s is None
+                            else (1 - alpha) * self.ttft_ewma_s + alpha * sample_s)
+        self.ttft_samples += 1
+
+    def record_itl(self, sample_s: float, alpha: float = 0.3) -> None:
+        """Feed one router-observed inter-token gap into the demotion EWMA."""
+        self.itl_ewma_s = (sample_s if self.itl_ewma_s is None
+                           else (1 - alpha) * self.itl_ewma_s + alpha * sample_s)
+        self.itl_samples += 1
+
     # --------------------------------------------------------------- dispatch --
     def dispatch(self, doc: dict, resume: bool = False,
                  trace_id: Optional[str] = None,
@@ -216,6 +246,8 @@ class Replica:
         return {"id": self.id, "role": self.role, "state": self.state.name,
                 "url": getattr(self, "url", None),
                 "dispatches": self.dispatches, "failures": self.failures,
+                "ttft_ewma_s": (round(self.ttft_ewma_s, 4)
+                                if self.ttft_ewma_s is not None else None),
                 "breaker": self.breaker.describe() if self.breaker else None,
                 "probe": self._probe_doc}
 
@@ -312,12 +344,18 @@ class LocalReplica(Replica):
                       deadline_s=doc.get("deadline_s"),
                       seed=int(doc.get("seed") or 0),
                       trace_id=trace_id, parent_span_id=parent_span_id,
-                      handoff=bool(doc.get("handoff")))
+                      handoff=bool(doc.get("handoff")),
+                      priority=doc.get("priority"))
         try:
             if resume:
                 req = self.scheduler.submit_resume(doc["payload"], **kwargs)
             else:
                 req = self.scheduler.submit(doc["prompt"], **kwargs)
+        except AdmissionRejected as e:
+            # overload shedding at the replica: backpressure-class (the
+            # breaker never eats a 429), with the replica's own Retry-After
+            raise ReplicaUnavailable(str(e), status=429,
+                                     retry_after_s=e.retry_after_s) from e
         except QueueFullError as e:
             raise ReplicaUnavailable(str(e), status=429) from e
         except SchedulerStopped as e:
@@ -535,11 +573,17 @@ class HttpReplica(Replica):
                 detail = json.loads(resp.read()).get("error", "")
             except Exception:
                 pass
+            retry_after = None
+            try:
+                header = resp.getheader("Retry-After")
+                retry_after = float(header) if header else None
+            except (TypeError, ValueError):  # pragma: no cover - defensive
+                pass
             conn.close()
             if resp.status in (429, 503):
                 raise ReplicaUnavailable(
                     f"replica {self.id}: HTTP {resp.status} {detail}",
-                    status=resp.status)
+                    status=resp.status, retry_after_s=retry_after)
             raise ValueError(f"replica {self.id}: HTTP {resp.status} {detail}")
         return _HttpLeg(conn, resp, self.id, progress_timeout_s=self.timeout_s)
 

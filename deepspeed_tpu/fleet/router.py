@@ -43,42 +43,55 @@ trace.
 """
 
 import base64
+import collections
 import hashlib
 import json
 import os
+import queue as queue_mod
 import random
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Iterator, List, Optional, Set
+from typing import Callable, Iterator, List, Optional, Set
+
+import numpy as np
 
 from deepspeed_tpu import telemetry
 from deepspeed_tpu.fleet.breaker import backoff_delay
 from deepspeed_tpu.fleet.config import FleetConfig
 from deepspeed_tpu.fleet.faults import (FaultConfig, FaultInjector,
                                         config_from_env)
+from deepspeed_tpu.fleet.global_queue import (GlobalQueue, GlobalQueueFull,
+                                              QueueWaitExpired)
 from deepspeed_tpu.fleet.manager import ReplicaManager
 from deepspeed_tpu.fleet.metrics import FleetMetrics
 from deepspeed_tpu.fleet.replica import (Leg, Replica, ReplicaDied,
                                          ReplicaUnavailable)
-from deepspeed_tpu.serving.server import TRACE_HEADER, parse_request_body
+from deepspeed_tpu.serving.overload import validate_priority
+from deepspeed_tpu.serving.server import (PRIORITY_HEADER, TRACE_HEADER,
+                                          parse_request_body,
+                                          retry_after_header)
 from deepspeed_tpu.telemetry import new_span_id, new_trace_id, now_us
 from deepspeed_tpu.utils.logging import logger
 
 # request fields forwarded verbatim to a replica leg (everything else —
 # stream, session, handoff — is router-interpreted, never blind-forwarded)
 _LEG_FIELDS = ("max_new_tokens", "temperature", "eos_token_id", "deadline_s",
-               "seed")
+               "seed", "priority")
 
 
 class RoutingError(RuntimeError):
     """No replica could take the request (all candidates excluded or
     unavailable); ``status`` is the HTTP code the client sees (503, or 429
-    when the last refusal was backpressure)."""
+    when the last refusal was backpressure). ``retry_after_s`` rides 429/503
+    responses as a ``Retry-After`` header when the router (or a replica's
+    overload control) produced a drain-rate estimate."""
 
-    def __init__(self, message: str, status: int = 503):
+    def __init__(self, message: str, status: int = 503,
+                 retry_after_s: Optional[float] = None):
         super().__init__(message)
         self.status = status
+        self.retry_after_s = retry_after_s
 
 
 def _rendezvous_score(session_key: str, replica_id: str) -> int:
@@ -111,6 +124,18 @@ class RoutedRequest:
         self._legs_meta: List[dict] = []
         self._cancelled = False
         self._degraded = False
+        self._hedged = False
+        # every leg ever dispatched for this request: cancel() must reach
+        # BOTH racers of an undecided hedge, not just _current_leg — an
+        # uncancelled loser would stream to completion for a dead client,
+        # holding its KV and queue slot exactly when the fleet is saturated
+        self._all_legs: List[Leg] = []
+        self.priority = validate_priority(doc.get("priority"))
+        # global-queue slot ownership per dispatched leg: released exactly
+        # once when the leg reaches a terminal outcome (result consumed,
+        # death, cancel) so freed capacity pulls the next queued request
+        self._leg_slots = {}
+        self._slot_lock = threading.Lock()
 
         mgr = router._manager
         prefill_pool = self._dispatchable("prefill")
@@ -128,34 +153,37 @@ class RoutedRequest:
         self._disagg = (not resume and bool(prefill_pool) and bool(decode_pool)
                         and self._n > 1)
         if self._disagg:
+            self._pool_fn = lambda: self._dispatchable("prefill")
             self._leg1 = self._dispatch(
                 self._leg_doc(prompt=doc["prompt"], max_new_tokens=1,
                               handoff=True),
-                resume=False, pool=prefill_pool, what="prefill")
+                resume=False, pool_fn=self._pool_fn, what="prefill")
         elif resume:
-            pool = decode_pool or self._dispatchable()
             if not decode_pool and "decode" in registered_roles:
                 # same contract as the generate path: serving a resume off
                 # the dark decode pool is degradation — counted, not silent
                 self._mark_degraded("decode pool unavailable; resuming on "
                                     "the surviving pool")
+            self._pool_fn = (lambda: self._dispatchable("decode")
+                             or self._dispatchable())
             self._leg1 = self._dispatch(
                 self._leg_doc(payload=doc["payload"],
                               handoff=self._client_handoff),
-                resume=True, pool=pool, what="resume")
+                resume=True, pool_fn=self._pool_fn, what="resume")
         else:
             # whole-request serving: the mixed pool when one exists, else any
             # dispatchable replica. A disaggregated fleet with one side
             # entirely dark lands here — graceful degradation, counted
-            pool = self._dispatchable("mixed") or self._dispatchable()
             if disagg_topology and self._n > 1:
                 self._mark_degraded(
                     f"{'decode' if prefill_pool else 'prefill'} pool "
                     f"unavailable; serving monolithically")
+            self._pool_fn = (lambda: self._dispatchable("mixed")
+                             or self._dispatchable())
             self._leg1 = self._dispatch(
                 self._leg_doc(prompt=doc["prompt"],
                               handoff=self._client_handoff),
-                resume=False, pool=pool, what="generate")
+                resume=False, pool_fn=self._pool_fn, what="generate")
         self._iter = self._run()
 
     def tokens(self) -> Iterator[int]:
@@ -168,11 +196,24 @@ class RoutedRequest:
         return self._final
 
     def cancel(self) -> None:
-        """Client went away: cancel the active leg so its KV frees upstream."""
+        """Client went away: cancel every dispatched leg so their KV frees
+        upstream (and their global-queue slots free for the next queued
+        request) — during an undecided hedge race BOTH racers die here."""
         self._cancelled = True
-        leg = self._current_leg
-        if leg is not None:
-            leg.cancel()
+        for leg in list(self._all_legs):
+            try:
+                leg.cancel()
+            except Exception:  # a long-terminal leg must not mask the rest
+                pass
+            self._finish_leg(leg)
+
+    def _finish_leg(self, leg: Leg) -> None:
+        """Release the leg's global-queue slot exactly once (terminal
+        outcome: result consumed, death, cancel)."""
+        with self._slot_lock:
+            replica_id = self._leg_slots.pop(id(leg), None)
+        if replica_id is not None and self._router._gq is not None:
+            self._router._gq.release(replica_id)
 
     # ---------------------------------------------------------------- pools --
     def _dispatchable(self, role: Optional[str] = None) -> List[Replica]:
@@ -195,34 +236,121 @@ class RoutedRequest:
         logger.warning(f"fleet: degraded serving: {reason}")
 
     # ---------------------------------------------------------------- legs --
-    def _dispatch(self, doc: dict, resume: bool, pool: List[Replica],
+    def _remaining_deadline_s(self) -> Optional[float]:
+        """The client deadline minus time already spent routing; None = no
+        deadline on the request."""
+        if self._doc.get("deadline_s") is None:
+            return None
+        return max(0.001, float(self._doc["deadline_s"])
+                   - (time.monotonic() - self._t0_s))
+
+    def _deadline_remaining_raw_s(self) -> Optional[float]:
+        """Like :meth:`_remaining_deadline_s` but unfloored: negative means
+        the deadline has already passed (the stream feed-stop predicate)."""
+        if self._doc.get("deadline_s") is None:
+            return None
+        return float(self._doc["deadline_s"]) - (time.monotonic() - self._t0_s)
+
+    def _deadline_cut_final(self, yielded: List[int]) -> dict:
+        """The router-side decode feed-stop (the replica's own per-tick
+        deadline check cannot see router-observed stalls — chaos delays,
+        slow transport): a request past its deadline stops being fed HERE,
+        with the same terminal shape the replica scheduler produces."""
+        router = self._router
+        with router._counter_lock:
+            router._counters["deadline_cuts"] += 1
+        if router._metrics:
+            router._metrics.deadline_stream_cuts.inc()
+        return {"state": "TIMED_OUT", "finish_reason": "deadline",
+                "error": "deadline exceeded mid-stream at the router",
+                "tokens": list(yielded), "n_tokens": len(yielded),
+                "retry_after_s": (router._gq.retry_after_s()
+                                  if router._gq is not None else None),
+                "e2e_s": time.monotonic() - self._t0_s}
+
+    def _acquire_replica(self, pool_fn: Callable[[], List[Replica]],
+                         exclude: Set[str], what: str,
+                         acquire_timeout_s: Optional[float] = None
+                         ) -> Optional[Replica]:
+        """One replica with dispatch capacity, or None when the pool is
+        empty. With the global queue enabled the request WAITS here, in
+        priority/deadline order, until a replica has a free slot (pull
+        dispatch); an expired wait is router-level shedding — RoutingError
+        with Retry-After, nothing dispatched. Queue-disabled: the legacy
+        blind least-loaded push (the control arm)."""
+        router = self._router
+        gq = router._gq
+
+        def candidates_fn():
+            return router._healthy(pool_fn(), exclude)
+
+        if gq is None:
+            candidates = candidates_fn()
+            if not candidates:
+                return None
+            return router._pick(candidates, self._session_key)
+        if not candidates_fn():
+            # nothing dispatchable at all (everything down / breaker-open /
+            # excluded): fail over NOW like the pre-queue router — the queue
+            # exists to park work behind BUSY replicas, not dead ones
+            return None
+        try:
+            return gq.acquire(
+                candidates_fn, priority=self.priority,
+                deadline_s=self._remaining_deadline_s(),
+                session_key=self._session_key,
+                timeout_s=(acquire_timeout_s if acquire_timeout_s is not None
+                           else router._config.global_queue.acquire_timeout_s))
+        except GlobalQueueFull as e:
+            raise RoutingError(f"{what} leg rejected: {e}", status=429,
+                               retry_after_s=e.retry_after_s) from e
+        except QueueWaitExpired as e:
+            if router._metrics:
+                router._metrics.failures.inc()
+            raise RoutingError(
+                f"{what} leg shed at the router queue: {e}", status=429,
+                retry_after_s=e.retry_after_s) from e
+
+    def _release_replica(self, replica: Replica) -> None:
+        """Give back an acquired-but-unused slot (dispatch refused)."""
+        if self._router._gq is not None:
+            self._router._gq.release(replica.id)
+
+    def _dispatch(self, doc: dict, resume: bool,
+                  pool_fn: Callable[[], List[Replica]],
                   what: str, exclude: Optional[Set[str]] = None,
-                  internal_payload: bool = False) -> Leg:
-        """Failover dispatch over ``pool``: an unavailable replica (429/503/
-        unreachable) is excluded — and its breaker fed — and the next
+                  internal_payload: bool = False,
+                  acquire_timeout_s: Optional[float] = None) -> Leg:
+        """Failover dispatch over ``pool_fn()``: an unavailable replica (429/
+        503/unreachable) is excluded — and its breaker fed — and the next
         candidate tried after a bounded-jitter backoff; the chosen replica's
-        request root parents under a per-hop router span. ``internal_payload``
-        marks a router-packed resume body: a replica rejecting it (ValueError)
-        smells like transit corruption, so the next attempt re-sends the
-        pristine buffered copy instead of failing the request."""
+        request root parents under a per-hop router span. With the global
+        queue enabled the replica comes from a priority/deadline-ordered
+        grant (see :meth:`_acquire_replica`) and the leg holds its slot until
+        terminal. ``internal_payload`` marks a router-packed resume body: a
+        replica rejecting it (ValueError) smells like transit corruption, so
+        the next attempt re-sends the pristine buffered copy instead of
+        failing the request."""
         router = self._router
         cfg = router._config
         faults = router._faults
         exclude = set(exclude or ())
         last: Optional[Exception] = None
         last_status = 503
-        for attempt in range(min(cfg.max_attempts, max(1, len(pool)))):
+        last_retry_after: Optional[float] = None
+        for attempt in range(min(cfg.max_attempts, max(1, len(pool_fn())))):
             if attempt and cfg.retry_backoff_base_s > 0:
                 time.sleep(backoff_delay(attempt - 1, cfg.retry_backoff_base_s,
                                          cfg.retry_backoff_cap_s,
                                          cfg.retry_jitter_frac, random.random()))
-            candidates = router._healthy(pool, exclude)
-            if not candidates:
+            replica = self._acquire_replica(pool_fn, exclude, what,
+                                            acquire_timeout_s)
+            if replica is None:
                 break
-            replica = router._pick(candidates, self._session_key)
             breaker = replica.breaker
             if breaker is not None and not breaker.try_acquire():
                 exclude.add(replica.id)  # HALF_OPEN trial slots exhausted
+                self._release_replica(replica)
                 continue
             hop_span = new_span_id() if self.trace_id is not None else None
             t0 = now_us()
@@ -237,6 +365,7 @@ class RoutedRequest:
                                        trace_id=self.trace_id,
                                        parent_span_id=hop_span)
             except ReplicaUnavailable as e:
+                self._release_replica(replica)
                 with router._counter_lock:
                     replica.failures += 1
                 if breaker is not None:
@@ -246,11 +375,17 @@ class RoutedRequest:
                         breaker.record_failure()
                 exclude.add(replica.id)
                 last, last_status = e, e.status
+                if e.retry_after_s is not None:
+                    # replica-side overload shedding: keep the LARGEST
+                    # backoff seen — the client must outwait the worst pool
+                    last_retry_after = max(last_retry_after or 0.0,
+                                           e.retry_after_s)
                 if router._metrics:
                     router._metrics.retries.inc()
                 logger.info(f"fleet: {what} leg failed over from {replica.id}: {e}")
                 continue
             except (ValueError, TypeError) as e:
+                self._release_replica(replica)
                 if breaker is not None:
                     breaker.release()  # the payload was refused, not the replica
                 if resume and internal_payload:
@@ -277,15 +412,23 @@ class RoutedRequest:
             self._current_leg = leg
             self._current_replica = replica
             self._last_replica_id = replica.id
+            self._all_legs.append(leg)
+            if router._gq is not None:
+                with self._slot_lock:
+                    self._leg_slots[id(leg)] = replica.id
             return leg
         if router._metrics:
             router._metrics.failures.inc()
         status = last.status if isinstance(last, ReplicaUnavailable) else last_status
         if status < 100:  # transport-class failures carry status=0 as the
             status = 503  # breaker signal; a client must see a real HTTP code
+        if last_retry_after is None and status in (429, 503) \
+                and router._gq is not None:
+            last_retry_after = router._gq.retry_after_s()
         raise RoutingError(
             f"no replica available for {what} leg "
-            f"({len(pool)} in pool, {len(exclude)} excluded): {last}", status)
+            f"({len(pool_fn())} in pool, {len(exclude)} excluded): {last}",
+            status, retry_after_s=last_retry_after)
 
     def _inject_dispatch_faults(self, faults: FaultInjector, replica: Replica,
                                 doc: dict, corruptible: bool) -> dict:
@@ -320,16 +463,36 @@ class RoutedRequest:
         return doc
 
     def _stream(self, leg: Leg, replica_id: str) -> Iterator[int]:
-        """Leg token iterator with the mid-stream truncation injection point
-        armed (one decision per leg)."""
-        faults = self._router._faults
+        """Leg token iterator with the mid-stream truncation and decode-stall
+        injection points armed, and the first token's latency fed into the
+        replica's TTFT EWMA (the slow-replica demotion signal) and the
+        router's hedge-budget sample window."""
+        router = self._router
+        faults = router._faults
         cut = None
+        stall = False
         if faults is not None:
             n = faults.fire("stream_truncate", replica_id)
             if n is not None:
-                self._router._count_fault()
+                router._count_fault()
                 cut = faults.truncate_after(n, replica_id)
+            stall = faults.stalls_replica(replica_id)
+        t0 = time.monotonic()
+        t_last = t0
         for i, tok in enumerate(leg):
+            if stall:
+                # the slow-but-alive replica: every token may eat a seeded
+                # delay BEFORE it reaches the client (or the hedge arbiter)
+                n = faults.fire("decode_stall", replica_id)
+                if n is not None:
+                    router._count_fault()
+                    time.sleep(faults.stall_s(n, replica_id))
+            now = time.monotonic()
+            if i == 0:
+                router._record_ttft(replica_id, now - t0)
+            else:
+                router._record_itl(replica_id, now - t_last)
+            t_last = now
             if cut is not None and i >= cut:
                 leg.cancel()
                 raise ReplicaDied(f"replica {replica_id}: injected mid-stream "
@@ -353,20 +516,236 @@ class RoutedRequest:
                                 "uid": final.get("uid"),
                                 "n_tokens": final.get("n_tokens")})
 
+    # ------------------------------------------------------------- hedging --
+    def _hedge_eligible(self) -> bool:
+        """Hedge single-leg generate requests only: a resume leg holds a
+        one-shot KV payload (two imports = two KV copies racing), and the
+        disaggregated path has its own decode re-dispatch. Sampled requests
+        are fine — both legs run the identical seeded sampler."""
+        hcfg = self._router._config.hedge
+        return (hcfg.enabled and not self._resume and not self._cancelled
+                and (not hcfg.interactive_only or self.priority == "interactive"))
+
+    def _reader(self, idx: int, leg: Leg, replica_id: str, out) -> None:
+        """Pump one leg into the hedge arbiter's event queue; releases the
+        leg's queue slot on exit (win, loss, or death)."""
+        try:
+            for tok in self._stream(leg, replica_id):
+                out.put((idx, "tok", tok))
+            out.put((idx, "done", dict(leg.result())))
+        except Exception as e:  # ReplicaDied, transport errors
+            out.put((idx, "err", e))
+        finally:
+            self._finish_leg(leg)
+
+    def _commit_leg(self, idx: int, legs, live, dead) -> None:
+        """``idx`` is now the stream: cancel every other live leg (its reader
+        drains to termination and releases the slot; the upstream scheduler
+        frees its KV on the next tick) and repoint the request at the winner."""
+        router = self._router
+        for other in list(live):
+            if other == idx:
+                continue
+            live.discard(other)
+            dead.add(other)
+            legs[other][0].cancel()
+            if router._metrics:
+                router._metrics.hedge_cancellations.inc()
+        self._current_leg, self._last_replica_id = legs[idx]
+        self._current_replica = router._manager_get(legs[idx][1])
+        if idx == 1:
+            with router._counter_lock:
+                router._counters["hedge_wins"] += 1
+            if router._metrics:
+                router._metrics.hedge_wins.inc()
+
+    def _run_hedged(self) -> Iterator[int]:
+        """Hedged streaming, first-past-the-prefix-wins: greedy and seeded
+        sampling make both legs token-identical, so a hedge dispatched at ANY
+        stream position — no first token within the budget, or a mid-stream
+        stall after ``k`` tokens — replays the request from scratch, silently
+        catches up through the ``k`` already-yielded tokens, and the stream
+        follows whichever leg delivers the next position first; the loser is
+        cancelled the moment the race is decided (its KV frees upstream).
+        The per-token wait is the TTFT budget capped by ``deadline_frac`` x
+        the remaining client deadline (a cold-start default must not eat the
+        whole deadline), one hedge per request, and a request whose deadline
+        passes mid-stream is cut here — the router-side decode feed-stop."""
+        router = self._router
+        hcfg = router._config.hedge
+        events: queue_mod.Queue = queue_mod.Queue()
+        legs = {0: (self._leg1, self._last_replica_id)}
+        started_s = {0: time.monotonic()}
+        delivered = {0: 0}    # tokens received per leg (its stream position)
+        live = {0}
+        dead: Set[int] = set()
+        committed: Optional[int] = None   # decided at the first contested pos
+        yielded: List[int] = []
+        final: Optional[dict] = None
+        first_err: Optional[Exception] = None
+        censored: Set[int] = set()  # legs whose silent wait was sampled once
+        suppressed_waits = 0        # storm-brake denials: backoff multiplier
+        threading.Thread(target=self._reader,
+                         args=(0, self._leg1, self._last_replica_id, events),
+                         name="dstpu-hedge-leg0", daemon=True).start()
+        while final is None:
+            remaining = self._deadline_remaining_raw_s()
+            if remaining is not None and remaining <= 0:
+                # the deadline passed — but events may already be BUFFERED
+                # (e.g. the stream completed while a hedge dispatch held the
+                # loop): drain them through the NORMAL processing below —
+                # buffered tokens still stream, a buffered done still wins —
+                # and only cut when the event queue is truly silent
+                try:
+                    idx, kind, val = events.get_nowait()
+                except queue_mod.Empty:
+                    for idx in live:
+                        legs[idx][0].cancel()
+                    final = self._deadline_cut_final(yielded)
+                    break
+            else:
+                budget: Optional[float] = None
+                if len(legs) == 1 and not self._cancelled:
+                    budget = router._hedge_budget_s()
+                    if budget is not None:
+                        # each storm-brake denial doubles the next wait
+                        # (capped at 4x): a request that cannot hedge must
+                        # not spin on the budget, but must still re-check
+                        # soon enough that freshly-formed demotion evidence
+                        # rescues it inside a client deadline
+                        budget = budget * (1 << min(suppressed_waits, 2))
+                        if remaining is not None:
+                            budget = min(budget,
+                                         max(0.02,
+                                             remaining * hcfg.deadline_frac))
+                try:
+                    idx, kind, val = events.get(
+                        timeout=budget if budget is not None else remaining)
+                except queue_mod.Empty:
+                    if budget is None:
+                        continue  # deadline wake-up: the top of the loop cuts
+                    # budget expired with no stream progress: hedge once. The
+                    # silence is itself a latency observation — feed a censored
+                    # (elapsed-so-far) TTFT sample to the slow replica's demotion
+                    # EWMA so it stops being everyone's least-loaded first pick
+                    (slow_idx,) = live
+                    slow_id = legs[slow_idx][1]
+                    if delivered[slow_idx] == 0 and slow_idx not in censored:
+                        # one censored TTFT sample per silent leg (not one per
+                        # wake-up — that would pollute the EWMA with wait time)
+                        censored.add(slow_idx)
+                        router._record_ttft(
+                            slow_id, time.monotonic() - started_s[slow_idx])
+                    if not router._hedge_admissible(slow_id):
+                        # storm brake: no replica-specific evidence and the
+                        # speculative bucket is dry — back off and re-check; the
+                        # censored sample above builds the demotion evidence
+                        # that exempts a genuinely stalled replica's victims
+                        suppressed_waits += 1
+                        continue
+                    try:
+                        # a hedge is only worth dispatching if capacity is free
+                        # roughly NOW: a long queue acquire here would freeze
+                        # the live stream (this loop is the event consumer) and
+                        # add load to an already-saturated fleet — so the hedge
+                        # leg's queue wait is clamped to a token gesture
+                        leg2 = self._dispatch(
+                            self._leg_doc(prompt=self._doc["prompt"],
+                                          handoff=self._client_handoff),
+                            resume=False, pool_fn=self._pool_fn, what="hedge",
+                            exclude={slow_id}, acquire_timeout_s=0.05)
+                    except (RoutingError, ValueError, TypeError) as e:
+                        # no second replica right now: not fatal — the primary
+                        # is slow, not dead; keep waiting and retry next expiry
+                        logger.info(f"fleet: hedge dispatch unavailable: {e}")
+                        continue
+                    self._hedged = True
+                    with router._counter_lock:
+                        router._counters["hedged"] += 1
+                    if router._metrics:
+                        router._metrics.hedge_dispatches.inc()
+                    legs[1] = (leg2, self._last_replica_id)
+                    started_s[1] = time.monotonic()
+                    delivered[1] = 0
+                    live.add(1)
+                    logger.info(f"fleet: hedged {slow_id} after no token within "
+                                f"the budget at position {len(yielded)}")
+                    threading.Thread(target=self._reader,
+                                     args=(1, leg2, self._last_replica_id, events),
+                                     name="dstpu-hedge-leg1", daemon=True).start()
+                    continue
+            if idx in dead:
+                continue  # cancelled-loser remnants
+            if kind == "err":
+                live.discard(idx)
+                dead.add(idx)
+                self._fail_replica(legs[idx][1])
+                if idx == committed:  # the WINNER died mid-stream: same
+                    raise val         # contract as the unhedged path
+                if not live:
+                    raise first_err or val
+                first_err = first_err or val
+                continue
+            if kind == "done":
+                if committed is None:
+                    # a completed leg is past every position: it wins the
+                    # race outright (both legs fully streamed = first done)
+                    committed = idx
+                    self._commit_leg(idx, legs, live, dead)
+                final = val
+                continue
+            # kind == "tok"
+            pos = delivered[idx]
+            delivered[idx] = pos + 1
+            if pos < len(yielded):
+                continue  # hedge catch-up inside the already-yielded prefix
+            if committed is None and len(live) > 1:
+                # this leg just produced the next needed position first:
+                # the race is decided, first-past-the-prefix-wins
+                committed = idx
+                self._commit_leg(idx, legs, live, dead)
+            yielded.append(val)
+            yield val
+        self._leg_meta("hedge" if committed == 1 else "serve", final)
+        return final
+
+    def _fail_replica(self, replica_id: str) -> None:
+        replica = self._router._manager_get(replica_id)
+        if replica is not None and replica.breaker is not None:
+            replica.breaker.record_failure(trial=False)
+
     # --------------------------------------------------------------- route --
     def _run(self) -> Iterator[int]:
         router = self._router
         if not self._disagg:
-            try:
-                for tok in self._stream(self._leg1, self._last_replica_id):
-                    yield tok
-                final = dict(self._leg1.result())
-            except ReplicaDied:
-                # single-leg death: nothing buffered to resume from — the
-                # breaker learns, the client gets 502 / a terminal SSE error
-                self._fail_current_replica()
-                raise
-            self._leg_meta("resume" if self._resume else "serve", final)
+            if self._hedge_eligible():
+                final = yield from self._run_hedged()
+            else:
+                final = None
+                yielded: List[int] = []
+                try:
+                    for tok in self._stream(self._leg1, self._last_replica_id):
+                        remaining = self._deadline_remaining_raw_s()
+                        if remaining is not None and remaining <= 0:
+                            # past-deadline stream: stop feeding NOW (the
+                            # router-side twin of the scheduler's per-tick
+                            # deadline feed-stop, for stalls the replica
+                            # cannot see)
+                            self._leg1.cancel()
+                            final = self._deadline_cut_final(yielded)
+                            break
+                        yielded.append(tok)
+                        yield tok
+                    if final is None:
+                        final = dict(self._leg1.result())
+                except ReplicaDied:
+                    # single-leg death: nothing buffered to resume from — the
+                    # breaker learns, the client gets 502 / a terminal SSE error
+                    self._fail_current_replica()
+                    raise
+                finally:
+                    self._finish_leg(self._leg1)
+                self._leg_meta("resume" if self._resume else "serve", final)
             if not self._client_handoff:
                 final.pop("handoff", None)
         else:
@@ -376,6 +755,8 @@ class RoutedRequest:
             except ReplicaDied:
                 self._fail_current_replica()
                 raise
+            finally:
+                self._finish_leg(self._leg1)
             for tok in final1["tokens"]:
                 yield tok
             self._leg_meta("prefill", final1)
@@ -421,8 +802,10 @@ class RoutedRequest:
                             yield tok
                             sent2 += 1
                         final2 = dict(leg2.result())
+                        self._finish_leg(leg2)
                         break
                     except ReplicaDied as e:
+                        self._finish_leg(leg2)
                         self._fail_current_replica()
                         exclude.add(self._last_replica_id)
                         if attempt == 1 or self._cancelled:
@@ -479,20 +862,19 @@ class RoutedRequest:
                             - (time.monotonic() - self._t0_s))
         doc = self._leg_doc(payload=payload, max_new_tokens=self._n - 1,
                             handoff=self._client_handoff, deadline_s=remaining)
-        decode_pool = [r for r in self._dispatchable("decode")
-                       if r.id not in exclude]
         try:
-            return self._dispatch(doc, resume=True, pool=decode_pool,
+            return self._dispatch(doc, resume=True,
+                                  pool_fn=lambda: self._dispatchable("decode"),
                                   what="decode", exclude=exclude,
                                   internal_payload=True)
         except RoutingError:
-            fallback = [r for r in self._dispatchable()
-                        if r.role != "decode" and r.id not in exclude]
-            if not fallback:
+            fallback_fn = lambda: [r for r in self._dispatchable()
+                                   if r.role != "decode"]
+            if not [r for r in fallback_fn() if r.id not in exclude]:
                 raise
             self._mark_degraded("decode pool unavailable mid-request; "
                                 "resuming on the surviving pool")
-            return self._dispatch(doc, resume=True, pool=fallback,
+            return self._dispatch(doc, resume=True, pool_fn=fallback_fn,
                                   what="decode-degraded", exclude=exclude,
                                   internal_payload=True)
 
@@ -504,11 +886,37 @@ class FleetRouter:
         self._manager = manager
         self._config = config or manager.config
         self._metrics = FleetMetrics.maybe_create()
-        self._counters = {"requests": 0, "degraded": 0}
+        self._counters = {"requests": 0, "degraded": 0, "hedged": 0,
+                          "hedge_wins": 0, "deadline_cuts": 0,
+                          "hedges_suppressed": 0}
         self._counter_lock = threading.Lock()
         self._server = None
         self._thread = None
         self._draining = threading.Event()
+        # the global queue: queued work lives HERE in priority/deadline
+        # order; replicas pull it as their dispatch slots free (ROADMAP 3c)
+        gq_cfg = self._config.global_queue
+        self._gq: Optional[GlobalQueue] = None
+        if gq_cfg.enabled:
+            self._gq = GlobalQueue(
+                max_inflight=gq_cfg.max_inflight_per_replica,
+                capacity=gq_cfg.capacity, pick=self._queue_pick,
+                retry_after_floor_s=gq_cfg.retry_after_floor_s,
+                retry_after_cap_s=gq_cfg.retry_after_cap_s,
+                metrics=self._metrics)
+        # router-observed TTFT samples: the hedge budget's p95 source
+        self._ttft_samples = collections.deque(maxlen=128)
+        self._ttft_lock = threading.Lock()
+        # speculative-hedge token bucket (the storm brake): refilled by
+        # admissions at max_hedge_frac per request, spent by hedges that
+        # lack replica-specific evidence; starts full so a cold fleet can
+        # still rescue its very first victims
+        self._hedge_allowance_cap = max(1.0, 32 * self._config.hedge.max_hedge_frac)
+        self._hedge_allowance = self._hedge_allowance_cap
+        # budget cache: every waiting request re-reads the budget each
+        # wake-up; a p95 over 128 samples at that frequency is real CPU on
+        # a small host, and 100ms staleness is invisible at hedge scale
+        self._budget_cache = (0.0, None)   # (computed_at_s, value)
         # fault injection: config first, the DSTPU_FAULTS env var (JSON
         # FaultConfig body) second — None on the (default, production) path,
         # so every hook is one is-None check
@@ -553,11 +961,134 @@ class FleetRouter:
 
     def _pick(self, candidates: List[Replica], session_key: Optional[str]) -> Replica:
         """Affinity (rendezvous hash) when a session key rides the request,
-        least-loaded otherwise; candidates are already healthy-filtered."""
+        least-loaded otherwise — with slow replicas (router-observed TTFT
+        EWMA above ``slow_demote_factor`` × the candidate median) demoted to
+        last resort; candidates are already healthy-filtered."""
         if session_key:
             return max(candidates,
                        key=lambda r: _rendezvous_score(session_key, r.id))
+        demoted = self._demoted_ids(candidates)
+        if demoted:
+            if self._metrics:
+                self._metrics.hedge_demotions.inc()
+            return min(candidates,
+                       key=lambda r: (r.id in demoted, r.load, r.id))
         return min(candidates, key=lambda r: (r.load, r.id))
+
+    def _queue_pick(self, candidates: List[Replica],
+                    session_key: Optional[str], pool=None,
+                    deadline=None) -> Optional[Replica]:
+        """The global queue's grant policy: :meth:`_pick` semantics, except
+        demotion is judged against the entry's WHOLE pool (not just the
+        replicas with free slots) and a deadline-carrying entry is never
+        granted to a demoted replica while a faster peer exists anywhere in
+        that pool — a grant onto a stalled replica burns the deadline the
+        queue exists to protect, so the entry waits for a healthy slot
+        instead (None = "rather wait"). Deadline-free work still flows to a
+        demoted replica when nothing faster has capacity, which keeps its
+        latency EWMAs fed and lets a recovered replica earn its way back."""
+        if session_key:
+            return max(candidates,
+                       key=lambda r: _rendezvous_score(session_key, r.id))
+        demoted = self._demoted_ids(list(pool) if pool else candidates)
+        live = [r for r in candidates if r.id not in demoted]
+        if live:
+            return min(live, key=lambda r: (r.load, r.id))
+        if demoted:
+            if self._metrics:
+                self._metrics.hedge_demotions.inc()
+            if deadline is not None and pool \
+                    and any(r.id not in demoted for r in pool):
+                return None
+        return min(candidates, key=lambda r: (r.load, r.id))
+
+    def _demoted_ids(self, candidates: List[Replica]) -> Set[str]:
+        """Candidates whose token-latency EWMAs mark them slow-but-alive:
+        above ``slow_demote_factor`` × the median of candidates with data,
+        on EITHER signal — TTFT (queue wait + first decode) or inter-token
+        latency (ITL, the sharper one: load inflates every replica's TTFT
+        together, but a healthy replica's ITL stays small, so a stalled
+        replica separates by an order of magnitude). Needs >= 2 informed
+        candidates per signal — a lone sample has no peer to be slower
+        than; the breaker, not demotion, handles a whole-fleet stall."""
+        factor = self._config.hedge.slow_demote_factor
+        min_samples = self._config.hedge.min_samples
+        out: Set[str] = set()
+        for ewma, samples in (("ttft_ewma_s", "ttft_samples"),
+                              ("itl_ewma_s", "itl_samples")):
+            informed = [(r.id, getattr(r, ewma)) for r in candidates
+                        if getattr(r, ewma) is not None
+                        and getattr(r, samples) >= min_samples]
+            if len(informed) < 2:
+                continue
+            median = float(np.median([s for _, s in informed]))
+            if median <= 0:
+                continue
+            out |= {rid for rid, s in informed if s > factor * median}
+        return out if len(out) < len(candidates) else set()
+
+    def _record_ttft(self, replica_id: str, sample_s: float) -> None:
+        replica = self._manager_get(replica_id)
+        if replica is not None:
+            replica.record_ttft(sample_s)
+        with self._ttft_lock:
+            self._ttft_samples.append(sample_s)
+
+    def _record_itl(self, replica_id: str, sample_s: float) -> None:
+        replica = self._manager_get(replica_id)
+        if replica is not None:
+            replica.record_itl(sample_s)
+
+    def _manager_get(self, replica_id: str) -> Optional[Replica]:
+        try:
+            return self._manager.get(replica_id)
+        except KeyError:
+            return None  # deregistered mid-request (supervisor reaped it)
+
+    def _hedge_budget_s(self) -> Optional[float]:
+        """The TTFT budget before a hedge fires: fixed when configured, else
+        p95 of the router's observed TTFTs × ``budget_factor`` (the
+        cold-start default until enough samples land)."""
+        hcfg = self._config.hedge
+        if not hcfg.enabled:
+            return None
+        if hcfg.ttft_budget_s is not None:
+            return hcfg.ttft_budget_s
+        now = time.monotonic()
+        with self._ttft_lock:
+            cached_at, cached = self._budget_cache
+            if cached is not None and now - cached_at < 0.1:
+                return cached
+            samples = list(self._ttft_samples)
+        if len(samples) < hcfg.min_samples:
+            value = hcfg.default_budget_s
+        else:
+            value = max(hcfg.min_budget_s,
+                        float(np.percentile(np.asarray(samples), 95))
+                        * hcfg.budget_factor)
+        with self._ttft_lock:
+            self._budget_cache = (now, value)
+        return value
+
+    def _hedge_admissible(self, slow_replica_id: str) -> bool:
+        """May a hedge fire against ``slow_replica_id`` right now? Evidence-
+        driven hedges — the replica's TTFT EWMA is demotion-grade slow vs
+        its current peers — always may (a stalled replica's victims are
+        rescued unconditionally); speculative ones spend a token from the
+        storm brake bucket, and are suppressed (counted) when it is dry."""
+        replica = self._manager_get(slow_replica_id)
+        if replica is not None:
+            peers = [r for r in self._manager.replicas(available_only=True)]
+            if slow_replica_id in self._demoted_ids(peers):
+                return True
+        with self._counter_lock:
+            if self._hedge_allowance >= 1.0:
+                self._hedge_allowance -= 1.0
+                return True
+            self._counters["hedges_suppressed"] += 1
+        if self._metrics:
+            self._metrics.hedge_suppressed.inc()
+        return False
 
     def _count_fault(self) -> None:
         if self._metrics:
@@ -583,10 +1114,25 @@ class FleetRouter:
         telemetry is active); the router span parents both replica legs."""
         if self._draining.is_set():
             raise RoutingError("router is draining", status=503)
+        validate_priority(doc.get("priority"))  # unknown class = client 400
         with self._counter_lock:
             self._counters["requests"] += 1
+            # every admission refills the speculative-hedge storm brake
+            self._hedge_allowance = min(
+                self._hedge_allowance_cap,
+                self._hedge_allowance + self._config.hedge.max_hedge_frac)
         if self._metrics:
             self._metrics.requests.inc()
+        if self._faults is not None and self._gq is not None:
+            # overload_burst: a seeded synthetic admission burst — phantom
+            # entries occupy the global queue, deterministically driving
+            # depth pressure, Retry-After growth and queue shedding
+            n = self._faults.fire("overload_burst")
+            if n is not None:
+                self._count_fault()
+                self._gq.inject_phantoms(
+                    self._faults.config.overload_burst_requests,
+                    self._faults.config.overload_burst_hold_s)
         # no fleet-wide probe sweep here: _healthy probes the candidate pool
         # (TTL-cached) during dispatch; a dead upstream elsewhere in the fleet
         # must not add its probe timeout to THIS request's latency. The
@@ -607,6 +1153,16 @@ class FleetRouter:
         with self._counter_lock:
             doc["router"] = dict(self._counters)
         doc["router"]["draining"] = self._draining.is_set()
+        if self._gq is not None:
+            doc["router"]["global_queue"] = self._gq.describe()
+        hedge_budget = self._hedge_budget_s()
+        with self._ttft_lock:
+            n_samples = len(self._ttft_samples)
+        doc["router"]["hedge"] = {
+            "enabled": self._config.hedge.enabled,
+            "budget_s": round(hedge_budget, 4) if hedge_budget else None,
+            "ttft_samples": n_samples,
+        }
         faults = self._faults
         if faults is not None:
             doc["faults"] = faults.report()
@@ -642,13 +1198,15 @@ class FleetRouter:
 
         class Handler(BaseHTTPRequestHandler):
 
-            def _send_json(self, code, doc, trace_id=None):
+            def _send_json(self, code, doc, trace_id=None, retry_after=None):
                 data = json.dumps(doc).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 if trace_id is not None:
                     self.send_header(TRACE_HEADER, trace_id)
+                if retry_after is not None:
+                    self.send_header("Retry-After", retry_after_header(retry_after))
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -709,13 +1267,17 @@ class FleetRouter:
                     return
                 session_key = (self.headers.get(config.affinity_header)
                                or doc.get("session") or None)
+                if not doc.get("priority") and self.headers.get(PRIORITY_HEADER):
+                    # header-form priority class, same contract as a replica
+                    doc["priority"] = self.headers.get(PRIORITY_HEADER)
                 upstream_trace = self.headers.get(TRACE_HEADER) or None
                 try:
                     routed = router.route(doc, resume=resume,
                                           session_key=session_key,
                                           trace_id=upstream_trace)
                 except RoutingError as e:
-                    self._send_json(e.status, {"error": str(e)})
+                    self._send_json(e.status, {"error": str(e)},
+                                    retry_after=e.retry_after_s)
                     return
                 except (ValueError, TypeError) as e:
                     self._send_json(400, {"error": str(e)})
@@ -726,12 +1288,21 @@ class FleetRouter:
                     else:
                         final = dict(routed.result())
                         self._encode_handoff(final)
-                        self._send_json(200, final, trace_id=routed.trace_id)
+                        # 429 only when nothing was delivered (an admission-
+                        # class rejection) — same contract as
+                        # serving/server.py; a mid-decode deadline cut that
+                        # streamed partial tokens consumed real capacity and
+                        # stays a 200 TIMED_OUT doc
+                        status = (429 if final.get("retry_after_s")
+                                  and not final.get("tokens") else 200)
+                        self._send_json(status, final, trace_id=routed.trace_id,
+                                        retry_after=final.get("retry_after_s"))
                 except RoutingError as e:
                     # mid-route failure (e.g. the decode pool vanished after
                     # the prefill leg): non-stream mode can still say why
                     routed.cancel()
-                    self._send_json(e.status, {"error": str(e)})
+                    self._send_json(e.status, {"error": str(e)},
+                                    retry_after=e.retry_after_s)
                 except (ValueError, TypeError) as e:
                     routed.cancel()
                     self._send_json(400, {"error": str(e)})
@@ -772,9 +1343,14 @@ class FleetRouter:
                     # event — never a second HTTP status line.
                     # Free the surviving leg's KV, best-effort error event
                     routed.cancel()
+                    event = {"done": True, "state": "FAILED", "error": str(e)}
+                    if isinstance(e, RoutingError) and e.retry_after_s is not None:
+                        # the backoff rides the SSE error event: streaming
+                        # clients see the same Retry-After contract
+                        event["retry_after_s"] = e.retry_after_s
                     try:
                         self.wfile.write(
-                            f"data: {json.dumps({'done': True, 'state': 'FAILED', 'error': str(e)})}\n\n".encode())
+                            f"data: {json.dumps(event)}\n\n".encode())
                         self.wfile.flush()
                     except (BrokenPipeError, ConnectionResetError):
                         pass

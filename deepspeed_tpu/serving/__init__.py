@@ -17,17 +17,21 @@ Usage::
     server.stop()                               # graceful drain
 """
 
-from deepspeed_tpu.serving.config import PrefixCacheConfig, ServingConfig
+from deepspeed_tpu.serving.config import (OverloadConfig, PrefixCacheConfig,
+                                          ServingConfig)
 from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.overload import (PRIORITIES, BrownoutController,
+                                            RateEstimator)
 from deepspeed_tpu.serving.request import (Request, RequestState, TERMINAL_STATES,
                                            TokenStream)
-from deepspeed_tpu.serving.scheduler import (QueueFullError, SchedulerStopped,
-                                             ServingScheduler)
+from deepspeed_tpu.serving.scheduler import (AdmissionRejected, QueueFullError,
+                                             SchedulerStopped, ServingScheduler)
 from deepspeed_tpu.serving.server import ServingServer
 
 __all__ = [
-    "PrefixCacheConfig",
+    "OverloadConfig", "PrefixCacheConfig", "PRIORITIES", "BrownoutController",
+    "RateEstimator",
     "ServingConfig", "ServingMetrics", "Request", "RequestState", "TERMINAL_STATES",
-    "TokenStream", "ServingScheduler", "QueueFullError", "SchedulerStopped",
-    "ServingServer",
+    "TokenStream", "ServingScheduler", "AdmissionRejected", "QueueFullError",
+    "SchedulerStopped", "ServingServer",
 ]

@@ -6,9 +6,9 @@ validated pydantic-style like the other config blocks (``config_v2.py``,
 ``telemetry/config.py``).
 """
 
-from typing import Literal, Optional
+from typing import Literal, Optional, Tuple
 
-from pydantic import Field, field_validator
+from pydantic import Field, field_validator, model_validator
 
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 
@@ -36,6 +36,74 @@ class PrefixCacheConfig(DeepSpeedConfigModel):
     min_prefix_blocks: int = Field(1, ge=1)
     """Smallest cached-prefix match (in blocks) worth applying to a request;
     shorter matches prefill cold."""
+
+
+class OverloadConfig(DeepSpeedConfigModel):
+    """Overload control (``serving/overload.py``): priority admission,
+    deadline-aware shedding and staged brownout degradation. Enabled by
+    default but quiescent under normal load — admission control only acts on
+    requests that carry a deadline, and the brownout stages only engage when
+    the smoothed pressure signal clears the thresholds."""
+
+    enabled: bool = True
+    """Master switch. False = the pre-overload-control scheduler: FIFO queue
+    order, no admission estimate, no shedding, no brownout (the uniform-FIFO
+    control arm the overload gates compare against)."""
+
+    priority_ordering: bool = True
+    """Admit queued requests in (priority, deadline, arrival) order instead
+    of FIFO; within a class, earliest deadline first."""
+
+    admission_control: bool = True
+    """Estimate queue wait from the measured token rate at ``submit()`` and
+    reject a request whose deadline is provably unmeetable (HTTP 429 +
+    ``Retry-After``) instead of admitting it to fail mid-queue — rejecting at
+    admission is cheap, failing after prefill wastes engine work."""
+
+    admission_margin: float = Field(1.0, gt=0)
+    """Feasibility proof margin: a request is rejected when the estimated
+    completion time exceeds ``deadline * margin``. Values above 1 are more
+    lenient (reject later); below 1 more aggressive."""
+
+    min_rate_samples: int = Field(4, ge=1)
+    """Executed batches the rate estimator needs before admission control or
+    shedding trusts it; a cold estimator admits everything."""
+
+    rate_alpha: float = Field(0.25, gt=0, le=1)
+    """EWMA smoothing factor for the measured token rate."""
+
+    shed_enabled: bool = True
+    """Under sustained pressure (brownout stage >= 1), shed queued requests
+    whose deadline is provably unmeetable — lowest priority / latest deadline
+    first — before they waste a prefill."""
+
+    brownout_stage_thresholds: Tuple[float, float, float] = (0.65, 0.85, 0.95)
+    """Smoothed-pressure entry thresholds for brownout stages 1..3 (stage 1:
+    clamp batch ``max_new_tokens``; stage 2: + disable speculative decode
+    chunking; stage 3: + reject batch class at submission)."""
+
+    brownout_hysteresis: float = Field(0.1, ge=0)
+    """A stage entered at threshold ``t`` is only left when the smoothed
+    pressure falls below ``t - hysteresis`` (no service-mode flapping)."""
+
+    pressure_alpha: float = Field(0.3, gt=0, le=1)
+    """EWMA smoothing factor for the pressure signal
+    (``max(queue_fraction, kv_occupancy)``, sampled every scheduler tick)."""
+
+    brownout_clamp_max_new_tokens: int = Field(16, ge=1)
+    """Stage >= 1 generation cap for batch-class requests (flagged
+    ``degraded_mode`` in the response)."""
+
+    retry_after_floor_s: float = Field(0.5, gt=0)
+    retry_after_cap_s: float = Field(30.0, gt=0)
+    """Bounds on the ``Retry-After`` estimate derived from the measured queue
+    drain rate (429/503 responses)."""
+
+    @model_validator(mode="after")
+    def _ordered_thresholds(self):
+        if list(self.brownout_stage_thresholds) != sorted(self.brownout_stage_thresholds):
+            raise ValueError("brownout_stage_thresholds must be ascending")
+        return self
 
 
 class ServingConfig(DeepSpeedConfigModel):
@@ -96,6 +164,10 @@ class ServingConfig(DeepSpeedConfigModel):
     prefix_cache: PrefixCacheConfig = PrefixCacheConfig()
     """Automatic prefix caching over the paged KV cache (radix-tree reuse +
     copy-on-write sharing); see :class:`PrefixCacheConfig`."""
+
+    overload: OverloadConfig = OverloadConfig()
+    """Overload control: priority admission, deadline-aware shedding, staged
+    brownout degradation; see :class:`OverloadConfig`."""
 
     max_resume_body_bytes: int = Field(DEFAULT_MAX_RESUME_BODY_BYTES, gt=0)
     """Upper bound on a ``POST /v1/resume`` body (the base64 KV-handoff

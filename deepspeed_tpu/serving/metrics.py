@@ -60,6 +60,25 @@ class ServingMetrics:
         self.prefix_evictions = registry.counter(
             "serving_prefix_evictions_total",
             "Prefix-trie leaves evicted (LRU) under KV pressure or the trie cap")
+        # overload control (serving/overload.py + scheduler admission/shed)
+        self.shed_admission = registry.counter(
+            "serving_shed_admission_total",
+            "Requests rejected at admission: deadline provably unmeetable")
+        self.shed_queue = registry.counter(
+            "serving_shed_queue_total",
+            "Queued requests shed under sustained overload pressure")
+        self.brownout_stage = registry.gauge(
+            "serving_brownout_stage",
+            "Current brownout degradation stage (0 = normal service)")
+        self.brownout_transitions = registry.counter(
+            "serving_brownout_transitions_total",
+            "Brownout stage changes (hysteresis-smoothed)")
+        self.brownout_clamped = registry.counter(
+            "serving_brownout_clamped_total",
+            "Batch-class requests whose max_new_tokens was brownout-clamped")
+        self.brownout_rejections = registry.counter(
+            "serving_brownout_rejections_total",
+            "Batch-class requests rejected outright at brownout stage 3")
 
     @classmethod
     def maybe_create(cls) -> Optional["ServingMetrics"]:

@@ -1,0 +1,159 @@
+"""Overload-control primitives for the serving layer.
+
+Three small, engine-free pieces the scheduler composes (``serving/scheduler.py``)
+— kept separate so the policy math is unit-testable without an engine:
+
+- **priority classes**: every request carries one of :data:`PRIORITIES`
+  (``interactive`` beats ``batch`` at every decision point: queue order,
+  brownout clamping, stage-3 rejection, router hedging);
+- :class:`RateEstimator` — an EWMA of the engine's *measured* token
+  commit rate (prefill + decode lumped), the denominator for every
+  queue-wait / deadline-feasibility estimate. Warmup-gated: admission
+  control never rejects on a cold estimator;
+- :class:`BrownoutController` — hysteresis-smoothed pressure (queue depth
+  fraction vs KV occupancy, whichever is worse) mapped to staged
+  degradation levels. Stages only move one way per update and re-arm below
+  ``threshold - hysteresis``, so a noisy pressure signal cannot flap the
+  fleet between degraded and normal service.
+
+The stages (enforced by the scheduler, each counted and flagged in the
+response ``degraded_mode`` — never silent):
+
+- **0** normal service;
+- **1** clamp ``max_new_tokens`` for batch-class requests;
+- **2** additionally disable speculative extras (chunked ``decode_loop``
+  dispatch falls back to one token per step);
+- **3** additionally reject batch-class requests outright at submission
+  (HTTP 429 + ``Retry-After``).
+"""
+
+import time
+from typing import Optional, Sequence
+
+PRIORITIES = ("interactive", "batch")
+"""Priority classes, best first. ``interactive`` is the default: existing
+clients that never heard of priorities keep first-class service."""
+
+DEFAULT_PRIORITY = "interactive"
+
+
+def priority_rank(priority: str) -> int:
+    """Queue-ordering rank (lower schedules first)."""
+    return PRIORITIES.index(priority)
+
+
+def validate_priority(priority: Optional[str]) -> str:
+    """Normalize/validate a wire-level priority field (None = default)."""
+    if priority is None:
+        return DEFAULT_PRIORITY
+    if priority not in PRIORITIES:
+        raise ValueError(f"unknown priority {priority!r} (know {PRIORITIES})")
+    return priority
+
+
+class RateEstimator:
+    """EWMA of observed token throughput (tokens/s).
+
+    ``observe(n)`` is called once per executed batch with the tokens it
+    committed; the instantaneous rate is ``n / dt`` against the previous
+    observation. ``rate`` is None until ``min_samples`` observations have
+    landed — callers treat a cold estimator as "cannot prove anything"
+    (admission control admits, shedding stands down).
+    """
+
+    def __init__(self, alpha: float = 0.25, min_samples: int = 4):
+        self._alpha = alpha
+        self._min_samples = min_samples
+        self._ewma: Optional[float] = None
+        self._samples = 0
+        self._last_s: Optional[float] = None
+
+    def observe(self, n_tokens: int, now: Optional[float] = None) -> None:
+        if n_tokens <= 0:
+            return
+        now = time.monotonic() if now is None else now
+        if self._last_s is None:
+            self._last_s = now
+            return  # first batch: no interval yet
+        dt = now - self._last_s
+        self._last_s = now
+        if dt <= 0:
+            return
+        inst = n_tokens / dt
+        self._ewma = (inst if self._ewma is None
+                      else (1 - self._alpha) * self._ewma + self._alpha * inst)
+        self._samples += 1
+
+    @property
+    def warm(self) -> bool:
+        return self._ewma is not None and self._samples >= self._min_samples
+
+    @property
+    def rate(self) -> Optional[float]:
+        """Tokens/s, or None while cold."""
+        return self._ewma if self.warm else None
+
+    def seconds_for(self, n_tokens: int) -> Optional[float]:
+        """Estimated wall seconds to commit ``n_tokens``; None while cold."""
+        rate = self.rate
+        if rate is None or rate <= 0:
+            return None
+        return n_tokens / rate
+
+
+class BrownoutController:
+    """Staged degradation driven by a smoothed pressure signal.
+
+    ``update(pressure)`` feeds one raw pressure sample in [0, 1] (the
+    scheduler uses ``max(queue_fraction, kv_occupancy)``), smooths it with an
+    EWMA, and maps it to a stage: the highest ``thresholds`` index the
+    smoothed signal clears, +1. Hysteresis: a stage entered at ``t`` is only
+    left when the signal falls below ``t - hysteresis``, so boundary noise
+    cannot flap service modes.
+    """
+
+    def __init__(self, thresholds: Sequence[float] = (0.65, 0.85, 0.95),
+                 hysteresis: float = 0.1, alpha: float = 0.3):
+        if list(thresholds) != sorted(thresholds):
+            raise ValueError(f"brownout thresholds must be ascending: {thresholds}")
+        self._thresholds = tuple(thresholds)
+        self._hysteresis = hysteresis
+        self._alpha = alpha
+        self._smoothed = 0.0
+        self._stage = 0
+        self.transitions = 0
+
+    @property
+    def stage(self) -> int:
+        return self._stage
+
+    @property
+    def pressure(self) -> float:
+        """The smoothed pressure signal (the stage driver)."""
+        return self._smoothed
+
+    @property
+    def max_stage(self) -> int:
+        return len(self._thresholds)
+
+    def update(self, pressure: float) -> int:
+        """Feed one raw pressure sample; returns the (possibly new) stage."""
+        pressure = min(1.0, max(0.0, float(pressure)))
+        self._smoothed = ((1 - self._alpha) * self._smoothed
+                          + self._alpha * pressure)
+        # escalate to the highest threshold cleared...
+        stage = 0
+        for i, t in enumerate(self._thresholds):
+            if self._smoothed >= t:
+                stage = i + 1
+        # ...but de-escalate only past the hysteresis band of the CURRENT
+        # stage's entry threshold (one band per stage: a signal hovering at a
+        # boundary holds the stage instead of flapping)
+        if stage < self._stage:
+            hold = self._thresholds[self._stage - 1] - self._hysteresis
+            if self._smoothed >= hold:
+                stage = self._stage
+        if stage != self._stage:
+            self._stage = stage
+            self.transitions += 1
+        return self._stage

@@ -27,6 +27,7 @@ from typing import Iterator, List, Optional
 
 import numpy as np
 
+from deepspeed_tpu.serving.overload import DEFAULT_PRIORITY, validate_priority
 from deepspeed_tpu.telemetry import now_us
 
 
@@ -101,7 +102,8 @@ class Request:
                  temperature: float = 0.0,
                  eos_token_id: Optional[int] = None,
                  deadline_s: Optional[float] = None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 priority: str = DEFAULT_PRIORITY):
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("prompt must contain at least one token")
@@ -113,6 +115,7 @@ class Request:
         self.eos_token_id = eos_token_id
         self.deadline_s = deadline_s
         self.seed = int(seed)
+        self.priority = validate_priority(priority)
 
         self.uid: Optional[int] = None  # assigned at admission by the scheduler
         # distributed-tracing identity: the scheduler assigns both when a
@@ -143,6 +146,14 @@ class Request:
         self.stream = TokenStream()
         self.error: Optional[str] = None
         self.finish_reason: Optional[str] = None  # "eos" | "length" | "context"
+        # overload control (serving/overload.py): shed_reason marks a request
+        # dropped before any engine work (admission estimate or queue shed);
+        # retry_after_s rides the 429/SSE error so clients back off
+        # proportionally; degraded_mode lists every brownout degradation
+        # applied (clamped budget, disabled speculation) — never silent
+        self.shed_reason: Optional[str] = None
+        self.retry_after_s: Optional[float] = None
+        self.degraded_mode: List[str] = []
 
         self.arrival_s = time.monotonic()
         self.arrival_us = now_us()  # span-clock arrival (perf_counter domain)

@@ -39,6 +39,8 @@ from deepspeed_tpu import telemetry
 from deepspeed_tpu.inference.v2.scheduling_utils import SchedulingError, SchedulingResult
 from deepspeed_tpu.serving.config import ServingConfig
 from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.overload import (BrownoutController, RateEstimator,
+                                            priority_rank, validate_priority)
 from deepspeed_tpu.serving.request import Request, RequestState
 from deepspeed_tpu.telemetry import new_span_id, new_trace_id, now_us
 from deepspeed_tpu.telemetry.flight_recorder import SERVING_SCHEDULER_CHANNEL
@@ -66,6 +68,17 @@ class QueueFullError(RuntimeError):
 
 class SchedulerStopped(RuntimeError):
     """submit() after stop(): the scheduler no longer admits requests."""
+
+
+class AdmissionRejected(RuntimeError):
+    """Overload control refused the request at submission — the deadline is
+    provably unmeetable at the measured rate, or the brownout stage rejects
+    its priority class. ``retry_after_s`` is the queue-drain-derived backoff
+    the HTTP layer surfaces as a ``Retry-After`` header (429)."""
+
+    def __init__(self, message: str, retry_after_s: float):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 class ServingScheduler:
@@ -100,7 +113,9 @@ class ServingScheduler:
         self._counters = {k: 0 for k in
                           ("submitted", "rejected", "completed", "cancelled",
                            "timed_out", "failed", "evictions", "batches", "heartbeats",
-                           "prefix_hits", "prefix_tokens_saved", "prefix_evictions")}
+                           "prefix_hits", "prefix_tokens_saved", "prefix_evictions",
+                           "shed_admission", "shed_queue", "brownout_rejected",
+                           "brownout_clamped")}
         self._stopping = False   # no new submits
         self._shutdown = False   # thread exit
         self._stopped = False
@@ -113,6 +128,20 @@ class ServingScheduler:
         # pool capacity for permanent-infeasibility checks (a prompt needing
         # more KV blocks than the whole pool can never run)
         self._capacity_blocks = engine._state_manager.kv_cache.num_blocks
+
+        # overload control (serving/overload.py): the measured-rate estimator
+        # feeds admission feasibility + Retry-After; the brownout controller
+        # maps smoothed pressure to staged degradation. Both exist even when
+        # disabled (stage stays 0, estimator unread) so the hot path is one
+        # boolean, not a None check per site.
+        ocfg = self._config.overload
+        self._rate = RateEstimator(alpha=ocfg.rate_alpha,
+                                   min_samples=ocfg.min_rate_samples)
+        self._brownout = BrownoutController(
+            thresholds=ocfg.brownout_stage_thresholds,
+            hysteresis=ocfg.brownout_hysteresis,
+            alpha=ocfg.pressure_alpha)
+        self._brownout_transitions_seen = 0
 
         # automatic prefix caching: radix-tree KV reuse with copy-on-write
         # block sharing (inference/v2/ragged/prefix_cache.py). All trie
@@ -173,12 +202,16 @@ class ServingScheduler:
                seed: int = 0,
                trace_id: Optional[str] = None,
                parent_span_id: Optional[int] = None,
-               handoff: bool = False) -> Request:
+               handoff: bool = False,
+               priority: Optional[str] = None) -> Request:
         """Enqueue a generation request (any thread). Returns the live
         :class:`Request`; stream tokens from ``request.stream`` or block on
         ``request.result()``. Backpressure per ``config.backpressure``:
         ``reject`` raises :class:`QueueFullError`, ``block`` stalls until the
-        queue has room.
+        queue has room. With overload control enabled, a brownout stage-3
+        batch-class request or a provably-unmeetable deadline raises
+        :class:`AdmissionRejected` (HTTP 429 + ``Retry-After``) instead of
+        being admitted to fail later.
 
         ``trace_id``/``parent_span_id`` adopt an upstream trace (the fleet
         router's) instead of minting a fresh one, so router → replica shows as
@@ -193,7 +226,9 @@ class ServingScheduler:
                       eos_token_id=eos_token_id,
                       deadline_s=deadline_s if deadline_s is not None
                       else self._config.default_deadline_s,
-                      seed=seed)
+                      seed=seed,
+                      priority=validate_priority(priority))
+        self._admission_gate(req)
         return self._enqueue(req, trace_id, parent_span_id, handoff)
 
     def submit_resume(self,
@@ -205,7 +240,8 @@ class ServingScheduler:
                       seed: int = 0,
                       trace_id: Optional[str] = None,
                       parent_span_id: Optional[int] = None,
-                      handoff: bool = False) -> Request:
+                      handoff: bool = False,
+                      priority: Optional[str] = None) -> Request:
         """Admit a handed-off sequence for decode continuation: ``payload`` is
         an ``engine.export_sequence`` product from a prefill-role peer. The
         scheduler imports it into its engine at admission (on the scheduler
@@ -232,9 +268,12 @@ class ServingScheduler:
                       eos_token_id=eos_token_id,
                       deadline_s=deadline_s if deadline_s is not None
                       else self._config.default_deadline_s,
-                      seed=seed)
+                      seed=seed,
+                      priority=validate_priority(priority))
         req._resume_payload = payload
         req._resume_header = header
+        self._admission_gate(req)  # after the header lands: resume work is
+        # its generation budget only, the donor already paid the prefill
         req._resume_kv = kv  # zero-copy view into payload; parsed exactly once
         req._next = int(extra["next_token"])
         rng_state = extra.get("rng_state")
@@ -280,16 +319,195 @@ class ServingScheduler:
         blocks on the next tick (``Request.cancel()`` is equivalent)."""
         request.cancel()
 
+    # ---------------------------------------------------------- overload --
+    @staticmethod
+    def _request_work(req: Request) -> int:
+        """Engine-token work this request still needs: unfed prompt tokens
+        plus its remaining generation budget (a resume request's prompt was
+        prefilled by the donor)."""
+        if req._resume_header is not None:
+            return max(0, req.max_new_tokens - len(req.tokens))
+        return (max(0, int(req.prompt.size) - req._fed)
+                + max(0, req.max_new_tokens - len(req.tokens)))
+
+    def _active_work_tokens(self) -> int:
+        """Outstanding work already admitted into the engine (active plus the
+        one mid-admission request)."""
+        work = sum(self._request_work(r) for r in list(self._active.values()))
+        admitting = self._admitting
+        if admitting is not None:
+            work += self._request_work(admitting)
+        return work
+
+    def _outstanding_work_tokens(self) -> int:
+        """Everything committed or queued, in engine tokens — the numerator
+        of every queue-wait / Retry-After estimate."""
+        with self._not_full:
+            queued = list(self._queue)
+        return self._active_work_tokens() + sum(self._request_work(r)
+                                                for r in queued)
+
+    def retry_after_s(self) -> float:
+        """Client backoff derived from the measured drain rate: how long the
+        currently-committed-plus-queued work takes at the observed token
+        rate, bounded by the configured floor/cap. Cold estimator: the floor
+        scaled by queue depth (some signal beats none)."""
+        ocfg = self._config.overload
+        est = self._rate.seconds_for(self._outstanding_work_tokens())
+        if est is None:
+            est = ocfg.retry_after_floor_s * (1 + self.queue_depth)
+        return min(ocfg.retry_after_cap_s, max(ocfg.retry_after_floor_s, est))
+
+    def _admission_gate(self, req: Request) -> None:
+        """submit()-time overload gate (any thread): brownout stage actions
+        for the batch class, then the deadline-feasibility estimate. Raises
+        :class:`AdmissionRejected` — failing here is cheap; admitting a
+        provably-doomed request wastes prefill work and queue capacity."""
+        ocfg = self._config.overload
+        if not ocfg.enabled:
+            return
+        stage = self._brownout.stage
+        if stage >= 1 and req.priority == "batch":
+            if stage >= self._brownout.max_stage:
+                self._counters["brownout_rejected"] += 1
+                if self._metrics:
+                    self._metrics.brownout_rejections.inc()
+                raise AdmissionRejected(
+                    f"brownout stage {stage}: batch-class requests are "
+                    f"rejected under overload", retry_after_s=self.retry_after_s())
+            if req.max_new_tokens > ocfg.brownout_clamp_max_new_tokens:
+                req.max_new_tokens = ocfg.brownout_clamp_max_new_tokens
+                req.degraded_mode.append("max_new_tokens_clamped")
+                self._counters["brownout_clamped"] += 1
+                if self._metrics:
+                    self._metrics.brownout_clamped.inc()
+        if stage >= 2 and self._config.decode_chunk > 1:
+            # the speculative decode chunk is globally off at stage >= 2;
+            # flagged per affected request so no degradation is silent
+            req.degraded_mode.append("speculative_disabled")
+        if ocfg.admission_control and req.deadline_s is not None:
+            own = self._request_work(req)
+            est = self._rate.seconds_for(self._outstanding_work_tokens() + own)
+            if est is not None and est > req.deadline_s * ocfg.admission_margin:
+                self._counters["shed_admission"] += 1
+                if self._metrics:
+                    self._metrics.shed_admission.inc()
+                raise AdmissionRejected(
+                    f"deadline unmeetable at admission: estimated completion "
+                    f"{est:.2f}s > deadline {req.deadline_s:.2f}s at the "
+                    f"measured rate", retry_after_s=self.retry_after_s())
+
+    def _queue_order_key(self, req: Request):
+        return (priority_rank(req.priority),
+                req.deadline if req.deadline is not None else float("inf"),
+                req.arrival_s)
+
+    def _pop_next_locked(self) -> Request:
+        """Next request to admit (caller holds the queue lock): FIFO without
+        overload control; (priority, deadline, arrival) order with it."""
+        ocfg = self._config.overload
+        if not (ocfg.enabled and ocfg.priority_ordering):
+            return self._queue.popleft()
+        best = min(self._queue, key=self._queue_order_key)
+        self._queue.remove(best)
+        return best
+
+    def _pop_shed_reason(self, req: Request, now: float) -> Optional[str]:
+        """Cheap per-request feasibility re-check at admission pop: the
+        estimate may have collapsed since submit() (a stalled engine, a
+        burst admitted ahead). A reason string fails the request *before*
+        it consumes any engine work; None admits."""
+        ocfg = self._config.overload
+        if (not ocfg.enabled or not ocfg.admission_control
+                or req.deadline is None):
+            return None
+        est = self._rate.seconds_for(self._active_work_tokens()
+                                     + self._request_work(req))
+        remaining = req.deadline - now
+        if est is not None and est > max(0.0, remaining) * ocfg.admission_margin:
+            return (f"deadline unmeetable at admission (est {est:.2f}s, "
+                    f"{remaining:.2f}s remaining)")
+        return None
+
+    def _overload_tick(self, now: float) -> None:
+        """Per-tick pressure sampling -> brownout stage -> queue shedding."""
+        with self._not_full:
+            depth = len(self._queue)
+        kv_occupancy = (1.0 - self._engine.free_blocks / self._capacity_blocks
+                        if self._capacity_blocks else 0.0)
+        stage = self._brownout.update(max(depth / self._config.queue_capacity,
+                                          kv_occupancy))
+        if self._brownout.transitions != self._brownout_transitions_seen:
+            delta = self._brownout.transitions - self._brownout_transitions_seen
+            self._brownout_transitions_seen = self._brownout.transitions
+            logger.warning(f"serving: brownout stage -> {stage} "
+                           f"(pressure {self._brownout.pressure:.2f})")
+            if self._metrics:
+                self._metrics.brownout_transitions.inc(delta)
+                self._metrics.brownout_stage.set(stage)
+        if stage >= 1 and self._config.overload.shed_enabled:
+            self._shed_queued(now)
+
+    def _shed_queued(self, now: float) -> None:
+        """Under sustained pressure, shed queued requests whose deadline is
+        provably unmeetable at the measured rate — before they waste a
+        prefill. The feasibility walk runs in scheduling order (work ahead of
+        a request is work that WILL run first); the doomed are shed lowest
+        priority / latest deadline first."""
+        rate = self._rate.rate
+        if rate is None or rate <= 0:
+            return  # cannot prove anything on a cold estimator
+        with self._not_full:
+            queued = list(self._queue)
+        if not queued:
+            return
+        margin = self._config.overload.admission_margin
+        acc = self._active_work_tokens()
+        doomed = []
+        for req in sorted(queued, key=self._queue_order_key):
+            own = self._request_work(req)
+            if req.deadline is not None and \
+                    (acc + own) / rate > max(0.0, req.deadline - now) * margin:
+                doomed.append(req)
+                continue  # its work will never run; don't charge the others
+            acc += own
+        doomed.sort(key=lambda r: (-priority_rank(r.priority),
+                                   -(r.deadline - now)))
+        # one drain-rate estimate for the whole pass: retry_after_s() walks
+        # active + queued under the queue lock, and the estimate cannot
+        # meaningfully change between two sheds of the same tick
+        retry_after = self.retry_after_s() if doomed else None
+        for req in doomed:
+            with self._not_full:
+                try:
+                    self._queue.remove(req)
+                except ValueError:
+                    continue  # raced into admission
+                self._not_full.notify()
+            req.shed_reason = ("queue shed under overload: deadline provably "
+                              "unmeetable")
+            req.retry_after_s = retry_after
+            self._counters["shed_queue"] += 1
+            if self._metrics:
+                self._metrics.shed_queue.inc()
+            self._finalize(req, RequestState.FAILED,
+                           error=f"shed: {req.shed_reason}")
+
     # ------------------------------------------------------------------ tick --
     def step(self) -> bool:
         """One scheduling iteration; returns True iff a batch executed.
         Runs on the scheduler thread — or inline when ``start=False``."""
         now = time.monotonic()
         for req in list(self._active.values()):
+            # the deadline check doubles as the decode feed-stop: a request
+            # past its deadline is finalized HERE, before batch building, so
+            # it never receives another decode step
             if req.cancel_requested:
                 self._finalize(req, RequestState.CANCELLED)
             elif req.deadline is not None and now > req.deadline:
                 self._finalize(req, RequestState.TIMED_OUT)
+        if self._config.overload.enabled:
+            self._overload_tick(now)
         self._admit(now)
         plan = self._build_batch()
         if not plan:
@@ -319,7 +537,7 @@ class ServingScheduler:
             with self._not_full:
                 if not self._queue or len(self._active) >= max_active:
                     break
-                req = self._queue.popleft()
+                req = self._pop_next_locked()
                 self._admitting = req  # visible to _has_work/load while popped
                 self._not_full.notify()
             try:
@@ -327,7 +545,21 @@ class ServingScheduler:
                     self._finalize(req, RequestState.CANCELLED)
                     continue
                 if req.deadline is not None and now > req.deadline:
+                    if self._config.overload.enabled:
+                        # deadline-failed while queued = rejected at
+                        # admission: zero engine work was spent, so the
+                        # client gets the same Retry-After contract as a shed
+                        req.retry_after_s = self.retry_after_s()
                     self._finalize(req, RequestState.TIMED_OUT)
+                    continue
+                shed = self._pop_shed_reason(req, now)
+                if shed is not None:
+                    req.shed_reason = shed
+                    req.retry_after_s = self.retry_after_s()
+                    self._counters["shed_admission"] += 1
+                    if self._metrics:
+                        self._metrics.shed_admission.inc()
+                    self._finalize(req, RequestState.FAILED, error=f"shed: {shed}")
                     continue
                 infeasible = self._permanently_infeasible(req)
                 if infeasible:
@@ -657,6 +889,8 @@ class ServingScheduler:
                                    "tokens": ntok if counts is None else counts[i]})
 
         K = self._config.decode_chunk
+        if K > 1 and self._config.overload.enabled and self._brownout.stage >= 2:
+            K = 1  # brownout stage >= 2: speculative extras disabled
         max_context = self._engine._config.state_manager.max_context
 
         def chunk_safe(req):
@@ -682,6 +916,7 @@ class ServingScheduler:
                 # the push loop, so trace and stream cannot disagree
                 counts = [self._kept_tokens(req, row)
                           for (req, _), row in zip(plan, rows)]
+                self._rate.observe(sum(counts))
                 _record_phase_spans(counts=counts)
                 for (req, _), row, kept in zip(plan, rows, counts):
                     prev = req._last_token_s
@@ -710,6 +945,7 @@ class ServingScheduler:
             for req, _ in plan:
                 self._finalize(req, RequestState.FAILED, error=f"engine error: {e}")
             return
+        self._rate.observe(sum(int(t.size) for t in tokens))
         _record_phase_spans()
         for i, (req, toks) in enumerate(plan):
             if req.state is RequestState.PREFILL:
@@ -1016,6 +1252,7 @@ class ServingScheduler:
         return {
             "uid": req.uid,
             "state": req.state.name,
+            "priority": req.priority,
             "prompt_tokens": int(req.prompt.size),
             "cached_tokens": req.cached_tokens,
             "generated": len(req.tokens),
@@ -1060,6 +1297,13 @@ class ServingScheduler:
             },
             "prefix_cache": (self._prefix_cache.stats()
                              if self._prefix_cache is not None else None),
+            "overload": {
+                "enabled": self._config.overload.enabled,
+                "brownout_stage": self._brownout.stage,
+                "pressure": round(self._brownout.pressure, 4),
+                "rate_tokens_per_s": self._rate.rate,
+                "retry_after_s": round(self.retry_after_s(), 3),
+            },
             "draining": self._stopping,
             "uptime_s": time.monotonic() - self._start_s,
         }

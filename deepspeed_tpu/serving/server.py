@@ -50,6 +50,7 @@ then the listener shuts down.
 
 import base64
 import json
+import math
 import queue
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -57,9 +58,10 @@ from typing import Optional
 
 from deepspeed_tpu.serving.config import (DEFAULT_MAX_RESUME_BODY_BYTES,
                                           ServingConfig)
+from deepspeed_tpu.serving.overload import validate_priority
 from deepspeed_tpu.serving.request import Request
-from deepspeed_tpu.serving.scheduler import (QueueFullError, SchedulerStopped,
-                                             ServingScheduler)
+from deepspeed_tpu.serving.scheduler import (AdmissionRejected, QueueFullError,
+                                             SchedulerStopped, ServingScheduler)
 from deepspeed_tpu.utils.logging import logger
 
 _MAX_BODY_BYTES = 8 << 20  # an 8 MiB prompt is already ~2M tokens of JSON
@@ -73,6 +75,23 @@ TRACE_HEADER = "X-DSTPU-Trace-Id"
 # the fleet router's span id: a replica's request root parents under it so
 # router → prefill replica → decode replica renders as ONE Perfetto track
 PARENT_SPAN_HEADER = "X-DSTPU-Parent-Span"
+# priority class (interactive | batch) — header form; the JSON body's
+# "priority" field wins when both are present
+PRIORITY_HEADER = "X-DSTPU-Priority"
+
+
+def request_priority(handler, doc: dict) -> Optional[str]:
+    """The request's priority class from the JSON ``priority`` field (wins)
+    or the ``X-DSTPU-Priority`` header; None = scheduler default. Raises
+    ``ValueError`` on an unknown class (callers answer 400)."""
+    raw = doc.get("priority") or handler.headers.get(PRIORITY_HEADER) or None
+    return validate_priority(raw) if raw is not None else None
+
+
+def retry_after_header(seconds: float) -> str:
+    """HTTP ``Retry-After`` is integer seconds; round up so a client never
+    retries before the estimate says there is room."""
+    return str(max(1, math.ceil(seconds)))
 
 
 def parse_request_body(handler, resume: bool, max_bytes: Optional[int] = None) -> dict:
@@ -113,7 +132,15 @@ def _request_doc(req: Request, raw_handoff: bool = False) -> dict:
         "ttft_s": req.ttft_s,
         "e2e_s": req.e2e_s,
         "trace_id": req.trace_id,
+        "priority": req.priority,
     }
+    if req.degraded_mode:
+        # brownout degradations applied to THIS request — never silent
+        doc["degraded_mode"] = list(req.degraded_mode)
+    if req.retry_after_s is not None:
+        # shed disposition: the queue-drain-derived backoff rides the final
+        # doc (and the SSE done/error event) so streaming clients see it too
+        doc["retry_after_s"] = req.retry_after_s
     if req.handoff_payload is not None:
         # fleet prefill→decode handoff: the exported KV/generation state, for
         # POST /v1/resume on a decode-role peer. Bytes ride JSON as base64;
@@ -158,13 +185,17 @@ class ServingServer:
 
         class Handler(BaseHTTPRequestHandler):
 
-            def _send_json(self, code, doc, trace_id=None):
+            def _send_json(self, code, doc, trace_id=None, retry_after=None):
                 data = json.dumps(doc).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 if trace_id is not None:
                     self.send_header(TRACE_HEADER, trace_id)
+                if retry_after is not None:
+                    # drain-rate-derived backoff: well-behaved clients retry
+                    # proportionally instead of hammering a saturated server
+                    self.send_header("Retry-After", retry_after_header(retry_after))
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -204,7 +235,8 @@ class ServingServer:
                     self._send_json(404, {"error": f"no route {path}"})
                     return
                 if draining.is_set():
-                    self._send_json(503, {"error": "server is draining"})
+                    self._send_json(503, {"error": "server is draining"},
+                                    retry_after=scheduler.retry_after_s())
                     return
                 trace_id, parent_span_id = self._upstream_trace()
                 resume = path == "/v1/resume"
@@ -225,17 +257,27 @@ class ServingServer:
                                   seed=int(doc.get("seed") or 0),
                                   trace_id=trace_id,
                                   parent_span_id=parent_span_id,
-                                  handoff=bool(doc.get("handoff")))
+                                  handoff=bool(doc.get("handoff")),
+                                  priority=request_priority(self, doc))
                     if path == "/v1/resume":
                         req = scheduler.submit_resume(doc["payload"], **common)
                     else:
                         req = scheduler.submit(doc["prompt"], **common)
+                except AdmissionRejected as e:
+                    # overload control said no before any engine work: the
+                    # cheap rejection, with the drain-rate-derived backoff
+                    self._send_json(429, {"error": str(e),
+                                          "retry_after_s": e.retry_after_s},
+                                    retry_after=e.retry_after_s)
+                    return
                 except QueueFullError as e:
                     self._send_json(429, {"error": str(e),
-                                          "queue_depth": scheduler.queue_depth})
+                                          "queue_depth": scheduler.queue_depth},
+                                    retry_after=scheduler.retry_after_s())
                     return
                 except SchedulerStopped as e:
-                    self._send_json(503, {"error": str(e)})
+                    self._send_json(503, {"error": str(e)},
+                                    retry_after=scheduler.retry_after_s())
                     return
                 except (ValueError, TypeError) as e:
                     # wrongly-typed optional fields (null temperature, string
@@ -246,7 +288,16 @@ class ServingServer:
                     self._stream_sse(req)
                 else:
                     req.wait()  # terminal by deadline/max_new_tokens/cancel
-                    self._send_json(200, _request_doc(req), trace_id=req.trace_id)
+                    if req.shed_reason is not None or (
+                            req.retry_after_s is not None and not req.tokens):
+                        # shed (or deadline-expired) before any engine work:
+                        # to the client this IS an admission rejection — 429
+                        self._send_json(429, _request_doc(req),
+                                        trace_id=req.trace_id,
+                                        retry_after=req.retry_after_s)
+                    else:
+                        self._send_json(200, _request_doc(req),
+                                        trace_id=req.trace_id)
 
             def _stream_sse(self, req):
                 self.send_response(200)

@@ -74,6 +74,13 @@ METRIC_FAMILIES = {
     "serving_prefix_tokens_saved_total": "prompt tokens served from cached KV instead of prefilled",
     "serving_prefix_trie_blocks": "device KV blocks pinned by the prefix trie",
     "serving_prefix_evictions_total": "prefix-trie leaves evicted (LRU) under KV pressure or the trie cap",
+    # overload control (serving/metrics.py over serving/overload.py)
+    "serving_shed_admission_total": "requests rejected at admission: deadline provably unmeetable",
+    "serving_shed_queue_total": "queued requests shed under sustained overload pressure",
+    "serving_brownout_stage": "current brownout degradation stage (0 = normal service)",
+    "serving_brownout_transitions_total": "brownout stage changes (hysteresis-smoothed)",
+    "serving_brownout_clamped_total": "batch-class requests whose max_new_tokens was brownout-clamped",
+    "serving_brownout_rejections_total": "batch-class requests rejected outright at brownout stage 3",
     # compile watch (telemetry/compile_watch.py)
     "compile_cache_misses_total": "XLA backend compiles (jit cache misses), by site",
     "compile_seconds_total": "cumulative XLA compile wall seconds, by site",
@@ -113,4 +120,15 @@ METRIC_FAMILIES = {
     "fleet_restart_quarantines_total": "supervised replicas quarantined after crash-looping",
     "fleet_degraded_requests_total": "requests served monolithically with a disaggregated pool dark",
     "fleet_faults_injected_total": "faults injected by the chaos harness",
+    # overload control (fleet/global_queue.py, fleet/router.py hedging)
+    "fleet_global_queue_depth": "requests (and chaos phantoms) waiting in the router global queue",
+    "fleet_global_queue_wait_seconds": "queue wait from router admission to replica grant",
+    "fleet_global_queue_grants_total": "pull-dispatch grants (a replica slot freed and took work)",
+    "fleet_global_queue_expired_total": "entries shed at the queue: admission estimate or deadline/wait expiry",
+    "fleet_hedge_dispatches_total": "hedge legs dispatched after a first-token budget expiry",
+    "fleet_hedge_wins_total": "hedged requests where the hedge leg produced the stream",
+    "fleet_hedge_cancellations_total": "hedge losers cancelled first-writer-wins (KV freed)",
+    "fleet_hedge_slow_demotions_total": "dispatch picks where a slow replica (TTFT EWMA) was demoted",
+    "fleet_deadline_stream_cuts_total": "streams cut at the router because the deadline passed mid-decode",
+    "fleet_hedge_suppressed_total": "hedges suppressed by the storm brake (no evidence, bucket dry)",
 }

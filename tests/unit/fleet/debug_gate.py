@@ -1,0 +1,175 @@
+"""Diagnostic replica of the flagship gate — NOT part of the suite.
+
+Prints the full per-request/per-replica picture the real gate asserts on
+(grant pattern, hedge/suppression counters, scheduler counters, demotion
+EWMAs, XLA compiles inside the measured window) — the tool that found the
+cold-bucket compile storms and the queue-starvation modes during PR 14.
+
+Run: DSTPU_DEBUG_GATE=1 JAX_PLATFORMS=cpu \\
+    python -m pytest tests/unit/fleet/debug_gate.py -q -s
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("DSTPU_DEBUG_GATE"),
+    reason="diagnostic tool (set DSTPU_DEBUG_GATE=1), not part of the suite")
+
+from deepspeed_tpu.fleet import (FaultConfig, FleetRouter, HedgeConfig,
+                                 RoutingError)
+from deepspeed_tpu.fleet.config import GlobalQueueConfig
+from deepspeed_tpu.serving.config import OverloadConfig
+
+from .test_overload import (GATE_ENGINE_KW, _arm_config, _fleet_config,
+                            _open_loop, _prompt, _quiesce, _stall_config,
+                            _warm_fleet)
+
+
+def _open_loop_dbg(router, n, rate, deadline_s, seed):
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n))
+    outcomes = []
+    lock = threading.Lock()
+    t0 = time.monotonic()
+
+    def one(i, at):
+        delay = at - (time.monotonic() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        doc = {"prompt": _prompt(8), "max_new_tokens": 4, "temperature": 0.0,
+               "seed": i, "deadline_s": deadline_s,
+               "priority": "interactive" if i % 2 == 0 else "batch"}
+        s0 = time.monotonic()
+        out = {"i": i, "priority": doc["priority"], "tokens": 0}
+        try:
+            routed = router.route(doc)
+            for _tok in routed.tokens():
+                out["tokens"] += 1
+            final = dict(routed.result())
+            out["state"] = final["state"]
+            out["legs"] = [m["replica"] for m in final.get("legs", [])]
+            out["hedged"] = routed._hedged
+        except RoutingError as e:
+            out["state"] = f"rejected:{e.status}"
+            out["legs"] = []
+            out["hedged"] = False
+        except Exception as e:
+            out["state"] = f"error:{type(e).__name__}: {e}"
+            out["legs"] = []
+            out["hedged"] = False
+        out["e2e_s"] = time.monotonic() - s0
+        out["done_at"] = time.monotonic() - t0
+        with lock:
+            outcomes.append(out)
+
+    threads = [threading.Thread(target=one, args=(i, at), daemon=True)
+               for i, at in enumerate(arrivals)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+        assert not t.is_alive()
+    return outcomes, time.monotonic() - t0
+
+
+@pytest.mark.parametrize("overload_on", [True, False])
+def test_debug_gate(make_fleet, overload_on):
+    cap_mgr = make_fleet(roles=("mixed",), **GATE_ENGINE_KW)
+    _warm_fleet(cap_mgr)
+    cap_router = FleetRouter(cap_mgr)
+    warm = cap_router.route({"prompt": _prompt(8), "max_new_tokens": 4}).result()
+    assert warm["state"] == "DONE"
+    e2es = []
+
+    def closed(i):
+        s0 = time.monotonic()
+        final = cap_router.route({"prompt": _prompt(8), "max_new_tokens": 4,
+                                  "temperature": 0.0, "seed": i}).result()
+        assert final["state"] == "DONE"
+        e2es.append(time.monotonic() - s0)
+
+    for measured in (False, True):
+        e2es.clear()
+        t0 = time.monotonic()
+        workers = [threading.Thread(target=lambda w=w: [closed(w * 8 + j)
+                                                        for j in range(8)],
+                                    daemon=True) for w in range(2)]
+        for t in workers:
+            t.start()
+        for t in workers:
+            t.join(timeout=600)
+        wall = time.monotonic() - t0
+    capacity = 16 / wall
+    p50_e2e = float(np.percentile(np.asarray(e2es), 50))
+    deadline_s = max(2.0, 8 * p50_e2e)
+    offered = 3.0 * capacity
+    horizon_s = 48 / offered + deadline_s
+    base_outcomes, _ = _open_loop(cap_router, n=48, rate=offered,
+                                  deadline_s=deadline_s, seed=77)
+    base_ok = sum(1 for o in base_outcomes
+                  if o["state"] == "DONE" and o["e2e_s"] <= deadline_s)
+    capacity_goodput = base_ok / horizon_s
+    print(f"\n=== capacity {capacity:.2f} req/s  p50 {p50_e2e*1e3:.0f}ms  "
+          f"deadline {deadline_s:.2f}s  offered {offered:.2f} req/s "
+          f"baseline {base_ok}/48 on-deadline -> {capacity_goodput:.2f} req/s "
+          f"horizon {horizon_s:.2f}s overload_on={overload_on}")
+
+    compiles = []
+    import jax.monitoring as jm
+    t_mark = [time.monotonic()]
+    jm.register_event_duration_secs_listener(
+        lambda e, d, **kw: compiles.append(
+            (round(time.monotonic() - t_mark[0], 2), round(d, 3)))
+        if "backend_compile" in e else None)
+
+    stall = _stall_config("r0", stall_s=2.0, min_first=0.0)
+    manager = make_fleet(roles=(), config=_arm_config(overload_on),
+                         **GATE_ENGINE_KW)
+    for rid in ("r0", "r1", "r2"):
+        manager.add_local(role="mixed", replica_id=rid)
+    _warm_fleet(manager)
+    router = FleetRouter(manager)
+    _open_loop(router, n=24, rate=offered, deadline_s=30.0, seed=7)
+    _quiesce(manager)
+    router.set_faults(FaultConfig(**stall.model_dump()))
+    pre = len(compiles)
+    t_mark[0] = time.monotonic()
+    outcomes, arm_wall = _open_loop_dbg(router, n=48, rate=offered,
+                                        deadline_s=deadline_s, seed=77)
+    print(f"compiles during measurement: {len(compiles) - pre} "
+          f"(at,dur)={compiles[pre:][:20]}")
+    router.set_faults(None)
+
+    on_deadline = [o for o in outcomes
+                   if o["state"] == "DONE" and o["e2e_s"] <= deadline_s]
+    from collections import Counter
+    states = Counter(o["state"] for o in outcomes)
+    late = [o for o in outcomes if o["state"] == "DONE" and o["e2e_s"] > deadline_s]
+    r0 = [o for o in outcomes if "r0" in o.get("legs", [])]
+    print(f"wall {arm_wall:.2f}s  goodput {len(on_deadline)/horizon_s:.2f}  "
+          f"floor {0.85*capacity_goodput:.2f}")
+    print(f"states: {dict(states)}")
+    print(f"on_deadline={len(on_deadline)} late_done={len(late)} "
+          f"hedged={sum(1 for o in outcomes if o['hedged'])} "
+          f"touched_r0={len(r0)}")
+    for o in sorted(outcomes, key=lambda o: -o["e2e_s"])[:12]:
+        print(f"  i={o['i']:>2} {o['priority'][:5]:>5} {o['state'][:24]:<24} "
+              f"e2e={o['e2e_s']:.2f} done_at={o['done_at']:.2f} "
+              f"tok={o['tokens']} legs={o.get('legs')} hedged={o['hedged']}")
+    print(f"router counters: {router._counters}")
+    try:
+        print(f"gq: {router._gq.describe() if router._gq else None}")
+    except Exception:
+        pass
+    for r in manager.replicas():
+        sched = r.scheduler
+        c = {k: v for k, v in sched._counters.items() if v}
+        print(f"  {r.id}: counters={c}")
+        print(f"      overload={sched.stats()['overload']} "
+              f"ttft={r.ttft_ewma_s} itl={r.itl_ewma_s} "
+              f"samples=({r.ttft_samples},{r.itl_samples})")
+    _quiesce(manager)

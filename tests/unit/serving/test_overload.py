@@ -1,0 +1,482 @@
+"""Overload control, serving side (ISSUE tentpole a+b): priority admission,
+deadline-aware shedding, staged brownout degradation, the Retry-After
+contract on every 429/503, and the loadgen --overload / dstpu_report
+--overload tooling.
+
+Policy math (RateEstimator, BrownoutController) is tested engine-free;
+scheduler behavior drives ``step()`` manually (``start=False``) like
+test_scheduler.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.serving import (AdmissionRejected, BrownoutController,
+                                   RateEstimator, RequestState, ServingConfig,
+                                   ServingScheduler, ServingServer)
+from deepspeed_tpu.serving.config import OverloadConfig
+from deepspeed_tpu.serving.overload import validate_priority
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+MAX_STEPS = 400
+
+
+def _run_until(sched, pred, max_steps=MAX_STEPS):
+    for _ in range(max_steps):
+        if pred():
+            return
+        sched.step()
+    raise AssertionError(f"predicate not reached in {max_steps} steps")
+
+
+def _warm(sched, tokens_per_s=100.0, batches=6):
+    """Warm the scheduler's rate estimator to a known synthetic rate: one
+    batch of ``tokens_per_s`` tokens per synthetic second."""
+    for i in range(batches):
+        sched._rate.observe(int(tokens_per_s), now=float(i))
+    assert sched._rate.rate == pytest.approx(tokens_per_s)
+
+
+def _prompt(n=9, vocab=64):
+    return (np.arange(n) % vocab).tolist()
+
+
+# ---------------------------------------------------------------------------
+# policy primitives (engine-free)
+# ---------------------------------------------------------------------------
+def test_priority_validation_default_and_unknown():
+    assert validate_priority(None) == "interactive"
+    assert validate_priority("interactive") == "interactive"
+    assert validate_priority("batch") == "batch"
+    with pytest.raises(ValueError, match="unknown priority"):
+        validate_priority("platinum")
+
+
+def test_rate_estimator_cold_then_converges():
+    est = RateEstimator(alpha=0.5, min_samples=3)
+    assert est.rate is None and est.seconds_for(100) is None  # cold
+    est.observe(50, now=0.0)          # first batch: no interval yet
+    est.observe(50, now=1.0)
+    est.observe(50, now=2.0)
+    assert est.rate is None           # 2 samples < min_samples
+    est.observe(50, now=3.0)
+    assert est.warm and est.rate == pytest.approx(50.0)
+    assert est.seconds_for(100) == pytest.approx(2.0)
+    # zero token counts and non-advancing clocks are ignored, never corrupt
+    est.observe(0, now=4.0)
+    est.observe(10, now=2.5)  # behind the last observation: dt <= 0
+    assert est.rate == pytest.approx(50.0)
+
+
+def test_brownout_stages_escalate_and_hysteresis_holds():
+    ctl = BrownoutController(thresholds=(0.4, 0.6, 0.8), hysteresis=0.15,
+                             alpha=1.0)  # alpha=1: the raw signal IS the stage driver
+    assert ctl.update(0.1) == 0
+    assert ctl.update(0.45) == 1
+    assert ctl.update(0.65) == 2
+    assert ctl.update(0.85) == 3 == ctl.max_stage
+    # hovering just below the stage-3 threshold holds the stage (hysteresis)
+    assert ctl.update(0.7) == 3
+    # falling past threshold - hysteresis de-escalates (0.8 - 0.15 = 0.65)
+    assert ctl.update(0.6) == 2
+    assert ctl.update(0.0) == 0
+    assert ctl.transitions == 5  # 0->1->2->3 then 3->2 and 2->0
+
+
+def test_brownout_thresholds_must_be_ascending():
+    with pytest.raises(ValueError, match="ascending"):
+        BrownoutController(thresholds=(0.8, 0.6, 0.9))
+    with pytest.raises(ValueError, match="ascending"):
+        OverloadConfig(brownout_stage_thresholds=(0.9, 0.5, 0.95))
+
+
+# ---------------------------------------------------------------------------
+# admission control + shedding (manual stepping)
+# ---------------------------------------------------------------------------
+def test_admission_rejects_provably_unmeetable_deadline(make_engine):
+    engine = make_engine()
+    sched = ServingScheduler(engine, ServingConfig(), start=False)
+    try:
+        _warm(sched, tokens_per_s=100.0)
+        # 9 prompt + 200 generation tokens at 100 tok/s ~ 2.1s >> 0.05s
+        with pytest.raises(AdmissionRejected) as exc:
+            sched.submit(_prompt(), max_new_tokens=200, deadline_s=0.05)
+        assert exc.value.retry_after_s >= sched._config.overload.retry_after_floor_s
+        assert sched.stats()["counters"]["shed_admission"] == 1
+        # nothing was admitted, nothing touched the engine
+        assert sched.queue_depth == 0 and sched.n_active == 0
+        # a feasible deadline at the same rate is admitted
+        req = sched.submit(_prompt(), max_new_tokens=3, deadline_s=30.0)
+        _run_until(sched, lambda: req.state is RequestState.DONE)
+    finally:
+        sched.stop(drain=False)
+
+
+def test_cold_estimator_admits_everything(make_engine):
+    """Admission control can only act on what it can prove: a cold rate
+    estimator admits even an absurd deadline (it will time out later, but
+    was never rejected on a guess)."""
+    engine = make_engine()
+    sched = ServingScheduler(engine, ServingConfig(), start=False)
+    try:
+        req = sched.submit(_prompt(), max_new_tokens=500, deadline_s=0.001)
+        assert req is not None  # admitted, not AdmissionRejected
+    finally:
+        sched.stop(drain=False)
+
+
+def test_priority_ordering_admits_interactive_before_batch(make_engine):
+    engine = make_engine(max_tracked_sequences=1)  # serialize admission
+    sched = ServingScheduler(engine, ServingConfig(), start=False)
+    try:
+        b1 = sched.submit(_prompt(), max_new_tokens=2, priority="batch")
+        b2 = sched.submit(_prompt(5), max_new_tokens=2, priority="batch")
+        i1 = sched.submit(_prompt(7), max_new_tokens=2, priority="interactive")
+        _run_until(sched, lambda: i1.state is RequestState.DONE)
+        # the interactive request finished while at least one earlier-queued
+        # batch request was still waiting: (priority, deadline, arrival) order
+        assert b2.state is not RequestState.DONE
+        _run_until(sched, lambda: b1.state is RequestState.DONE
+                   and b2.state is RequestState.DONE)
+    finally:
+        sched.stop(drain=False)
+
+
+def test_overload_disabled_is_fifo_control(make_engine):
+    """The control arm: overload.enabled=False restores strict FIFO admission
+    and never rejects at submit()."""
+    engine = make_engine(max_tracked_sequences=1)
+    cfg = ServingConfig(overload=OverloadConfig(enabled=False))
+    sched = ServingScheduler(engine, cfg, start=False)
+    try:
+        _warm(sched, tokens_per_s=100.0)
+        b1 = sched.submit(_prompt(), max_new_tokens=2, priority="batch",
+                          deadline_s=120.0)
+        i1 = sched.submit(_prompt(7), max_new_tokens=2, priority="interactive",
+                          deadline_s=120.0)
+        _run_until(sched, lambda: b1.state is RequestState.DONE)
+        assert i1.state is not RequestState.DONE  # FIFO: batch went first
+        _run_until(sched, lambda: i1.state is RequestState.DONE)
+        # no admission gate either: an unmeetable deadline is still admitted
+        req = sched.submit(_prompt(), max_new_tokens=500, deadline_s=0.001)
+        assert req.shed_reason is None
+    finally:
+        sched.stop(drain=False)
+
+
+def test_queue_shed_under_pressure_lowest_priority_first(make_engine):
+    """Sustained pressure (brownout stage >= 1) sheds queued requests whose
+    deadline is provably unmeetable — batch before interactive, before any
+    engine work."""
+    engine = make_engine(max_tracked_sequences=1)
+    # admission control off: the requests must actually QUEUE so the
+    # stage->shed path (not the submit() gate) is what rejects them
+    cfg = ServingConfig(queue_capacity=4,
+                        overload=OverloadConfig(admission_control=False))
+    sched = ServingScheduler(engine, cfg, start=False)
+    try:
+        _warm(sched, tokens_per_s=10.0)  # slow: 49 tokens ~ 4.9s
+        # each request is ~4.9s of work; at 6s deadlines the first fits and
+        # every later one is provably unmeetable behind it
+        reqs = [sched.submit(_prompt(), max_new_tokens=40, deadline_s=6.0,
+                             priority=p)
+                for p in ("interactive", "batch", "batch")]
+        # force pressure past stage 1 (the shed trigger), deterministically
+        for _ in range(30):
+            sched._brownout.update(1.0)
+        assert sched._brownout.stage >= 1
+        sched._shed_queued(now=reqs[0].arrival_s)
+        shed = [r for r in reqs if r.shed_reason is not None]
+        assert shed, "nothing shed under provable overload"
+        for r in shed:
+            assert r.state is RequestState.FAILED
+            assert r.retry_after_s is not None and r.retry_after_s > 0
+            assert r.tokens == [] and r._fed == 0  # zero engine work consumed
+        # the interactive request survives while any batch was shed
+        if len(shed) < len(reqs):
+            assert all(r.priority == "batch" for r in shed)
+        assert sched.stats()["counters"]["shed_queue"] == len(shed)
+    finally:
+        sched.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# brownout stages
+# ---------------------------------------------------------------------------
+def _force_stage(sched, stage, pin=False):
+    """Drive the brownout controller to ``stage`` through its own update
+    path. ``pin=True`` additionally freezes it there — tests that keep
+    stepping would otherwise watch the stage decay as every tick feeds the
+    real (idle) pressure signal."""
+    thresholds = sched._brownout._thresholds
+    target = 1.0 if stage >= len(thresholds) else (
+        (thresholds[stage - 1] + thresholds[stage]) / 2 if stage else 0.0)
+    for _ in range(60):
+        sched._brownout.update(target)
+    assert sched._brownout.stage == stage
+    if pin:
+        sched._brownout.update = lambda pressure: stage
+
+
+def test_brownout_stage1_clamps_batch_only_flagged(make_engine):
+    engine = make_engine()
+    sched = ServingScheduler(engine, ServingConfig(), start=False)
+    try:
+        _force_stage(sched, 1)
+        clamp = sched._config.overload.brownout_clamp_max_new_tokens
+        batch = sched.submit(_prompt(), max_new_tokens=clamp + 50,
+                             priority="batch")
+        assert batch.max_new_tokens == clamp
+        assert "max_new_tokens_clamped" in batch.degraded_mode  # never silent
+        inter = sched.submit(_prompt(5), max_new_tokens=clamp + 50,
+                             priority="interactive")
+        assert inter.max_new_tokens == clamp + 50  # interactive untouched
+        assert not inter.degraded_mode
+        assert sched.stats()["counters"]["brownout_clamped"] == 1
+    finally:
+        sched.stop(drain=False)
+
+
+def test_brownout_stage2_disables_speculative_decode_chunk(make_engine,
+                                                           llama_setup):
+    """Stage >= 2: chunked decode dispatch falls back to one token per step,
+    flagged per request — and the tokens stay greedy-identical."""
+    cfg, _, _ = llama_setup
+    engine = make_engine()
+    sched = ServingScheduler(engine, ServingConfig(decode_chunk=4), start=False)
+    try:
+        prompt = _prompt(vocab=cfg.vocab_size)
+        base = sched.submit(prompt, max_new_tokens=5)
+        _run_until(sched, lambda: base.state is RequestState.DONE)
+        batches_before_stage2 = sched.stats()["counters"]["batches"]
+
+        _force_stage(sched, 2, pin=True)
+        req = sched.submit(prompt, max_new_tokens=5)
+        assert "speculative_disabled" in req.degraded_mode
+        _run_until(sched, lambda: req.state is RequestState.DONE)
+        assert req.tokens == base.tokens  # degraded, not different
+        # one token per step now: strictly more batches than the chunked run
+        degraded_batches = (sched.stats()["counters"]["batches"]
+                            - batches_before_stage2)
+        assert degraded_batches > 2  # 1 prefill + 5 single-token decode steps
+    finally:
+        sched.stop(drain=False)
+
+
+def test_brownout_stage3_rejects_batch_admits_interactive(make_engine):
+    engine = make_engine()
+    sched = ServingScheduler(engine, ServingConfig(), start=False)
+    try:
+        _force_stage(sched, 3)
+        with pytest.raises(AdmissionRejected, match="stage 3"):
+            sched.submit(_prompt(), max_new_tokens=2, priority="batch")
+        assert sched.stats()["counters"]["brownout_rejected"] == 1
+        req = sched.submit(_prompt(5), max_new_tokens=2, priority="interactive")
+        _run_until(sched, lambda: req.state is RequestState.DONE)
+    finally:
+        sched.stop(drain=False)
+
+
+def test_brownout_recovers_and_stats_expose_overload_block(make_engine):
+    engine = make_engine()
+    sched = ServingScheduler(engine, ServingConfig(), start=False)
+    try:
+        _force_stage(sched, 3)
+        _force_stage(sched, 0)  # pressure collapsed: full service restored
+        req = sched.submit(_prompt(), max_new_tokens=2, priority="batch")
+        _run_until(sched, lambda: req.state is RequestState.DONE)
+        doc = sched.stats()["overload"]
+        assert doc["enabled"] and doc["brownout_stage"] == 0
+        assert doc["retry_after_s"] >= 0
+    finally:
+        sched.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# the Retry-After contract over HTTP
+# ---------------------------------------------------------------------------
+def test_http_429_and_503_carry_retry_after(make_engine):
+    engine = make_engine()
+    sched = ServingScheduler(engine, ServingConfig(), start=False)
+    srv = ServingServer(sched).start()
+    try:
+        _warm(sched, tokens_per_s=10.0)
+        body = json.dumps({"prompt": _prompt(), "max_new_tokens": 400,
+                           "deadline_s": 0.05}).encode()
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(urllib.request.Request(
+                srv.url + "/v1/generate", data=body,
+                headers={"Content-Type": "application/json"}), timeout=30)
+        assert exc.value.code == 429
+        retry_after = exc.value.headers.get("Retry-After")
+        assert retry_after is not None and int(retry_after) >= 1
+        assert json.loads(exc.value.read())["retry_after_s"] > 0
+
+        # draining: 503 with the same contract
+        srv._draining.set()
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(urllib.request.Request(
+                srv.url + "/v1/generate", data=body,
+                headers={"Content-Type": "application/json"}), timeout=30)
+        assert exc.value.code == 503
+        assert int(exc.value.headers.get("Retry-After")) >= 1
+    finally:
+        srv.stop(drain=False)
+
+
+def test_http_priority_header_and_unknown_class_400(make_engine):
+    engine = make_engine()
+    sched = ServingScheduler(engine, ServingConfig(), start=False)
+    srv = ServingServer(sched).start()
+    try:
+        _force_stage(sched, 3)  # batch is rejected: proves the header landed
+        body = json.dumps({"prompt": _prompt(), "max_new_tokens": 2}).encode()
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(urllib.request.Request(
+                srv.url + "/v1/generate", data=body,
+                headers={"Content-Type": "application/json",
+                         "X-DSTPU-Priority": "batch"}), timeout=30)
+        assert exc.value.code == 429
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(urllib.request.Request(
+                srv.url + "/v1/generate",
+                data=json.dumps({"prompt": _prompt(), "priority": "gold",
+                                 "max_new_tokens": 2}).encode(),
+                headers={"Content-Type": "application/json"}), timeout=30)
+        assert exc.value.code == 400  # unknown class is a client error
+    finally:
+        srv.stop(drain=False)
+
+
+def test_response_doc_carries_priority_and_degradations(make_engine):
+    engine = make_engine()
+    sched = ServingScheduler(engine, ServingConfig(), start=False)
+    srv = ServingServer(sched).start()
+    try:
+        _force_stage(sched, 1, pin=True)
+        clamp = sched._config.overload.brownout_clamp_max_new_tokens
+        body = json.dumps({"prompt": _prompt(), "max_new_tokens": clamp + 10,
+                           "priority": "batch"}).encode()
+        resp_holder = {}
+
+        def post():
+            with urllib.request.urlopen(urllib.request.Request(
+                    srv.url + "/v1/generate", data=body,
+                    headers={"Content-Type": "application/json"}),
+                    timeout=60) as resp:
+                resp_holder["doc"] = json.loads(resp.read())
+        t = threading.Thread(target=post, daemon=True)
+        t.start()
+        # wall-clock-bounded stepping: the handler thread needs real time to
+        # connect and submit before steps have any work to do
+        deadline = time.monotonic() + 60
+        while "doc" not in resp_holder and time.monotonic() < deadline:
+            sched.step()
+            time.sleep(0.001)
+        t.join(timeout=10)
+        assert "doc" in resp_holder, "response never arrived"
+        doc = resp_holder["doc"]
+        assert doc["priority"] == "batch"
+        assert doc["degraded_mode"] == ["max_new_tokens_clamped"]
+        assert doc["n_tokens"] == clamp  # the clamp actually bounded decode
+    finally:
+        srv.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# loadgen --overload ramp + dstpu_report --overload (ISSUE satellites)
+# ---------------------------------------------------------------------------
+def _overload_doc(goodputs, capacity=10.0):
+    return {"capacity_req_s": capacity, "deadline_s": 2.0,
+            "interactive_frac": 0.5, "requests_per_step": 8,
+            "steps": [{"offered_x": 0.5 * (i + 1),
+                       "offered_req_s": 0.5 * (i + 1) * capacity,
+                       "goodput_req_s": g, "requests": 8, "ok": 8,
+                       "on_deadline": 8, "shed": i, "degraded": 0, "hedged": 0,
+                       "queue_expired": 0, "wall_s": 1.0,
+                       "ttft": {"interactive": {"p50_s": 0.01, "p99_s": 0.05,
+                                                "n": 4},
+                                "batch": {"p50_s": 0.02, "p99_s": 0.08,
+                                          "n": 4}}}
+                      for i, g in enumerate(goodputs)]}
+
+
+def test_report_overload_flags_the_knee(tmp_path, capsys):
+    from deepspeed_tpu.env_report import overload_report
+    path = tmp_path / "ramp.json"
+    # goodput holds at capacity then collapses: knee at the third step
+    path.write_text(json.dumps(_overload_doc([9.8, 9.5, 6.0, 4.0])))
+    assert overload_report(str(path)) == 0
+    out = capsys.readouterr().out
+    assert "<- knee" in out
+    assert "knee at 1.5x" in out  # first step below 90% of 10 req/s
+
+    # no knee: goodput held
+    path.write_text(json.dumps(_overload_doc([9.8, 9.5, 9.2])))
+    assert overload_report(str(path)) == 0
+    assert "no knee" in capsys.readouterr().out
+
+    # a sub-capacity step is bounded by OFFERED load, not collapse: a lone
+    # 0.5x step serving everything it was offered (5 < 9 req/s) is no knee
+    path.write_text(json.dumps(_overload_doc([4.9])))
+    assert overload_report(str(path)) == 0
+    assert "no knee" in capsys.readouterr().out
+
+    # garbage input is a loud rc 2, not a traceback
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    assert overload_report(str(bad)) == 2
+    assert overload_report(str(tmp_path / "missing.json")) == 2
+
+
+def test_loadgen_overload_ramp_end_to_end(make_engine, llama_setup):
+    """bin/dstpu_loadgen --overload against a live server: capacity phase,
+    two ramp steps, JSON artifact, and dstpu_report rendering it."""
+    import tempfile
+    cfg, _, _ = llama_setup
+    engine = make_engine()
+    sched = ServingScheduler(engine, ServingConfig())
+    srv = ServingServer(sched).start()
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            out_json = os.path.join(td, "ramp.json")
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bin", "dstpu_loadgen"),
+                 "--url", srv.url, "--requests", "6", "--concurrency", "2",
+                 "--prompt-len", "8", "--max-new-tokens", "3",
+                 "--vocab-size", str(cfg.vocab_size), "--deadline-s", "30",
+                 "--overload", "--overload-steps", "0.5,2",
+                 "--interactive-frac", "0.5", "--seed", "7",
+                 "--json", out_json],
+                capture_output=True, text=True, timeout=560)
+            assert proc.returncode == 0, proc.stdout + proc.stderr
+            assert "overload ramp" in proc.stdout
+            with open(out_json) as f:
+                doc = json.load(f)
+            assert doc["capacity_req_s"] > 0
+            assert [s["offered_x"] for s in doc["steps"]] == [0.5, 2.0]
+            for step in doc["steps"]:
+                assert step["on_deadline"] > 0
+                assert step["ttft"]["interactive"]["n"] + \
+                    step["ttft"]["batch"]["n"] > 0
+
+            report = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bin", "dstpu_report"),
+                 "--overload", out_json],
+                capture_output=True, text=True, timeout=60)
+            assert report.returncode == 0, report.stdout + report.stderr
+            assert "overload ramp" in report.stdout
+    finally:
+        srv.stop(drain=False)
