@@ -14,7 +14,8 @@ from deepspeed_tpu.fleet.breaker import BreakerConfig
 from deepspeed_tpu.fleet.faults import FaultConfig
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 from deepspeed_tpu.serving.config import (DEFAULT_MAX_RESUME_BODY_BYTES,
-                                          OverloadConfig, PrefixCacheConfig)
+                                          OverloadConfig, PrefixCacheConfig,
+                                          SpeculativeConfig)
 
 ReplicaRole = Literal["mixed", "prefill", "decode"]
 """``mixed`` serves whole requests; ``prefill``/``decode`` replicas form the
@@ -249,6 +250,22 @@ class FleetConfig(DeepSpeedConfigModel):
 
     prefix_cache_roles: Tuple[ReplicaRole, ...] = ("mixed", "prefill")
     """Replica roles that receive ``prefix_cache`` when it is enabled."""
+
+    speculative: Optional[SpeculativeConfig] = None
+    """Speculative decoding applied to fleet-built local replicas
+    (``serving/config.SpeculativeConfig``). When set, this block is
+    authoritative for the roles in ``speculative_roles`` and drafting is
+    forced OFF for the others; None = replicas keep whatever their own
+    ``ServingConfig.speculative`` says. Trie-backed drafting is a
+    prefill/mixed-role concern (those pools carry the prefix-cache trie);
+    decode-role replicas self-draft from the request's own history, with the
+    acceptance EWMA riding the prefill→decode handoff payload so adaptation
+    survives the migration."""
+
+    speculative_roles: Tuple[ReplicaRole, ...] = ("mixed", "decode")
+    """Replica roles that receive ``speculative`` when it is set. Prefill
+    replicas are excluded by default — they generate exactly one token per
+    request, so there is no decode loop to speed up."""
 
     global_queue: GlobalQueueConfig = GlobalQueueConfig()
     """Router global queue + pull dispatch (``fleet/global_queue.py``)."""

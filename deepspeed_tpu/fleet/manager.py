@@ -78,6 +78,16 @@ class ReplicaManager:
             # replicas disagreeing on what "overloaded" means
             base = (base or ServingConfig()).model_copy(
                 update={"overload": self._config.overload})
+        fleet_spec = self._config.speculative
+        if fleet_spec is not None:
+            # same authority rule as the prefix cache: listed roles get the
+            # fleet's speculative block, the others run with drafting off
+            if role in self._config.speculative_roles:
+                base = (base or ServingConfig()).model_copy(
+                    update={"speculative": fleet_spec})
+            elif base is not None and base.speculative.enabled:
+                from deepspeed_tpu.serving.config import SpeculativeConfig
+                base = base.model_copy(update={"speculative": SpeculativeConfig()})
         fleet_pc = self._config.prefix_cache
         if not fleet_pc.enabled:
             return base

@@ -261,10 +261,23 @@ class InferenceEngineV2:
                     rng=None) -> np.ndarray:
         """Generate ``n_steps`` tokens per sequence in ONE device program (no
         host round-trip per token — see DSTransformerModelBase.decode_loop).
-        ``batch_tokens`` holds each sequence's next input token (e.g. the
-        argmax of its prefill logits); returns generated tokens
-        ``[n_seqs, n_steps]``. ``temperature`` 0 = greedy; > 0 samples
-        categorically with the (per-step folded) ``rng``.
+        ``batch_tokens`` holds each sequence's next-input token(s); returns
+        generated tokens ``[n_seqs, n_steps]``. ``temperature`` 0 = greedy;
+        > 0 samples categorically with the (per-step folded) ``rng``.
+
+        **Multi-token verify feed** (speculative decoding): an entry may carry
+        its next-input token followed by k draft tokens. Any entry wider than
+        one token switches the call into verify mode — ``n_steps`` must be 1,
+        greedy only — where ONE ragged forward scores every fed position and
+        the return value is a list of per-sequence int32 arrays: element i
+        holds, for each of sequence i's ``1+k_i`` positions, the target
+        model's greedy next token after consuming the feed up to and including
+        that position (``out[i][j] == argmax`` after ``feed_i[:j+1]``). The
+        caller accepts the longest prefix where ``out[i][j] == feed_i[j+1]``
+        and rolls back the rejected tail via :meth:`rollback`. All-single-token
+        feeds keep the old on-device scan path unchanged — the k=0 fast case.
+        Sampled verification consumes :meth:`verify` logits host-side instead
+        (per-request seeded streams cannot share a device PRNG).
 
         EOS is not monitored on device: the loop always runs ``n_steps``; the
         caller trims at the first EOS (the fixed-shape scan is what makes the
@@ -272,10 +285,22 @@ class InferenceEngineV2:
         """
         batch_uids = list(batch_uids)
         batch_tokens = [np.atleast_1d(np.asarray(t)) for t in batch_tokens]
-        if any(t.size != 1 for t in batch_tokens):
-            raise ValueError("decode_loop takes exactly one next-input token per sequence")
+        if any(t.size < 1 for t in batch_tokens):
+            raise ValueError("decode_loop needs at least one next-input token per sequence")
         if n_steps < 1:
             raise ValueError("n_steps must be >= 1")
+        if any(t.size != 1 for t in batch_tokens):
+            if n_steps != 1:
+                raise ValueError("a multi-token verify feed runs exactly one step "
+                                 "(n_steps=1); the on-device scan takes single-token "
+                                 "entries only")
+            if temperature > 0:
+                raise ValueError("the multi-token verify feed is greedy; sampled "
+                                 "verification consumes engine.verify() logits "
+                                 "host-side")
+            return [np.argmax(rows, axis=-1).astype(np.int32)
+                    for rows in self.verify(batch_uids, batch_tokens,
+                                            do_checks=do_checks)]
         if do_checks:
             # each SCAN STEP's ragged batch holds one token per sequence, so
             # the token budget is checked against n_seqs — but the KV-block
@@ -329,6 +354,81 @@ class InferenceEngineV2:
                 seq_desc.post_forward()
             self._model.maybe_free_kv(seq_desc)
         return tokens[:, :len(batch_uids)].T
+
+    # ------------------------------------------------------ speculative verify --
+    def verify(self, batch_uids: Iterable[int], batch_tokens: Iterable,
+               do_checks: bool = True) -> List[np.ndarray]:
+        """Speculative-decoding verify step: feed each sequence its next-input
+        token plus draft tokens (``batch_tokens[i]`` holds ``1+k_i`` ids)
+        through ONE ragged forward — the chunked-prefill multi-token feed path
+        — and return per-position logits: a list of float32 arrays, element i
+        shaped ``[1+k_i, vocab]`` where row j scores the token AFTER
+        ``batch_tokens[i][:j+1]``.
+
+        Every fed position's KV is written and committed (``seen_tokens``
+        advances by ``1+k_i``); the caller decides the accepted prefix and
+        truncates the rejected tail with :meth:`rollback` — the same
+        write-then-truncate mechanism chunk-decode over-run relies on."""
+        batch_uids = list(batch_uids)
+        batch_tokens = [np.atleast_1d(np.asarray(t)) for t in batch_tokens]
+        if do_checks:
+            schedule_check = self.can_schedule(batch_uids, [t.size for t in batch_tokens])
+            if schedule_check != SchedulingResult.Success:
+                raise SchedulingError(schedule_check)
+        self._restore_offloaded(batch_uids)
+
+        self._batch.clear()
+        if self._tracer:
+            self._tracer.init_batch(is_empty_run=False, num_layers=self._model.num_layers)
+        for uid, tokens in zip(batch_uids, batch_tokens):
+            seq_desc = self._state_manager.get_or_create_sequence(uid)
+            self._model.maybe_allocate_kv(seq_desc, tokens.size)
+            seq_desc.pre_forward(tokens.size)
+            self._batch.insert_sequence(seq_desc, tokens, do_checks=do_checks)
+            if self._tracer:
+                self._tracer.add_sequence(seq_desc)
+
+        self._batch.finalize()
+        self._model.prepare_batch(self._batch)
+        spans = self._resolve_spans()
+        if spans is not None:
+            _t0 = _tel_now_us()
+        logits = np.asarray(self._model.forward_verify(self._batch))  # [T, vocab]
+
+        for uid in batch_uids:
+            seq_desc = self._state_manager.get_sequence(uid)
+            seq_desc.post_forward()
+            self._model.maybe_free_kv(seq_desc)
+        n_tokens = int(sum(t.size for t in batch_tokens))
+        if spans is not None:
+            spans.record("verify", cat="inference", ts_us=_t0,
+                         dur_us=_tel_now_us() - _t0,
+                         args={"sequences": len(batch_uids),
+                               "tokens": n_tokens,
+                               "uids": [int(u) for u in batch_uids]})
+        metrics = self._resolve_tel_metrics()
+        if metrics is not None:
+            self._write_telemetry(metrics, batch_tokens=n_tokens)
+        # insertion order is batch order: each sequence's positions are one
+        # contiguous token-major run
+        out, offset = [], 0
+        for tokens in batch_tokens:
+            out.append(logits[offset:offset + tokens.size])
+            offset += tokens.size
+        return out
+
+    def rollback(self, uid: int, n_tokens: int) -> None:
+        """Truncate ``uid``'s last ``n_tokens`` committed tokens after a
+        verify step rejected them: the stale KV stays in its blocks and is
+        overwritten when the correct tokens are fed at those positions
+        (write-then-truncate — the mechanism chunk-decode over-run already
+        relies on). The blocks stay allocated for the sequence."""
+        if n_tokens <= 0:
+            return
+        seq_desc = self._state_manager.get_sequence(uid)
+        if seq_desc is None:
+            raise ValueError(f"rollback: unknown uid {uid}")
+        seq_desc.rollback(n_tokens)
 
     # ------------------------------------------------------------- scheduling --
     def query(self, uid: int, max_request_tokens: int, max_request_blocks: int) -> Tuple[int, int]:
@@ -440,9 +540,9 @@ class InferenceEngineV2:
     # ---------------------------------------------------------- lowering hooks --
     def lowerable_callables(self) -> dict:
         """The engine's jitted device programs as raw ``jax.jit`` callables
-        (``.lower()``-able), in two buckets: ``forward`` keyed by
-        ``(T, S, MB)`` pad bucket and ``decode_loop`` keyed by
-        ``(bucket, n_steps, sampled)``. This is the official hook for
+        (``.lower()``-able): ``forward`` keyed by ``(T, S, MB)`` pad bucket,
+        ``decode_loop`` keyed by ``(bucket, n_steps, sampled)`` and ``verify``
+        keyed by ``("verify", bucket)``. This is the official hook for
         HLO-level analysis (the deepspeed_tpu/perf/ gates); the jit-cache
         entries themselves may be compile-watch wrappers shared with
         telemetry and cannot lower."""
@@ -457,6 +557,11 @@ class InferenceEngineV2:
         """``jax.stages.Lowered`` of the on-device ``n_steps`` decode scan."""
         return self._model.lower_decode_loop(n_steps, bucket=bucket,
                                              temperature=temperature)
+
+    def lower_verify_step(self, bucket=None):
+        """``jax.stages.Lowered`` of the speculative verify program (one
+        ragged forward unembedding every fed position). Never executes."""
+        return self._model.lower_verify_step(bucket)
 
     # -------------------------------------------------------------- empty_run --
     def empty_run(self) -> None:

@@ -203,15 +203,20 @@ class DSTransformerModelBase:
     def lowerable_callables(self):
         """Raw ``jax.jit`` callables (they support ``.lower()``) keyed exactly
         like ``_compiled``: forward programs by ``(T, S, MB)`` bucket, decode
-        programs by ``(bucket, n_steps, sampled)``. The official hook for
-        HLO-level analysis (deepspeed_tpu/perf/) — the entries in
-        ``_compiled`` may be compile-watch wrappers, which cannot lower."""
+        programs by ``(bucket, n_steps, sampled)``, speculative verify
+        programs by ``("verify", bucket)``. The official hook for HLO-level
+        analysis (deepspeed_tpu/perf/) — the entries in ``_compiled`` may be
+        compile-watch wrappers, which cannot lower."""
         return {"forward": {k: v for k, v in self._lowerable.items()
                             if not (isinstance(k, tuple) and len(k) == 3
-                                    and isinstance(k[0], tuple))},
+                                    and isinstance(k[0], tuple))
+                            and not (isinstance(k, tuple) and k[0] == "verify")},
                 "decode_loop": {k: v for k, v in self._lowerable.items()
                                 if isinstance(k, tuple) and len(k) == 3
-                                and isinstance(k[0], tuple)}}
+                                and isinstance(k[0], tuple)},
+                "verify": {k: v for k, v in self._lowerable.items()
+                           if isinstance(k, tuple) and len(k) == 2
+                           and k[0] == "verify"}}
 
     def _synthetic_batch(self, bucket=None):
         """Shape/dtype-faithful device-batch arrays for ``bucket`` (default:
@@ -256,6 +261,18 @@ class DSTransformerModelBase:
             donate_argnums=(1, ))
         return fn.lower(self._params, self._state_manager.kv_cache.cache, dev,
                         jnp.float32(temperature), jax.random.PRNGKey(0))
+
+    def lower_verify_step(self, bucket=None):
+        """Lower the speculative verify program at ``bucket`` (default
+        smallest) — the same ``_verify_impl`` jit :meth:`forward_verify`
+        runs. Never executes."""
+        import jax
+        dev = self._synthetic_batch(bucket)
+        key = ("verify", (dev["tok_meta"].shape[1], dev["seq_meta"].shape[0],
+                          dev["seq_meta"].shape[1] - 4))
+        fn = self._lowerable.get(key) or jax.jit(self._verify_impl,
+                                                 donate_argnums=(1, ))
+        return fn.lower(self._params, self._state_manager.kv_cache.cache, dev)
 
     # ------------------------------------------------------------ decode loop --
     def decode_loop(self, ragged_batch, n_steps: int, temperature: float = 0.0,
@@ -360,6 +377,49 @@ class DSTransformerModelBase:
         # unembed ONLY each sequence's last token (reference logits_gather)
         x_last = x[batch["last_tok"]]
         logits = self.unembed(params, x_last)
+        return logits.astype(jnp.float32), cache
+
+    # ----------------------------------------------------- speculative verify --
+    def forward_verify(self, ragged_batch):
+        """The speculative-decoding verify forward: identical layer compute to
+        :meth:`forward`, but EVERY token position is unembedded — returns
+        logits ``[T_bucket, vocab]`` (row t scores the token AFTER batch
+        position t), so one ragged pass prices a next-input token plus its k
+        draft tokens per sequence. The KV cache is updated in place for every
+        fed position, including drafts that turn out wrong — the caller rolls
+        those back by truncating ``seen_tokens`` (the KV is overwritten when
+        the correct tokens are fed at the same positions)."""
+        import jax
+        batch = ragged_batch.device_batch if hasattr(ragged_batch, "device_batch") else ragged_batch
+        bucket = (batch["tok_meta"].shape[1], batch["seq_meta"].shape[0],
+                  batch["seq_meta"].shape[1] - 4)
+        key = ("verify", bucket)
+        if key not in self._compiled:
+            fn = jax.jit(self._verify_impl, donate_argnums=(1, ))
+            self._lowerable[key] = fn
+            cw = compile_watch.get()
+            if cw is not None:
+                fn = cw.wrap("inference_verify", key, fn)
+            self._compiled[key] = fn
+        cache = self._state_manager.kv_cache.cache
+        dev = {"tok_meta": batch["tok_meta"], "seq_meta": batch["seq_meta"]}
+        logits, new_cache = self._compiled[key](self._params, cache, dev)
+        self._state_manager.kv_cache.set_cache(new_cache)
+        return logits
+
+    def _verify_impl(self, params, cache, batch):
+        """Same program body as :meth:`_forward_impl` minus the last-token
+        gather: the verify step needs logits at all 1+k fed positions."""
+        import jax.numpy as jnp
+        from deepspeed_tpu.inference.v2.quantization import dequantize_tree
+
+        params = dequantize_tree(params)
+        batch = self._unpack_batch(batch)
+        x = self.embed(params, batch["input_ids"])
+        attn = partial(self._paged_attention, batch=batch)
+        for li in range(self.num_layers):
+            x, cache = self.layer_forward(params, li, x, cache, attn, batch)
+        logits = self.unembed(params, x)  # ALL positions, token-major
         return logits.astype(jnp.float32), cache
 
     def _traced_forward(self, batch, cache, n):

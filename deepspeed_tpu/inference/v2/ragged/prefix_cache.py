@@ -44,14 +44,18 @@ import numpy as np
 
 
 class _Node:
-    __slots__ = ("digest", "block", "parent", "children", "last_touch")
+    __slots__ = ("digest", "block", "parent", "children", "last_touch", "tokens")
 
-    def __init__(self, digest: bytes, block: int, parent: Optional["_Node"]):
+    def __init__(self, digest: bytes, block: int, parent: Optional["_Node"],
+                 tokens: Optional[np.ndarray] = None):
         self.digest = digest
         self.block = block          # device block id this node owns a ref on
         self.parent = parent
         self.children: Dict[bytes, "_Node"] = {}
         self.last_touch = 0
+        # the block's token ids (host copy): what a prompt-lookup drafter
+        # mines — the trie holds exactly the token histories it wants
+        self.tokens = tokens
 
 
 class PrefixHit:
@@ -153,6 +157,49 @@ class PrefixCache:
         if len(blocks):
             self._kv.free(blocks)
 
+    # ----------------------------------------------------- drafter mining --
+    def lookup_continuation(self, tokens, k: int,
+                            digests: Optional[List[bytes]] = None) -> np.ndarray:
+        """Mine the trie for a continuation of ``tokens`` — the prompt-lookup
+        drafter's trie leg (speculative decoding): walk the full-block digest
+        chain to the deepest indexed node, then descend children whose stored
+        token blocks extend the partial tail, returning up to ``k`` proposed
+        next tokens. Read-only: takes no block references and leaves LRU
+        clocks untouched (drafting a continuation is not evidence the prefix
+        will be re-prefilled). Empty when the history diverges from every
+        indexed path."""
+        if k <= 0:
+            return np.empty(0, np.int32)
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self._block_size
+        n_full = tokens.size // bs
+        if digests is None or len(digests) < n_full:
+            # extend (never trust a short prompt-only chain: the walk depth
+            # and the partial tail below must agree)
+            digests = self.chain(tokens, base=digests)
+        node = self._root
+        for digest in digests[:n_full]:
+            child = node.children.get(digest)
+            if child is None:
+                return np.empty(0, np.int32)
+            node = child
+        rem = tokens[n_full * bs:]
+        out: List[int] = []
+        while len(out) < k:
+            nxt = None
+            for child in node.children.values():
+                ct = child.tokens
+                if ct is not None and rem.size < ct.size and \
+                        np.array_equal(ct[:rem.size], rem):
+                    nxt = child
+                    break
+            if nxt is None:
+                break
+            tail = nxt.tokens[rem.size:]
+            out.extend(int(t) for t in tail[:k - len(out)])
+            node, rem = nxt, np.empty(0, np.int32)
+        return np.asarray(out, np.int32)
+
     # ------------------------------------------------------------- publish --
     def publish(self, tokens, block_ids, committed_tokens: int,
                 digests: Optional[List[bytes]] = None) -> int:
@@ -182,7 +229,9 @@ class PrefixCache:
                     break  # cap reached and nothing evictable: stop indexing
                 block = int(block_ids[i])
                 self._kv.incref([block])
-                child = _Node(digest, block, node)
+                child = _Node(digest, block, node,
+                              tokens=np.array(tokens[i * bs:(i + 1) * bs],
+                                              np.int32, copy=True))
                 node.children[digest] = child
                 self._by_digest[digest] = child
                 added += 1

@@ -65,6 +65,20 @@ class DSSequenceDescriptor:
         self._seen_tokens += self._in_flight_tokens
         self._in_flight_tokens = 0
 
+    def rollback(self, n_tokens: int) -> None:
+        """Forget the last ``n_tokens`` committed tokens (write-then-truncate):
+        their KV stays in place and is overwritten when the correct tokens are
+        fed at those positions — the speculative-verify rejection path. The
+        blocks stay allocated; only the committed count moves."""
+        n_tokens = int(n_tokens)
+        if self._in_flight_tokens:
+            raise RuntimeError(f"sequence {self.tracking_id}: rollback with "
+                               f"{self._in_flight_tokens} in-flight tokens")
+        if n_tokens < 0 or n_tokens > self._seen_tokens:
+            raise ValueError(f"rollback({n_tokens}) with {self._seen_tokens} "
+                             f"committed tokens")
+        self._seen_tokens -= n_tokens
+
 
 class PlaceholderSequenceDescriptor(DSSequenceDescriptor):
     """Ephemeral stand-in used by ``engine.query``/``can_schedule`` for uids the

@@ -1,0 +1,12 @@
+"""Speculative decoding: model-free drafters for the ragged decode path.
+
+The drafter proposes up to ``k`` cheap draft tokens per sequence per decode
+step; the engine's verify step (``engine_v2.verify``) prices all ``1+k``
+positions in ONE ragged forward and the scheduler accepts the longest
+matching prefix — >1 token per decode dispatch on repetitive text, exact
+spec-off equivalence always.
+"""
+
+from deepspeed_tpu.inference.v2.spec.drafter import PromptLookupDrafter
+
+__all__ = ["PromptLookupDrafter"]

@@ -27,6 +27,7 @@ FLASH_B, FLASH_S, FLASH_H, FLASH_D = 1, 128, 4, 32
 DECODE_STEPS = 8
 PREFIX_TOKENS, SUFFIX_TOKENS = 192, 24
 KV_BLOCK = 16
+SPEC_DRAFT_K = 3  # verify feed width 1+k pads into the smallest token bucket
 
 
 @dataclass
@@ -159,6 +160,21 @@ def _build_paged_decode_step() -> BuiltProgram:
                         meta={"n_steps": DECODE_STEPS, "kv_block_size": KV_BLOCK})
 
 
+def _build_spec_verify_step() -> BuiltProgram:
+    """The speculative-decoding verify program: one ragged forward scoring a
+    next-input token plus SPEC_DRAFT_K drafts per sequence (every position
+    unembedded). Built at the smallest pad bucket — the same bucket a
+    single-token decode forward runs in, which IS the speculative claim: 1+k
+    verified positions for the dispatch cost of one step."""
+    engine, _ = build_v2_engine()
+    return BuiltProgram(
+        name="spec_verify_step", lowered=engine.lower_verify_step(),
+        meta={"draft_tokens": SPEC_DRAFT_K, "feed_width": 1 + SPEC_DRAFT_K,
+              "kv_block_size": KV_BLOCK,
+              "note": "all-position unembed over the smallest decode bucket"},
+        comparisons={"single_token_forward": engine.lower_forward()})
+
+
 def _build_int4_decode_matmul() -> BuiltProgram:
     engine, _ = build_v2_engine(quant_bits=4)
     bf16_engine, _ = build_v2_engine(quant_bits=None)
@@ -197,6 +213,7 @@ FLAGSHIP_PROGRAMS: Dict[str, Callable[[], BuiltProgram]] = {
     "zero3_train_batch": _build_zero3_train_batch,
     "flash_attention_fwd_bwd": _build_flash_fwd_bwd,
     "paged_decode_step": _build_paged_decode_step,
+    "spec_verify_step": _build_spec_verify_step,
     "int4_decode_matmul": _build_int4_decode_matmul,
     "prefix_suffix_prefill": _build_prefix_suffix_prefill,
 }

@@ -18,7 +18,7 @@ Usage::
 """
 
 from deepspeed_tpu.serving.config import (OverloadConfig, PrefixCacheConfig,
-                                          ServingConfig)
+                                          ServingConfig, SpeculativeConfig)
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.overload import (PRIORITIES, BrownoutController,
                                             RateEstimator)
@@ -29,8 +29,8 @@ from deepspeed_tpu.serving.scheduler import (AdmissionRejected, QueueFullError,
 from deepspeed_tpu.serving.server import ServingServer
 
 __all__ = [
-    "OverloadConfig", "PrefixCacheConfig", "PRIORITIES", "BrownoutController",
-    "RateEstimator",
+    "OverloadConfig", "PrefixCacheConfig", "SpeculativeConfig", "PRIORITIES",
+    "BrownoutController", "RateEstimator",
     "ServingConfig", "ServingMetrics", "Request", "RequestState", "TERMINAL_STATES",
     "TokenStream", "ServingScheduler", "AdmissionRejected", "QueueFullError",
     "SchedulerStopped", "ServingServer",

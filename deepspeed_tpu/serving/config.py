@@ -38,6 +38,52 @@ class PrefixCacheConfig(DeepSpeedConfigModel):
     shorter matches prefill cold."""
 
 
+class SpeculativeConfig(DeepSpeedConfigModel):
+    """Speculative decoding via model-free self-drafting
+    (``inference/v2/spec/``): a prompt-lookup drafter proposes up to
+    ``max_draft_tokens`` continuation tokens per sequence per decode step
+    (mined from the prefix-cache trie when one is attached, else from the
+    request's own history); the engine verifies all ``1+k`` positions in ONE
+    ragged forward and the scheduler accepts the longest matching prefix.
+    Output is token-identical to non-speculative decoding at the same seed —
+    greedy and sampled — the only effect is fewer decode dispatches on
+    repetitive text. Off by default."""
+
+    enabled: bool = False
+    """Draft at batch-build time and run multi-token verify feeds through
+    the decode path."""
+
+    max_draft_tokens: int = Field(4, ge=1)
+    """Upper bound on draft tokens per sequence per step (k). The effective k
+    adapts per request from a measured acceptance EWMA and reaches 0 on
+    adversarial (pattern-free) text."""
+
+    min_ngram: int = Field(1, ge=1)
+    max_ngram: int = Field(3, ge=1)
+    """Self-lookup n-gram window: the drafter matches the longest history
+    suffix between these bounds against earlier occurrences."""
+
+    accept_alpha: float = Field(0.5, gt=0, le=1)
+    """EWMA smoothing for the per-request acceptance rate that drives the
+    adaptive k (higher = faster back-off AND faster recovery)."""
+
+    probe_interval: int = Field(16, ge=1)
+    """At k=0 (acceptance collapsed), propose a single probe draft every this
+    many decode steps so acceptance can recover when the text turns
+    repetitive again."""
+
+    draft_token_budget: Optional[int] = Field(None, ge=1)
+    """Cap on draft tokens per batch (they compete with prefill chunks under
+    the ragged token budget); None = bounded only by that budget. Brownout
+    stage >= 2 zeroes the budget regardless."""
+
+    @model_validator(mode="after")
+    def _ngram_ordered(self):
+        if self.max_ngram < self.min_ngram:
+            raise ValueError("max_ngram must be >= min_ngram")
+        return self
+
+
 class OverloadConfig(DeepSpeedConfigModel):
     """Overload control (``serving/overload.py``): priority admission,
     deadline-aware shedding and staged brownout degradation. Enabled by
@@ -164,6 +210,10 @@ class ServingConfig(DeepSpeedConfigModel):
     prefix_cache: PrefixCacheConfig = PrefixCacheConfig()
     """Automatic prefix caching over the paged KV cache (radix-tree reuse +
     copy-on-write sharing); see :class:`PrefixCacheConfig`."""
+
+    speculative: SpeculativeConfig = SpeculativeConfig()
+    """Speculative decoding (model-free self-drafting + batch-wide verify);
+    see :class:`SpeculativeConfig`."""
 
     overload: OverloadConfig = OverloadConfig()
     """Overload control: priority admission, deadline-aware shedding, staged

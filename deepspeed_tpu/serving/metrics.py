@@ -60,6 +60,27 @@ class ServingMetrics:
         self.prefix_evictions = registry.counter(
             "serving_prefix_evictions_total",
             "Prefix-trie leaves evicted (LRU) under KV pressure or the trie cap")
+        # speculative decoding (inference/v2/spec/ + the scheduler's verify
+        # execute path)
+        self.spec_drafted = registry.counter(
+            "serving_spec_draft_tokens_total",
+            "Draft tokens proposed into speculative verify feeds")
+        self.spec_accepted = registry.counter(
+            "serving_spec_accepted_tokens_total",
+            "Draft tokens the target model's verify step accepted")
+        self.spec_verify_steps = registry.counter(
+            "serving_spec_verify_steps_total",
+            "Decode dispatches that carried at least one draft token")
+        self.spec_rollback = registry.counter(
+            "serving_spec_rollback_tokens_total",
+            "Rejected draft positions truncated from committed KV (write-then-truncate)")
+        self.spec_accept_rate = registry.gauge(
+            "serving_spec_accept_rate",
+            "EWMA of the speculative acceptance rate across verify steps")
+        self.spec_tokens_per_step = registry.histogram(
+            "serving_spec_tokens_per_step",
+            "Tokens emitted per speculative verify step (1 = nothing accepted)",
+            buckets=(1, 2, 3, 4, 6, 8, 12, 16))
         # overload control (serving/overload.py + scheduler admission/shed)
         self.shed_admission = registry.counter(
             "serving_shed_admission_total",

@@ -154,6 +154,13 @@ class Request:
         self.shed_reason: Optional[str] = None
         self.retry_after_s: Optional[float] = None
         self.degraded_mode: List[str] = []
+        # speculative decoding (inference/v2/spec/): per-request drafting
+        # stats and the acceptance EWMA driving the adaptive k. The EWMA is
+        # the drafter state a fleet handoff carries so a decode-role peer
+        # continues adaptation where the donor stopped.
+        self.spec_drafted = 0     # draft tokens proposed into verify feeds
+        self.spec_accepted = 0    # of those, accepted by the target model
+        self.decode_steps = 0     # decode dispatches this request consumed
 
         self.arrival_s = time.monotonic()
         self.arrival_us = now_us()  # span-clock arrival (perf_counter domain)
@@ -173,6 +180,11 @@ class Request:
         self._last_touch_s = self.arrival_s  # eviction coldness ordering
         self._last_token_s: Optional[float] = None  # ITL measurement
         self._rng: Optional[np.random.Generator] = None
+        self._spec_ewma: Optional[float] = None  # acceptance EWMA (None = cold)
+        # drafting history buffer (prompt + generated), grown incrementally by
+        # the scheduler so per-step drafting copies O(new tokens), not O(all)
+        self._spec_history: Optional[np.ndarray] = None
+        self._spec_history_len = 0
 
     # ----------------------------------------------------------------- state --
     @property

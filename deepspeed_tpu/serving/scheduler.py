@@ -115,7 +115,8 @@ class ServingScheduler:
                            "timed_out", "failed", "evictions", "batches", "heartbeats",
                            "prefix_hits", "prefix_tokens_saved", "prefix_evictions",
                            "shed_admission", "shed_queue", "brownout_rejected",
-                           "brownout_clamped")}
+                           "brownout_clamped", "spec_drafted", "spec_accepted",
+                           "spec_steps", "spec_rollback")}
         self._stopping = False   # no new submits
         self._shutdown = False   # thread exit
         self._stopped = False
@@ -154,6 +155,21 @@ class ServingScheduler:
                 engine._state_manager.kv_cache,
                 max_blocks=self._config.prefix_cache.max_blocks,
                 min_prefix_blocks=self._config.prefix_cache.min_prefix_blocks)
+
+        # speculative decoding (inference/v2/spec/): a model-free drafter
+        # proposes k continuation tokens per decode step at batch-build time;
+        # the engine verifies 1+k positions in one ragged forward and the
+        # execute path accepts the longest matching prefix. Trie-backed when
+        # the prefix cache runs (the trie holds exactly the token histories a
+        # prompt-lookup drafter wants to mine), self-lookup otherwise.
+        self._drafter = None
+        self._spec_accept_ewma: Optional[float] = None
+        if self._config.speculative.enabled:
+            from deepspeed_tpu.inference.v2.spec import PromptLookupDrafter
+            scfg = self._config.speculative
+            self._drafter = PromptLookupDrafter(min_ngram=scfg.min_ngram,
+                                                max_ngram=scfg.max_ngram,
+                                                prefix_cache=self._prefix_cache)
 
         engine._serving_scheduler = self
         # armed last: flight_state() must never observe a half-built
@@ -282,6 +298,15 @@ class ServingScheduler:
             # reseed — sampled handoffs stay token-identical
             req._rng = np.random.default_rng()
             req._rng.bit_generator.state = rng_state
+        req.decode_steps = int(extra.get("decode_steps") or 0)
+        spec = extra.get("spec")
+        if spec:
+            # drafter continuation: adopt the donor's acceptance EWMA and
+            # counters so adaptive k resumes where it left off
+            ewma = spec.get("accept_ewma")
+            req._spec_ewma = float(ewma) if ewma is not None else None
+            req.spec_drafted = int(spec.get("drafted") or 0)
+            req.spec_accepted = int(spec.get("accepted") or 0)
         return self._enqueue(req, trace_id, parent_span_id, handoff)
 
     def _enqueue(self, req: Request, trace_id: Optional[str],
@@ -381,9 +406,12 @@ class ServingScheduler:
                 self._counters["brownout_clamped"] += 1
                 if self._metrics:
                     self._metrics.brownout_clamped.inc()
-        if stage >= 2 and self._config.decode_chunk > 1:
-            # the speculative decode chunk is globally off at stage >= 2;
-            # flagged per affected request so no degradation is silent
+        if stage >= 2 and (self._config.decode_chunk > 1
+                           or self._config.speculative.enabled):
+            # speculative extras — the decode chunk AND the draft budget —
+            # are globally off at stage >= 2 (the first capacity lever that
+            # touches no request's token budget); flagged per affected
+            # request so no degradation is silent
             req.degraded_mode.append("speculative_disabled")
         if ocfg.admission_control and req.deadline_s is not None:
             own = self._request_work(req)
@@ -731,6 +759,94 @@ class ServingScheduler:
             [req.prompt, np.asarray(req.tokens, np.int32)]) if req.tokens else req.prompt
         self._publish(req, seq, history, min(seq.seen_tokens, history.size))
 
+    # ---------------------------------------------------- speculative decode --
+    def _spec_draft_budget(self) -> int:
+        """Draft tokens this batch may spend (0 = drafting off this tick).
+        Brownout stage >= 2 zeroes the budget — speculation is the first
+        capacity lever pulled under overload, before anything clamps a
+        request's own token budget."""
+        if self._drafter is None:
+            return 0
+        if self._config.overload.enabled and self._brownout.stage >= 2:
+            return 0
+        budget = self._config.speculative.draft_token_budget
+        return budget if budget is not None else (1 << 30)
+
+    def _spec_k(self, req: Request) -> int:
+        """Per-request adaptive draft depth: the acceptance EWMA scales
+        ``max_draft_tokens`` down to 0 on adversarial (pattern-free) text —
+        bounded regression — with a periodic single-token probe so acceptance
+        can recover when the text turns repetitive again."""
+        scfg = self._config.speculative
+        ewma = req._spec_ewma
+        k = (scfg.max_draft_tokens if ewma is None
+             else int(scfg.max_draft_tokens * ewma + 0.5))
+        if k == 0 and req.decode_steps % scfg.probe_interval == 0:
+            k = 1
+        return k
+
+    @staticmethod
+    def _history_for(req: Request) -> np.ndarray:
+        """The request's token history (prompt + generated) as a read-only
+        view over an incrementally-grown buffer: each decode tick copies only
+        the newly-pushed tokens, not the whole history — per-token drafting
+        cost stays O(new), not O(length)."""
+        n = int(req.prompt.size) + len(req.tokens)
+        buf = req._spec_history
+        if buf is None or n > buf.size:
+            grown = np.empty(max(64, 2 * n), np.int32)
+            if buf is None:
+                grown[:req.prompt.size] = req.prompt
+                req._spec_history_len = int(req.prompt.size)
+            else:
+                grown[:req._spec_history_len] = buf[:req._spec_history_len]
+            req._spec_history = buf = grown
+        if req._spec_history_len < n:
+            tail = req.tokens[req._spec_history_len - int(req.prompt.size):]
+            buf[req._spec_history_len:n] = tail
+            req._spec_history_len = n
+        return buf[:n]
+
+    def _draft_for(self, req: Request, k: int) -> np.ndarray:
+        """Up to ``k`` proposed continuation tokens for ``req`` (scheduler
+        thread). History = prompt + everything generated; the admission-time
+        digest chain is extended (never recomputed) so the trie walk hashes
+        only newly-completed blocks."""
+        history = self._history_for(req)
+        digests = None
+        if self._prefix_cache is not None:
+            req._prefix_digests = self._prefix_cache.chain(
+                history, base=req._prefix_digests)
+            digests = req._prefix_digests
+        return self._drafter.draft(history, k, digests=digests)
+
+    def _spec_accept(self, req: Request, feed: np.ndarray, rows: np.ndarray):
+        """The acceptance rule over one verify feed. ``rows[j]`` scores the
+        token after ``feed[:j+1]``; the emitted sequence is EXACTLY what
+        non-speculative decoding would produce: each emitted token is sampled
+        (or argmaxed) from the target distribution with the request's own
+        stream — one draw per emitted token, same draw order as spec-off — and
+        a draft survives only when it equals that token (rejection sampling
+        with a point-mass draft distribution). Returns ``(emitted,
+        accepted_drafts)``; emission stops at eos / the generation cap,
+        mirroring :meth:`_push_token`'s rules."""
+        emitted: List[int] = []
+        accepted = 0
+        k = int(feed.size) - 1
+        for j in range(int(feed.size)):
+            tok = self._sample(req, rows[j])
+            emitted.append(tok)
+            if req.eos_token_id is not None and tok == req.eos_token_id:
+                break
+            if len(req.tokens) + len(emitted) >= req.max_new_tokens:
+                break
+            if j >= k:
+                break  # the bonus token: no more drafts to validate
+            if int(feed[j + 1]) != tok:
+                break  # rejection: the target model disagrees with the draft
+            accepted += 1
+        return emitted, accepted
+
     def _permanently_infeasible(self, req: Request) -> Optional[str]:
         """A reason this request can NEVER be scheduled, or None. Failing at
         admission beats starving it forever against budgets that will not
@@ -792,7 +908,9 @@ class ServingScheduler:
             # this a permanently-admitted peer could starve a deferred one
             return sorted(reqs, key=lambda r: (-r._deferred, r.uid))
 
-        # --- decode tokens first: one each, latency-critical
+        # --- decode tokens first: one each (plus up to k draft tokens when
+        # speculation is on), latency-critical
+        draft_budget = self._spec_draft_budget()
         for req in by_pressure_priority(
                 [r for r in list(self._active.values()) if r.state is RequestState.DECODE]):
             if len(lens) + 1 > sm_cfg.max_ragged_sequence_count or sum(lens) + 1 > budget:
@@ -803,7 +921,29 @@ class ServingScheduler:
                 req.finish_reason = "context"
                 self._finalize(req, RequestState.DONE)
                 continue
-            if admit_under_pressure(req, 1):
+            feed = None
+            if draft_budget > 0:
+                # draft tokens compete with prefill chunks under the same
+                # ragged token budget; never draft past the generation cap or
+                # the context window (the device commits every fed position)
+                k = min(self._spec_k(req), draft_budget,
+                        budget - sum(lens) - 1,
+                        req.max_new_tokens - len(req.tokens) - 1)
+                if seq is not None:
+                    k = min(k, sm_cfg.max_context - seq.seen_tokens - 1)
+                if k > 0:
+                    draft = self._draft_for(req, k)
+                    if draft.size:
+                        feed = np.concatenate(
+                            [np.asarray([req._next], np.int32), draft])
+            if feed is not None and \
+                    admission(req.uid, int(feed.size)) == SchedulingResult.Success:
+                # drafts are speculative: they never trigger eviction — a feed
+                # the pool can't take falls back to the k=0 single token below
+                req._deferred = 0
+                admit(req, feed)
+                draft_budget -= int(feed.size) - 1
+            elif admit_under_pressure(req, 1):
                 req._deferred = 0
                 admit(req, [req._next])
             else:
@@ -888,6 +1028,13 @@ class ServingScheduler:
                              args={"uid": req.uid,
                                    "tokens": ntok if counts is None else counts[i]})
 
+        # speculative verify: any decode feed wider than one token (next
+        # input + draft tokens) routes the tick through the verify path
+        if any(req.state is RequestState.DECODE and toks.size > 1
+               for req, toks in plan):
+            self._execute_verify(plan, _record_phase_spans)
+            return
+
         K = self._config.decode_chunk
         if K > 1 and self._config.overload.enabled and self._brownout.stage >= 2:
             K = 1  # brownout stage >= 2: speculative extras disabled
@@ -919,22 +1066,9 @@ class ServingScheduler:
                 self._rate.observe(sum(counts))
                 _record_phase_spans(counts=counts)
                 for (req, _), row, kept in zip(plan, rows, counts):
-                    prev = req._last_token_s
-                    pushed = 0
-                    for tok in row[:kept]:  # eos/cap discard the over-generated tail
-                        self._push_token(req, int(tok), record_itl=False)
-                        pushed += 1
-                        if req.finished:
-                            break  # _push_token's rules stay the authority
-                    if not req.finished:
-                        req._next = int(row[kept - 1])
-                    if self._metrics and prev is not None and pushed:
-                        # the chunk arrives as one burst: record the dispatch
-                        # gap amortized per token, so ITL reflects the cadence
-                        # a client sees rather than the microsecond host loop
-                        gap = (req._last_token_s - prev) / pushed
-                        for _ in range(pushed):
-                            self._metrics.itl.observe(gap)
+                    req.decode_steps += 1
+                    # eos/cap discard the over-generated tail
+                    self._push_burst(req, row[:kept])
                 return
 
         try:
@@ -949,21 +1083,127 @@ class ServingScheduler:
         _record_phase_spans()
         for i, (req, toks) in enumerate(plan):
             if req.state is RequestState.PREFILL:
-                req._fed += toks.size
-                if req._fed < req.prompt.size:
-                    continue  # mid-prefill logits are meaningless
-                req._set_state(RequestState.DECODE)
-                if self._prefix_cache is not None:
-                    # publish the prompt's blocks NOW: its KV is fully
-                    # committed, and peers sharing the prefix are likely
-                    # already queued behind it (the burst shape)
-                    seq = engine._state_manager.get_sequence(req.uid)
-                    if seq is not None:
-                        self._publish(req, seq, req.prompt, seq.seen_tokens)
-            nxt = self._sample(req, logits[i])
-            self._push_token(req, nxt)
-            if not req.finished:
-                req._next = nxt
+                self._advance_prefill(req, toks, logits[i])
+            else:
+                req.decode_steps += 1
+                nxt = self._sample(req, logits[i])
+                self._push_token(req, nxt)
+                if not req.finished:
+                    req._next = nxt
+
+    def _advance_prefill(self, req: Request, toks: np.ndarray, last_row) -> None:
+        """Account one executed prefill chunk; on the final chunk: flip to
+        DECODE, publish the prompt's blocks (peers sharing the prefix are
+        likely already queued behind it — the burst shape), and emit the
+        first token from the chunk's final-position logits. Shared by the
+        put and verify execute paths so prefill behavior cannot depend on
+        whether a draft rode the same batch."""
+        req._fed += toks.size
+        if req._fed < req.prompt.size:
+            return  # mid-prefill logits are meaningless
+        req._set_state(RequestState.DECODE)
+        if self._prefix_cache is not None:
+            seq = self._engine._state_manager.get_sequence(req.uid)
+            if seq is not None:
+                self._publish(req, seq, req.prompt, seq.seen_tokens)
+        nxt = self._sample(req, last_row)
+        self._push_token(req, nxt)
+        if not req.finished:
+            req._next = nxt
+
+    def _push_burst(self, req: Request, toks) -> None:
+        """Stream a multi-token burst (a decode chunk's kept tokens, a verify
+        step's emitted run): pushes honor :meth:`_push_token`'s finish rules,
+        ``req._next`` advances to the last pushed token, and the dispatch gap
+        is amortized per token so ITL reflects the cadence a client sees
+        rather than the microsecond host loop."""
+        prev = req._last_token_s
+        pushed = 0
+        for tok in toks:
+            self._push_token(req, int(tok), record_itl=False)
+            pushed += 1
+            if req.finished:
+                break  # _push_token's rules stay the authority
+        if not req.finished and pushed:
+            req._next = int(toks[pushed - 1])
+        if self._metrics and prev is not None and pushed:
+            gap = (req._last_token_s - prev) / pushed
+            for _ in range(pushed):
+                self._metrics.itl.observe(gap)
+
+    def _execute_verify(self, plan: List[Tuple[Request, np.ndarray]],
+                        record_spans) -> None:
+        """Execute a tick containing speculative verify feeds. The decode
+        entries (each a next-input token plus k drafts) run through ONE
+        ``engine.verify`` dispatch; prefill chunks sharing the tick run
+        through their normal ``engine.put`` — a prefill bucket must not pay
+        the verify program's all-position unembed (and a [T, vocab] logits
+        transfer at prefill widths) for a peer's draft. Each decode entry
+        accepts its longest matching draft prefix, rolls the rejected tail
+        back (write-then-truncate on ``seen_tokens``) and streams the
+        emitted tokens."""
+        engine = self._engine
+        decode_plan = [(req, toks) for req, toks in plan
+                       if req.state is not RequestState.PREFILL]
+        prefill_plan = [(req, toks) for req, toks in plan
+                        if req.state is RequestState.PREFILL]
+        try:
+            per_seq = engine.verify([req.uid for req, _ in decode_plan],
+                                    [toks for _, toks in decode_plan])
+            prefill_logits = (np.asarray(engine.put(
+                [req.uid for req, _ in prefill_plan],
+                [toks for _, toks in prefill_plan])) if prefill_plan else None)
+        except Exception as e:  # pragma: no cover - defensive: same contract
+            # as the put path — the scheduler thread must survive
+            logger.exception("serving: engine verify tick failed; failing the batch")
+            for req, _ in plan:
+                self._finalize(req, RequestState.FAILED, error=f"engine error: {e}")
+            return
+        # the estimator measures engine-token throughput: verify feeds cost
+        # their full width (accepted or not), like any other fed token
+        self._rate.observe(sum(int(t.size) for _, t in plan))
+        alpha = self._config.speculative.accept_alpha
+        # sample/accept BEFORE any push: span token counts must be final when
+        # the root span closes, and each request's private stream makes the
+        # per-request draw order independent of processing order
+        accepts = {id(req): self._spec_accept(req, toks, rows)
+                   for (req, toks), rows in zip(decode_plan, per_seq)}
+        record_spans(counts=[len(accepts[id(req)][0]) if id(req) in accepts
+                             else int(toks.size) for req, toks in plan])
+        for (req, toks), rows in zip(decode_plan, per_seq):
+            emitted, accepted = accepts[id(req)]
+            k = int(toks.size) - 1
+            rejected = int(toks.size) - len(emitted)
+            # rollback BEFORE pushing: a push may finalize, and the handoff
+            # export / trie publish there must see the truncated seen_tokens
+            # (= full history - 1, the same invariant every other path keeps)
+            engine.rollback(req.uid, rejected)
+            req.decode_steps += 1
+            if k:
+                # a k=0 feed riding a verify batch proposed nothing — no
+                # acceptance evidence, no EWMA movement
+                req.spec_drafted += k
+                req.spec_accepted += accepted
+                self._counters["spec_steps"] += 1
+                self._counters["spec_drafted"] += k
+                self._counters["spec_rollback"] += rejected
+                self._counters["spec_accepted"] += accepted
+                rate = accepted / k
+                req._spec_ewma = (rate if req._spec_ewma is None
+                                  else alpha * rate + (1 - alpha) * req._spec_ewma)
+                self._spec_accept_ewma = (rate if self._spec_accept_ewma is None
+                                          else alpha * rate
+                                          + (1 - alpha) * self._spec_accept_ewma)
+                if self._metrics:
+                    self._metrics.spec_verify_steps.inc()
+                    self._metrics.spec_drafted.inc(k)
+                    self._metrics.spec_accepted.inc(accepted)
+                    self._metrics.spec_rollback.inc(rejected)
+                    self._metrics.spec_accept_rate.set(self._spec_accept_ewma or 0.0)
+                    self._metrics.spec_tokens_per_step.observe(len(emitted))
+            self._push_burst(req, emitted)
+        for i, (req, toks) in enumerate(prefill_plan):
+            self._advance_prefill(req, toks, prefill_logits[i])
 
     @staticmethod
     def _kept_tokens(req: Request, row) -> int:
@@ -1026,6 +1266,16 @@ class ServingScheduler:
             extra["next_token"] = int(req.tokens[-1])
         if req._rng is not None:
             extra["rng_state"] = req._rng.bit_generator.state
+        # the dispatch count rides every handoff (tokens-per-step accounting
+        # must survive the migration whether or not the donor ever drafted)
+        extra["decode_steps"] = req.decode_steps
+        if req._spec_ewma is not None or req.spec_drafted:
+            # drafter state rides the handoff: the decode-role peer continues
+            # the acceptance adaptation exactly where the donor stopped (no
+            # cold re-probe tax on a mid-stream migration)
+            extra["spec"] = {"accept_ewma": req._spec_ewma,
+                             "drafted": req.spec_drafted,
+                             "accepted": req.spec_accepted}
         tokens = [int(t) for t in req.prompt.tolist()] + [int(t) for t in req.tokens]
         # chunked greedy decode feeds the device ahead of the kept history (a
         # mid-chunk cap leaves the last kept token — and discarded over-run —
@@ -1274,6 +1524,22 @@ class ServingScheduler:
                          for q in (0.5, 0.95, 0.99)}
         return out
 
+    def _spec_stats(self) -> Optional[dict]:
+        if self._drafter is None:
+            return None
+        drafted = self._counters["spec_drafted"]
+        return {
+            "enabled": True,
+            "drafted": drafted,
+            "accepted": self._counters["spec_accepted"],
+            "accept_rate": (self._counters["spec_accepted"] / drafted
+                            if drafted else 0.0),
+            "accept_ewma": self._spec_accept_ewma,
+            "verify_steps": self._counters["spec_steps"],
+            "rollback_tokens": self._counters["spec_rollback"],
+            "max_draft_tokens": self._config.speculative.max_draft_tokens,
+        }
+
     def stats(self) -> dict:
         queued, active = self._snapshot_requests()
         return self._stats_doc(queued, active)
@@ -1297,6 +1563,7 @@ class ServingScheduler:
             },
             "prefix_cache": (self._prefix_cache.stats()
                              if self._prefix_cache is not None else None),
+            "speculative": self._spec_stats(),
             "overload": {
                 "enabled": self._config.overload.enabled,
                 "brownout_stage": self._brownout.stage,

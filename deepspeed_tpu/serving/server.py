@@ -126,6 +126,7 @@ def _request_doc(req: Request, raw_handoff: bool = False) -> dict:
         "tokens": list(req.tokens),
         "n_tokens": len(req.tokens),
         "cached_tokens": req.cached_tokens,
+        "decode_steps": req.decode_steps,
         "state": req.state.name,
         "finish_reason": req.finish_reason,
         "error": req.error,
@@ -134,6 +135,12 @@ def _request_doc(req: Request, raw_handoff: bool = False) -> dict:
         "trace_id": req.trace_id,
         "priority": req.priority,
     }
+    if req.spec_drafted:
+        # speculative decoding rode this request: drafted/accepted let a
+        # client (and the loadgen --spec-demo report) compute acceptance rate
+        # and tokens-per-step without scraping /v1/stats
+        doc["spec"] = {"drafted": req.spec_drafted,
+                       "accepted": req.spec_accepted}
     if req.degraded_mode:
         # brownout degradations applied to THIS request — never silent
         doc["degraded_mode"] = list(req.degraded_mode)

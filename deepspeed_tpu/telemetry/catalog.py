@@ -74,6 +74,14 @@ METRIC_FAMILIES = {
     "serving_prefix_tokens_saved_total": "prompt tokens served from cached KV instead of prefilled",
     "serving_prefix_trie_blocks": "device KV blocks pinned by the prefix trie",
     "serving_prefix_evictions_total": "prefix-trie leaves evicted (LRU) under KV pressure or the trie cap",
+    # speculative decoding (serving/metrics.py over inference/v2/spec/ and
+    # the scheduler's verify execute path)
+    "serving_spec_draft_tokens_total": "draft tokens proposed into speculative verify feeds",
+    "serving_spec_accepted_tokens_total": "draft tokens the target model's verify step accepted",
+    "serving_spec_verify_steps_total": "decode dispatches that carried at least one draft token",
+    "serving_spec_rollback_tokens_total": "rejected draft positions truncated from committed KV",
+    "serving_spec_accept_rate": "EWMA of the speculative acceptance rate across verify steps",
+    "serving_spec_tokens_per_step": "tokens emitted per speculative verify step (1 = nothing accepted)",
     # overload control (serving/metrics.py over serving/overload.py)
     "serving_shed_admission_total": "requests rejected at admission: deadline provably unmeetable",
     "serving_shed_queue_total": "queued requests shed under sustained overload pressure",

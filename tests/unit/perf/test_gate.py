@@ -60,6 +60,22 @@ def test_paged_decode_step_structure(built_results):
     assert result.roofline["fits_hbm"]
 
 
+def test_spec_verify_step_costs_one_forward(built_results):
+    built, result = built_results["spec_verify_step"]
+    single = stats_from_lowered(built.comparisons["single_token_forward"],
+                                name="single_token_forward")
+    # the speculative claim, chip-independently: scoring a next-input token
+    # plus k drafts is ONE forward at the same pad bucket — within noise of
+    # the single-token decode step, nowhere near (1+k) sequential steps
+    k1 = built.meta["feed_width"]
+    assert result.stats.flops <= 1.10 * single.flops, \
+        (result.stats.flops, single.flops)
+    assert result.stats.flops < 0.5 * k1 * single.flops
+    # all-position unembed: the verify program returns more logits bytes
+    assert result.stats.output_bytes >= single.output_bytes
+    assert result.stats.f32_dot_count == 0
+
+
 def test_int4_decode_matmul_beats_bf16_weight_bytes(built_results):
     built, result = built_results["int4_decode_matmul"]
     bf16 = stats_from_lowered(built.comparisons["bf16_forward"], name="bf16_forward")

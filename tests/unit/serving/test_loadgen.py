@@ -8,7 +8,8 @@ import sys
 import pytest
 
 from deepspeed_tpu.serving import (PrefixCacheConfig, ServingConfig,
-                                   ServingScheduler, ServingServer)
+                                   ServingScheduler, ServingServer,
+                                   SpeculativeConfig)
 
 BIN = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))), "bin")
@@ -74,6 +75,35 @@ def test_loadgen_shared_prefix_reports_cache_effectiveness(make_engine, llama_se
         assert saved >= hits * 31
         pc = sched.stats()["prefix_cache"]
         assert pc["hits"] == hits and pc["lookups"] == 8
+    finally:
+        srv.stop(drain=False)
+
+
+def test_loadgen_spec_demo_reports_acceptance(make_engine, llama_setup):
+    """--spec-demo against a speculation-enabled server: each group's first
+    request publishes the trie, repeats decode off mined drafts; the report
+    carries acceptance rate, tokens/step, and the first/repeat ITL split."""
+    cfg, _, _ = llama_setup
+    sched = ServingScheduler(
+        make_engine(block_size=4),
+        ServingConfig(prefix_cache=PrefixCacheConfig(enabled=True),
+                      speculative=SpeculativeConfig(enabled=True,
+                                                    max_draft_tokens=4)))
+    srv = ServingServer(sched).start()
+    try:
+        r = _loadgen("--url", srv.url, "--requests", "6", "--mode", "closed",
+                     "--concurrency", "1", "--spec-demo", "16:2",
+                     "--max-new-tokens", "10",
+                     "--vocab-size", str(cfg.vocab_size))
+        assert r.returncode == 0, r.stderr[-800:]
+        assert "ok=6 err=0" in r.stdout
+        assert "# speculative: accept_rate=" in r.stdout, r.stdout
+        accepted = int(r.stdout.split("accept_rate=")[1]
+                       .split("(")[1].split("/")[0])
+        assert accepted > 0  # repeats really decoded off accepted drafts
+        spec = sched.stats()["speculative"]
+        assert spec["accepted"] == accepted
+        assert spec["verify_steps"] > 0
     finally:
         srv.stop(drain=False)
 
