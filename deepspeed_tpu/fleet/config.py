@@ -102,6 +102,57 @@ class HedgeConfig(DeepSpeedConfigModel):
     latency-shaped sibling of the failure-shaped circuit breaker."""
 
 
+class CacheRouteConfig(DeepSpeedConfigModel):
+    """Cache-aware placement (``fleet/router.py``): replicas publish a digest
+    catalog of their prefix-cache trie in the probe doc; the router hashes
+    the request's block-aligned prefix chain at admission and dispatches to
+    the replica holding the longest cached prefix. Staleness is bounded by
+    ``FleetConfig.probe_ttl_s`` — a stale hint costs one misrouted dispatch
+    that then misses locally, never correctness."""
+
+    enabled: bool = True
+    """False = ignore published digests (rendezvous/least-loaded only; the
+    hash-routing control arm of the routing A/B gate)."""
+
+    peer_fetch: bool = True
+    """On a local trie miss where a peer's catalog matches the request chain
+    deeper, fetch those KV blocks from the peer over the handoff frame
+    instead of recomputing them (``POST /v1/prefix/export``). CRC-covered:
+    a corrupt frame is rejected loudly and the prefill recomputes cold."""
+
+    fetch_timeout_s: float = Field(2.0, gt=0)
+    """Budget for one peer prefix fetch. Deliberately short: two in-process
+    replicas fetching from each other symmetrically would block both
+    scheduler loops; timing out degrades both sides to a cold prefill."""
+
+    min_match_blocks: int = Field(1, ge=1)
+    """Smallest digest-chain match (in blocks) that steers placement or
+    justifies a peer fetch; shorter matches are noise."""
+
+
+class StealConfig(DeepSpeedConfigModel):
+    """Cross-replica work stealing (``fleet/router.py``): a request that has
+    produced no token within the wait budget on a hot replica — still queued,
+    or early in decode — is claimed back (``POST /v1/steal``), exported
+    token-identically when mid-decode, and re-dispatched to a colder replica.
+    The hedged-dispatch shape (PR 14) moving work instead of duplicating it.
+    Off by default: stealing adds a dispatch round-trip by design."""
+
+    enabled: bool = False
+
+    wait_budget_s: float = Field(0.5, gt=0)
+    """No-first-token budget before the router considers stealing the leg."""
+
+    min_deadline_headroom_s: float = Field(2.0, ge=0)
+    """Only steal a request whose remaining deadline exceeds this (or that
+    carries no deadline): a steal costs a round-trip plus a re-dispatch, so
+    tight-deadline requests are left to the hedging machinery."""
+
+    load_ratio: float = Field(2.0, gt=1)
+    """The victim's probe load must exceed the target's by this factor for
+    the move to count as hot→cold; symmetric load never triggers a steal."""
+
+
 class AutoscaleConfig(DeepSpeedConfigModel):
     """Policy knobs for :class:`deepspeed_tpu.fleet.policy.FleetAutoscaler`."""
 
@@ -272,6 +323,19 @@ class FleetConfig(DeepSpeedConfigModel):
 
     hedge: HedgeConfig = HedgeConfig()
     """Hedged dispatch against slow-but-alive replicas."""
+
+    cache_route: CacheRouteConfig = CacheRouteConfig()
+    """Cache-aware placement over the replicas' published digest catalogs,
+    plus cross-replica prefix-KV fetch; see :class:`CacheRouteConfig`."""
+
+    steal: StealConfig = StealConfig()
+    """Cross-replica work stealing; see :class:`StealConfig`."""
+
+    kv_transport: Literal["binary", "base64"] = "binary"
+    """Preferred resume/handoff wire transport toward HTTP replicas:
+    ``binary`` streams the raw handoff frame (O(memcpy), auto-falls back per
+    replica when an upstream only speaks JSON); ``base64`` forces the legacy
+    JSON envelope everywhere (the zero-copy gate's control arm)."""
 
     overload: Optional[OverloadConfig] = None
     """Serving-layer overload control (``serving/config.OverloadConfig``)

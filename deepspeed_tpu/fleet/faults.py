@@ -47,7 +47,8 @@ from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 # every injection point the router consults; would_fire rejects unknown ones
 # so a typo'd hook cannot silently never fire
 POINTS = ("dispatch_delay", "connect_reset", "http_5xx", "stream_truncate",
-          "handoff_corrupt", "replica_kill", "decode_stall", "overload_burst")
+          "handoff_corrupt", "replica_kill", "decode_stall", "overload_burst",
+          "peer_fetch_corrupt", "steal_race")
 
 _EVENT_LOG_CAP = 512  # per injector, for the recovery report
 
@@ -83,6 +84,17 @@ class FaultConfig(DeepSpeedConfigModel):
 
     handoff_corrupt_p: float = Field(0.0, ge=0, le=1)
     replica_kill_p: float = Field(0.0, ge=0, le=1)
+
+    peer_fetch_corrupt_p: float = Field(0.0, ge=0, le=1)
+    """Per-peer-prefix-fetch probability of corrupting the fetched KV frame
+    in transit (byte flip in the CRC-covered region / truncation): the
+    importer must reject loudly and recompute cold, never publish a
+    corrupted block into its trie."""
+
+    steal_race_p: float = Field(0.0, ge=0, le=1)
+    """Per-steal probability that the victim finishes the request while the
+    steal decision is in flight: the router must keep the original leg and
+    complete exactly once (no duplicate tokens, no lost request)."""
 
     decode_stall_p: float = Field(0.0, ge=0, le=1)
     """Per-token probability of an injected stall on the leg's token stream
@@ -214,13 +226,16 @@ class FaultInjector:
         u = _uniform(self.config.seed, self._key("stream_truncate", scope), n, "len")
         return int(u * (self.config.stream_truncate_max_tokens + 1))
 
-    def corrupt(self, payload: bytes, n: int, scope: Optional[str] = None) -> bytes:
+    def corrupt(self, payload: bytes, n: int, scope: Optional[str] = None,
+                point: str = "handoff_corrupt") -> bytes:
         """A corrupted copy of ``payload`` for firing index ``n``: either a
         short (truncated) payload — the framing/length validation path — or
         one with a byte flipped inside the raw-KV region, which only the
         payload's ``kv_crc32`` can catch. Both shapes must be a loud
-        ``ValueError`` at unpack, never silently wrong attention."""
-        u = _uniform(self.config.seed, self._key("handoff_corrupt", scope), n, "mode")
+        ``ValueError`` at unpack, never silently wrong attention. The same
+        shape serves ``handoff_corrupt`` (prefill→decode hop) and
+        ``peer_fetch_corrupt`` (cross-replica prefix fetch) via ``point``."""
+        u = _uniform(self.config.seed, self._key(point, scope), n, "mode")
         if not payload:
             return payload
         if u < 0.5:  # short payload: framing/length validation path
@@ -237,7 +252,7 @@ class FaultInjector:
             import struct
             kv_off = min(len(bad) - 1,
                          frame + struct.unpack_from("<I", bad, len(MAGIC))[0])
-        pos = kv_off + _u64(self.config.seed, self._key("handoff_corrupt", scope),
+        pos = kv_off + _u64(self.config.seed, self._key(point, scope),
                             n, "pos") % max(1, len(bad) - kv_off)
         bad[min(pos, len(bad) - 1)] ^= 0xFF
         return bytes(bad)

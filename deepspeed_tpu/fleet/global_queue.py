@@ -58,10 +58,11 @@ class QueueWaitExpired(RuntimeError):
 
 class _Entry:
     __slots__ = ("seq", "priority", "deadline", "enq_s", "pool_fn",
-                 "session_key", "event", "replica", "phantom")
+                 "session_key", "event", "replica", "phantom", "hint")
 
     def __init__(self, pool_fn, priority: str, deadline: Optional[float],
-                 session_key: Optional[str], phantom: bool = False):
+                 session_key: Optional[str], phantom: bool = False,
+                 hint=None):
         self.seq = next(_ENTRY_SEQ)
         self.priority = priority
         self.deadline = deadline          # absolute monotonic, None = none
@@ -71,6 +72,7 @@ class _Entry:
         self.event = threading.Event()
         self.replica = None               # set under the queue lock at grant
         self.phantom = phantom
+        self.hint = hint                  # opaque placement hint for pick
 
     @property
     def order_key(self):
@@ -150,17 +152,20 @@ class GlobalQueue:
                 priority: str = "interactive",
                 deadline_s: Optional[float] = None,
                 session_key: Optional[str] = None,
-                timeout_s: float = 30.0):
+                timeout_s: float = 30.0,
+                hint=None):
         """Wait for a replica with a free slot (priority/deadline order);
         returns the granted replica, whose slot the caller MUST release via
         :meth:`release` when the leg finishes. ``deadline_s`` is the
         remaining client deadline: expiring while queued raises
         :class:`QueueWaitExpired` (router-level shedding, nothing dispatched).
+        ``hint`` is an opaque placement hint forwarded to ``pick`` at grant
+        time (cache-aware routing threads the request's prefix chain here).
         """
         now = time.monotonic()
         entry = _Entry(pool_fn, priority,
                        now + deadline_s if deadline_s is not None else None,
-                       session_key)
+                       session_key, hint=hint)
         with self._lock:
             # admission estimate: with a warm grant clock, an entry whose
             # expected grant wait (depth x the EWMA inter-grant interval)
@@ -280,8 +285,15 @@ class GlobalQueue:
                 # None verdict means "rather wait" (e.g. every free slot is
                 # on a demotion-grade slow replica and the entry carries a
                 # deadline a doomed grant would burn)
-                replica = self._pick(candidates, entry.session_key,
-                                     pool=pool, deadline=entry.deadline)
+                if entry.hint is not None:
+                    # only pass the kwarg when a hint exists: custom pick
+                    # callables predating cache-aware routing keep working
+                    replica = self._pick(candidates, entry.session_key,
+                                         pool=pool, deadline=entry.deadline,
+                                         hint=entry.hint)
+                else:
+                    replica = self._pick(candidates, entry.session_key,
+                                         pool=pool, deadline=entry.deadline)
                 if replica is None:
                     continue
                 self._slots[replica.id] = self._slots.get(replica.id, 0) + 1

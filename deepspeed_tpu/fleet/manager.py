@@ -46,6 +46,9 @@ class ReplicaManager:
         self._lock = threading.Lock()
         self._replicas: Dict[str, Replica] = {}
         self._supervisor = None  # ReplicaSupervisor attaches itself (stats)
+        # the router shares its FaultInjector here so manager-installed hooks
+        # (peer prefix fetch) consult the same chaos schedule as dispatch
+        self.faults = None
 
     @property
     def config(self) -> FleetConfig:
@@ -121,6 +124,15 @@ class ReplicaManager:
         replica.probe_backoff_cap_s = self._config.probe_backoff_cap_s
         replica.probe_jitter_frac = self._config.retry_jitter_frac
         replica.probe_backoff_base_s = max(self._config.probe_ttl_s, 0.25)
+        replica.fleet_metrics = self._metrics
+        if isinstance(replica, HttpReplica):
+            # the fleet-wide transport policy; "base64" is the zero-copy
+            # gate's control arm (per-replica 400 fallback still applies)
+            replica.binary_transport = self._config.kv_transport == "binary"
+        if (isinstance(replica, LocalReplica)
+                and self._config.cache_route.enabled
+                and self._config.cache_route.peer_fetch):
+            self._install_peer_fetch(replica)
         with self._lock:
             if replica.id in self._replicas:
                 replica.drain(timeout=0.0)
@@ -129,6 +141,69 @@ class ReplicaManager:
         logger.info(f"fleet: replica {replica.id} (role={replica.role}) registered")
         self._update_gauges()
         return replica
+
+    def _install_peer_fetch(self, replica: LocalReplica) -> None:
+        """Give one local replica's scheduler the fleet view it needs to pull
+        a deeper cached prefix from a peer instead of recomputing it.
+
+        The installed hook runs on *that replica's scheduler thread* at
+        admission: it matches the request's digest chain against every
+        available peer's probe-published catalog (truncated hex — a routing
+        hint; the donor re-matches full digests), picks the deepest holder,
+        and fetches the frame over the replica's own transport. Donor-side
+        export and importer-side validation both carry short timeouts, so two
+        replicas fetching from each other degrade to cold prefills rather
+        than deadlocking their loops."""
+        from deepspeed_tpu.inference.v2.ragged.prefix_cache import DIGEST_HEX
+        cfg = self._config.cache_route
+
+        def peer_fetch(digests, have):
+            chain = [d.hex()[:DIGEST_HEX] for d in digests]
+            best, best_depth = None, max(have, cfg.min_match_blocks - 1)
+            for peer in self.replicas(available_only=True):
+                if peer.id == replica.id:
+                    continue
+                doc = peer._probe_doc
+                if doc is None:
+                    doc = peer.probe(max_age_s=self._config.probe_ttl_s)
+                catalog = doc.get("prefix_digests")
+                if not catalog:
+                    continue
+                catset = set(catalog)
+                depth = 0
+                for i, h in enumerate(chain):
+                    # membership of the i-th chain digest means the peer
+                    # holds the first i+1 blocks (chained digests); the
+                    # catalog may omit intermediates under its size limit,
+                    # so the deepest member wins, no consecutiveness needed
+                    if h in catset:
+                        depth = i + 1
+                if depth > best_depth:
+                    best, best_depth = peer, depth
+            if best is None:
+                return None
+            payload = best.fetch_prefix(digests, min_blocks=have + 1,
+                                        timeout=cfg.fetch_timeout_s)
+            if payload is None:
+                return None
+            faults = self.faults
+            if faults is not None:
+                idx = faults.fire("peer_fetch_corrupt", best.id)
+                if idx is not None:
+                    payload = faults.corrupt(payload, idx, best.id,
+                                             point="peer_fetch_corrupt")
+            return payload
+
+        def notify(outcome):
+            if self._metrics is None:
+                return
+            if outcome == "hit":
+                self._metrics.peer_fetches.inc()
+            else:
+                self._metrics.peer_fetch_rejects.inc()
+
+        replica.scheduler._peer_fetch = peer_fetch
+        replica.scheduler._peer_fetch_notify = notify
 
     def _make_breaker_observer(self, replica: Replica):
         """Breaker transitions land in the ``fleet_breaker_*`` metrics and the
@@ -255,9 +330,14 @@ class ReplicaManager:
         for r in replicas:
             if r.available:
                 roles[r.role] = roles.get(r.role, 0) + 1
+        kv_wire: Dict[str, int] = {}
+        for r in replicas:
+            for transport, n in r.kv_wire_bytes.items():
+                kv_wire[transport] = kv_wire.get(transport, 0) + n
         doc = {"replicas": [r.describe() for r in replicas], "roles": roles,
                "quarantined": sum(1 for r in replicas
-                                  if r.state is ReplicaState.QUARANTINED)}
+                                  if r.state is ReplicaState.QUARANTINED),
+               "kv_wire_bytes": kv_wire}
         if self._supervisor is not None:
             doc["supervisor"] = self._supervisor.describe()
         return doc

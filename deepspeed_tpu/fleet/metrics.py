@@ -99,6 +99,44 @@ class FleetMetrics:
             "fleet_hedge_suppressed_total",
             "Hedges suppressed by the storm brake (no replica-specific "
             "evidence and the speculative bucket was dry)")
+        # fleet data motion (cache-aware routing / zero-copy transport /
+        # work stealing)
+        self.cache_route_hits = registry.counter(
+            "fleet_cache_route_hits_total",
+            "Dispatches placed by digest match (the replica advertised the "
+            "request's prefix chain)")
+        self.cache_route_misses = registry.counter(
+            "fleet_cache_route_misses_total",
+            "Cache-aware placements that fell back to rendezvous/least-loaded "
+            "(no replica advertised a matching prefix)")
+        self.peer_fetches = registry.counter(
+            "fleet_peer_prefix_fetches_total",
+            "Cross-replica prefix-KV fetches that imported blocks (donor "
+            "trie → wire frame → local trie)")
+        self.peer_fetch_rejects = registry.counter(
+            "fleet_peer_prefix_fetch_rejects_total",
+            "Peer prefix fetches rejected at import (CRC/geometry/digest "
+            "mismatch) and recomputed cold")
+        self.kv_transport_bytes = registry.counter(
+            "fleet_kv_transport_bytes_total",
+            "KV payload bytes moved across replica dispatch interfaces, all "
+            "transports (resume bodies, handoff returns, peer/steal frames)")
+        self.kv_transport_binary_bytes = registry.counter(
+            "fleet_kv_transport_binary_bytes_total",
+            "KV payload bytes moved as raw handoff frames (zero-copy wire "
+            "transport)")
+        self.kv_transport_base64_bytes = registry.counter(
+            "fleet_kv_transport_base64_bytes_total",
+            "KV payload bytes moved as base64 text (compatibility transport; "
+            "encoded size, ~4/3× the raw payload)")
+        self.steals = registry.counter(
+            "fleet_steals_total",
+            "Requests moved off a hot replica by work stealing (re-granted "
+            "queued entries and exported mid-decode legs)")
+        self.steal_attempts = registry.counter(
+            "fleet_steal_attempts_total",
+            "Steal probes sent to victim replicas (includes races the victim "
+            "won by finishing first)")
 
     @classmethod
     def maybe_create(cls) -> Optional["FleetMetrics"]:

@@ -53,12 +53,17 @@ from enum import Enum
 from typing import Iterator, Optional
 
 from deepspeed_tpu.fleet.breaker import CircuitBreaker, backoff_delay
+from deepspeed_tpu.inference.v2.ragged.handoff import \
+    CONTENT_TYPE as HANDOFF_CONTENT_TYPE
 from deepspeed_tpu.serving import (AdmissionRejected, QueueFullError,
                                    SchedulerStopped, ServingConfig,
                                    ServingScheduler)
 from deepspeed_tpu.serving.request import Request
 from deepspeed_tpu.serving.scheduler import KILLED_ERROR_PREFIX
-from deepspeed_tpu.serving.server import PARENT_SPAN_HEADER, TRACE_HEADER
+from deepspeed_tpu.serving.server import (HANDLE_HEADER,
+                                          HANDOFF_TRANSPORT_HEADER,
+                                          PARAMS_HEADER, PARENT_SPAN_HEADER,
+                                          STEAL_SENT_HEADER, TRACE_HEADER)
 from deepspeed_tpu.utils.logging import logger
 
 _REPLICA_IDS = itertools.count()
@@ -104,7 +109,14 @@ def _raise_if_killed(doc: dict) -> None:
 class Leg:
     """One dispatched request leg: iterate for live tokens, ``result()`` for
     the terminal doc (``serving/server._request_doc`` shape, with the handoff
-    payload — when requested — as raw bytes under ``"handoff"``)."""
+    payload — when requested — as raw bytes under ``"handoff"``).
+
+    ``handle`` is the replica-side request handle (``Request.handle``) once
+    known — the address the router's work-stealing monitor uses to claim the
+    leg back out of the replica; None until the replica surfaced it (an HTTP
+    leg learns it from the SSE response headers)."""
+
+    handle: Optional[str] = None
 
     def __iter__(self) -> Iterator[int]:
         raise NotImplementedError
@@ -149,6 +161,29 @@ class Replica:
         self.probe_backoff_base_s = 0.25
         self.probe_backoff_cap_s = 10.0
         self.probe_jitter_frac = 0.25
+        # per-transport KV payload bytes moved across this replica's dispatch
+        # interface (resume bodies in, handoff/steal/prefix frames out):
+        # ``binary`` = raw handoff frames, ``base64`` = the encoded wire text,
+        # ``local`` = in-process moves. Feeds the fleet_kv_transport_*
+        # counters and the zero-copy perf gate's byte accounting.
+        self.kv_wire_bytes = {"binary": 0, "base64": 0, "local": 0}
+        self._kv_bytes_lock = threading.Lock()
+        self.fleet_metrics = None  # ReplicaManager._register attaches it
+
+    def record_kv_bytes(self, transport: str, n: int) -> None:
+        """Account ``n`` wire bytes of KV payload over ``transport`` (any
+        thread — dispatch handlers and SSE leg readers both feed this)."""
+        n = int(n)
+        with self._kv_bytes_lock:
+            self.kv_wire_bytes[transport] = (
+                self.kv_wire_bytes.get(transport, 0) + n)
+        m = self.fleet_metrics
+        if m is not None:
+            m.kv_transport_bytes.inc(n)
+            if transport == "binary":
+                m.kv_transport_binary_bytes.inc(n)
+            elif transport == "base64":
+                m.kv_transport_base64_bytes.inc(n)
 
     @property
     def available(self) -> bool:
@@ -233,6 +268,24 @@ class Replica:
         :class:`ReplicaUnavailable` when this replica cannot admit."""
         raise NotImplementedError
 
+    # ----------------------------------------------------------- data motion --
+    def fetch_prefix(self, digests, min_blocks: int = 1,
+                     timeout: float = 2.0) -> Optional[bytes]:
+        """Ask this replica to frame its deepest cached prefix along
+        ``digests`` (full 20-byte chained digests) as a handoff payload —
+        the peer-KV-fetch donor side. None = nothing deep enough cached (or
+        the replica kind doesn't serve fetches); the caller proceeds cold."""
+        return None
+
+    def steal(self, handle: str, timeout: float = 5.0) -> dict:
+        """Ask this replica to give up the request addressed by ``handle``.
+        Returns the scheduler's steal verdict doc: ``{"status": "queued"}``
+        (never started — re-dispatch from scratch), ``{"status": "exported",
+        "payload": bytes, "sent": n}`` (mid-decode — resume elsewhere), or
+        ``{"status": "finished"}`` (too late / unreachable — the caller keeps
+        the original leg; the conservative exactly-once default)."""
+        return {"status": "finished"}
+
     # ------------------------------------------------------------- lifecycle --
     def drain(self, timeout: Optional[float] = None) -> None:
         """Leave rotation, let in-flight requests finish (bounded), then stop."""
@@ -249,6 +302,7 @@ class Replica:
                 "ttft_ewma_s": (round(self.ttft_ewma_s, 4)
                                 if self.ttft_ewma_s is not None else None),
                 "breaker": self.breaker.describe() if self.breaker else None,
+                "kv_wire_bytes": dict(self.kv_wire_bytes),
                 "probe": self._probe_doc}
 
 
@@ -279,6 +333,7 @@ class _LocalLeg(Leg):
 
     def __init__(self, req: Request):
         self.request = req
+        self.handle = req.handle
 
     def __iter__(self):
         return iter(self.request.stream)
@@ -323,7 +378,7 @@ class LocalReplica(Replica):
     def _probe(self) -> dict:
         sched = self.scheduler
         free = self.engine.free_blocks
-        return {
+        doc = {
             "healthy": (self.state is ReplicaState.UP and not sched._stopping
                         and sched.ready),
             "draining": self.state is ReplicaState.DRAINING or sched._stopping,
@@ -332,6 +387,21 @@ class LocalReplica(Replica):
             "kv_free_frac": free / self._capacity_blocks if self._capacity_blocks else 0.0,
             "heartbeats": sched._counters["heartbeats"],
         }
+        digests = sched.prefix_digest_catalog()
+        if digests is not None:
+            # the trie's fleet-visible shape: what cache-aware routing and
+            # peer prefix fetch match the request chain against
+            doc["prefix_digests"] = digests
+            doc["prefix_block_size"] = self.engine._state_manager.kv_block_size
+        pc = sched._prefix_cache
+        if pc is not None:
+            s = pc.stats()
+            # the per-replica hit-rate attribution loadgen reads off
+            # /v1/fleet/stats (each stats row carries its last probe doc)
+            doc["prefix_stats"] = {k: s.get(k) for k in
+                                   ("lookups", "hits", "hit_rate",
+                                    "trie_blocks")}
+        return doc
 
     def dispatch(self, doc: dict, resume: bool = False,
                  trace_id: Optional[str] = None,
@@ -348,6 +418,7 @@ class LocalReplica(Replica):
                       priority=doc.get("priority"))
         try:
             if resume:
+                self.record_kv_bytes("local", len(doc["payload"]))
                 req = self.scheduler.submit_resume(doc["payload"], **kwargs)
             else:
                 req = self.scheduler.submit(doc["prompt"], **kwargs)
@@ -361,6 +432,30 @@ class LocalReplica(Replica):
         except SchedulerStopped as e:
             raise ReplicaUnavailable(str(e), status=503) from e
         return _LocalLeg(req)
+
+    def fetch_prefix(self, digests, min_blocks: int = 1,
+                     timeout: float = 2.0) -> Optional[bytes]:
+        # the short timeout is load-bearing: two LocalReplicas fetching from
+        # each other symmetrically would block both scheduler loops; a timed
+        # out fetch degrades to a cold prefill on both sides
+        try:
+            payload = self.scheduler.export_prefix(digests,
+                                                   min_blocks=min_blocks,
+                                                   timeout=timeout)
+        except (SchedulerStopped, TimeoutError):
+            return None
+        if payload is not None:
+            self.record_kv_bytes("local", len(payload))
+        return payload
+
+    def steal(self, handle: str, timeout: float = 5.0) -> dict:
+        try:
+            out = self.scheduler.request_steal(handle, timeout=timeout)
+        except (SchedulerStopped, TimeoutError):
+            return {"status": "finished"}
+        if out.get("status") == "exported":
+            self.record_kv_bytes("local", len(out["payload"]))
+        return out
 
     def kill(self, reason: str = "injected fault") -> None:
         """Abrupt replica death (the chaos harness / supervisor test path):
@@ -403,7 +498,8 @@ class _HttpLeg(Leg):
     :class:`ReplicaDied`."""
 
     def __init__(self, conn, resp, replica_id: str,
-                 progress_timeout_s: float = 120.0):
+                 progress_timeout_s: float = 120.0,
+                 fetch_handoff=None, account=None):
         self._conn = conn
         self._resp = resp
         self._replica_id = replica_id
@@ -411,6 +507,14 @@ class _HttpLeg(Leg):
         self._last_progress = time.monotonic()
         self._final: Optional[dict] = None
         self._lock = threading.Lock()
+        # claim-once fetch for a `handoff_ref` done event (zero-copy return
+        # transport: GET /v1/handoff/<ref> -> raw frame) and the replica's
+        # per-transport wire-byte accountant
+        self._fetch_handoff = fetch_handoff
+        self._account = account or (lambda transport, n: None)
+        # the upstream surfaces the request handle before streaming: the
+        # work-stealing address for this leg
+        self.handle = resp.getheader(HANDLE_HEADER)
 
     def __iter__(self):
         try:
@@ -430,7 +534,18 @@ class _HttpLeg(Leg):
                 self._last_progress = time.monotonic()
                 if event.get("done"):
                     if "handoff" in event:
+                        self._account("base64", len(event["handoff"]))
                         event["handoff"] = base64.b64decode(event["handoff"])
+                    elif event.get("handoff_ref") and self._fetch_handoff:
+                        # ref'd return transport: the payload never rode the
+                        # SSE stream; claim the raw frame out of band
+                        raw = self._fetch_handoff(event.pop("handoff_ref"))
+                        if raw is None:
+                            raise ReplicaDied(
+                                f"replica {self._replica_id}: handoff ref "
+                                f"unclaimable (upstream restarted?)")
+                        self._account("binary", len(raw))
+                        event["handoff"] = raw
                     with self._lock:
                         self._final = event
                     return
@@ -478,6 +593,10 @@ class HttpReplica(Replica):
         self.timeout_s = timeout_s
         self.connect_timeout_s = connect_timeout_s
         self.read_timeout_s = min(read_timeout_s, timeout_s)
+        # resume transport memo: binary (raw handoff frame body) until the
+        # upstream answers 400 — an older server that only parses JSON — then
+        # base64 for this replica's lifetime
+        self.binary_transport = True
         split = urllib.parse.urlsplit(self.url)
         self._https = split.scheme == "https"
         self._host, self._port = split.hostname, split.port
@@ -538,7 +657,7 @@ class HttpReplica(Replica):
         engine = stats.get("engine", {})
         capacity = engine.get("capacity_blocks") or 0
         free = engine.get("free_blocks") or 0
-        return {
+        doc = {
             "healthy": health.get("status") == "ok" and self.state is ReplicaState.UP,
             "draining": health.get("status") == "draining"
                         or self.state is ReplicaState.DRAINING
@@ -549,24 +668,69 @@ class HttpReplica(Replica):
             "kv_free_frac": free / capacity if capacity else 1.0,
             "heartbeats": int(stats.get("counters", {}).get("heartbeats", 0)),
         }
+        prefix = stats.get("prefix_cache")
+        if isinstance(prefix, dict):
+            if prefix.get("digests") is not None:
+                doc["prefix_digests"] = [str(d) for d in prefix["digests"]]
+                doc["prefix_block_size"] = int(prefix.get("block_size") or 0)
+            doc["prefix_stats"] = {k: prefix.get(k) for k in
+                                   ("lookups", "hits", "hit_rate",
+                                    "trie_blocks")}
+        return doc
 
     def dispatch(self, doc: dict, resume: bool = False,
                  trace_id: Optional[str] = None,
                  parent_span_id: Optional[int] = None) -> Leg:
         if not self.available:
             raise ReplicaUnavailable(f"replica {self.id} is {self.state.name}")
+        base_headers = {}
+        if trace_id is not None:
+            base_headers[TRACE_HEADER] = trace_id
+        if parent_span_id is not None:
+            base_headers[PARENT_SPAN_HEADER] = str(parent_span_id)
+        if doc.get("handoff"):
+            # negotiate the ref'd return transport: the handoff payload comes
+            # back as a claim-once raw frame, not base64 inside the SSE doc
+            base_headers[HANDOFF_TRANSPORT_HEADER] = "ref"
+        path = "/v1/resume" if resume else "/v1/generate"
+        if resume and self.binary_transport:
+            # zero-copy resume: the raw handoff frame IS the body; generation
+            # params ride a header so the upstream never re-buffers the KV
+            params = {k: v for k, v in doc.items() if k != "payload"}
+            params["stream"] = True
+            headers = dict(base_headers)
+            headers["Content-Type"] = HANDOFF_CONTENT_TYPE
+            headers[PARAMS_HEADER] = json.dumps(params)
+            conn, resp = self._request("POST", path, body=doc["payload"],
+                                       headers=headers)
+            if resp.status == 400:
+                # an upstream that can't parse the frame as a body is running
+                # the JSON-only protocol: remember, fall through to base64
+                logger.warning(f"fleet: replica {self.id} rejected binary "
+                               f"resume transport; falling back to base64")
+                self.binary_transport = False
+                try:
+                    resp.read()
+                except Exception:  # pragma: no cover - best effort
+                    pass
+                conn.close()
+            else:
+                self.record_kv_bytes("binary", len(doc["payload"]))
+                return self._leg_or_raise(conn, resp)
         body = dict(doc)
         body["stream"] = True  # SSE upstream: early admission status, live tokens
         if resume:
-            body["payload"] = base64.b64encode(doc["payload"]).decode()
-        headers = {"Content-Type": "application/json"}
-        if trace_id is not None:
-            headers[TRACE_HEADER] = trace_id
-        if parent_span_id is not None:
-            headers[PARENT_SPAN_HEADER] = str(parent_span_id)
-        path = "/v1/resume" if resume else "/v1/generate"
+            encoded = base64.b64encode(doc["payload"]).decode()
+            self.record_kv_bytes("base64", len(encoded))
+            body["payload"] = encoded
+        headers = dict(base_headers)
+        headers["Content-Type"] = "application/json"
         conn, resp = self._request("POST", path, body=json.dumps(body).encode(),
                                    headers=headers)
+        return self._leg_or_raise(conn, resp)
+
+    def _leg_or_raise(self, conn, resp) -> Leg:
+        """Map a dispatch response to a live leg or the failure taxonomy."""
         if resp.status != 200:
             detail = ""
             try:
@@ -585,7 +749,76 @@ class HttpReplica(Replica):
                     f"replica {self.id}: HTTP {resp.status} {detail}",
                     status=resp.status, retry_after_s=retry_after)
             raise ValueError(f"replica {self.id}: HTTP {resp.status} {detail}")
-        return _HttpLeg(conn, resp, self.id, progress_timeout_s=self.timeout_s)
+        return _HttpLeg(conn, resp, self.id, progress_timeout_s=self.timeout_s,
+                        fetch_handoff=self._claim_handoff,
+                        account=self.record_kv_bytes)
+
+    def _claim_handoff(self, ref: str) -> Optional[bytes]:
+        """Claim a stashed handoff frame (``GET /v1/handoff/<ref>``): the
+        zero-copy return leg of the ref'd transport. Claim-once upstream —
+        None means the ref is gone (restart, double claim)."""
+        try:
+            conn, resp = self._request("GET", f"/v1/handoff/{ref}")
+        except ReplicaUnavailable:
+            return None
+        try:
+            if resp.status != 200:
+                return None
+            return resp.read()
+        except (socket.timeout, http.client.HTTPException, OSError):
+            return None
+        finally:
+            conn.close()
+
+    def fetch_prefix(self, digests, min_blocks: int = 1,
+                     timeout: float = 2.0) -> Optional[bytes]:
+        body = json.dumps({"digests": [d.hex() if isinstance(d, (bytes, bytearray))
+                                       else str(d) for d in digests],
+                           "min_blocks": int(min_blocks)}).encode()
+        try:
+            conn, resp = self._request(
+                "POST", "/v1/prefix/export", body=body,
+                headers={"Content-Type": "application/json"},
+                read_timeout=min(self.read_timeout_s, timeout))
+        except ReplicaUnavailable:
+            return None  # an unreachable donor is just a cold prefill
+        try:
+            if resp.status != 200:
+                return None
+            payload = resp.read()
+        except (socket.timeout, http.client.HTTPException, OSError):
+            return None
+        finally:
+            conn.close()
+        self.record_kv_bytes("binary", len(payload))
+        return payload
+
+    def steal(self, handle: str, timeout: float = 5.0) -> dict:
+        body = json.dumps({"handle": handle}).encode()
+        try:
+            conn, resp = self._request(
+                "POST", "/v1/steal", body=body,
+                headers={"Content-Type": "application/json"},
+                read_timeout=min(self.read_timeout_s, timeout))
+        except ReplicaUnavailable:
+            # can't reach the victim: assume it still owns the leg
+            return {"status": "finished"}
+        try:
+            if resp.status != 200:
+                return {"status": "finished"}
+            ctype = resp.getheader("Content-Type") or ""
+            if ctype.startswith(HANDOFF_CONTENT_TYPE):
+                payload = resp.read()
+                sent = int(resp.getheader(STEAL_SENT_HEADER) or 0)
+                self.record_kv_bytes("binary", len(payload))
+                return {"status": "exported", "payload": payload, "sent": sent}
+            out = json.loads(resp.read())
+            return out if isinstance(out, dict) else {"status": "finished"}
+        except (socket.timeout, http.client.HTTPException, OSError,
+                ValueError):
+            return {"status": "finished"}
+        finally:
+            conn.close()
 
     def drain(self, timeout: Optional[float] = None) -> None:
         # the upstream process is not ours to stop: drain = leave rotation

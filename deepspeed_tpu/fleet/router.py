@@ -67,6 +67,8 @@ from deepspeed_tpu.fleet.manager import ReplicaManager
 from deepspeed_tpu.fleet.metrics import FleetMetrics
 from deepspeed_tpu.fleet.replica import (Leg, Replica, ReplicaDied,
                                          ReplicaUnavailable)
+from deepspeed_tpu.inference.v2.ragged.prefix_cache import (DIGEST_HEX,
+                                                            digest_chain)
 from deepspeed_tpu.serving.overload import validate_priority
 from deepspeed_tpu.serving.server import (PRIORITY_HEADER, TRACE_HEADER,
                                           parse_request_body,
@@ -136,6 +138,20 @@ class RoutedRequest:
         # death, cancel) so freed capacity pulls the next queued request
         self._leg_slots = {}
         self._slot_lock = threading.Lock()
+        # cache-aware routing: the request's block-aligned prefix chain as
+        # truncated-hex digests, computed at most once per block size (a
+        # mixed fleet may disagree on geometry) and matched against each
+        # candidate's probe-published catalog at pick time
+        routing = doc.get("routing")
+        if routing not in (None, "cache", "hash"):
+            raise ValueError(f"unknown routing mode {routing!r} "
+                             f"(know 'cache', 'hash')")
+        self._chain_cache = {}
+        self._cache_route_counted = False
+        self._route_hint = None
+        if (router._config.cache_route.enabled and routing != "hash"
+                and not resume and doc.get("prompt") is not None):
+            self._route_hint = self
 
         mgr = router._manager
         prefill_pool = self._dispatchable("prefill")
@@ -224,6 +240,36 @@ class RoutedRequest:
                                                           available_only=True)
                 if r.breaker is None or r.breaker.allow()]
 
+    # ------------------------------------------------------- cache routing --
+    def _chain_for(self, block_size: int) -> Optional[List[str]]:
+        """The prompt's chained block digests at ``block_size``, truncated to
+        the catalog's hex width (matching a hint needs no more; the peer
+        fetch path re-matches full 20-byte digests donor-side)."""
+        if block_size <= 0:
+            return None
+        chain = self._chain_cache.get(block_size)
+        if chain is None:
+            tokens = np.asarray(self._doc["prompt"], dtype=np.int32)
+            chain = [d.hex()[:DIGEST_HEX]
+                     for d in digest_chain(tokens, block_size)]
+            self._chain_cache[block_size] = chain
+        return chain
+
+    def _note_cache_route(self, hit: bool) -> None:
+        """Count the request's cache-routing outcome exactly once (failover
+        and hedge legs re-run the pick; only the first verdict is the
+        routing decision)."""
+        if self._cache_route_counted:
+            return
+        self._cache_route_counted = True
+        router = self._router
+        key = "cache_route_hits" if hit else "cache_route_misses"
+        with router._counter_lock:
+            router._counters[key] += 1
+        if router._metrics:
+            (router._metrics.cache_route_hits if hit
+             else router._metrics.cache_route_misses).inc()
+
     def _mark_degraded(self, reason: str) -> None:
         if self._degraded:
             return
@@ -288,7 +334,8 @@ class RoutedRequest:
             candidates = candidates_fn()
             if not candidates:
                 return None
-            return router._pick(candidates, self._session_key)
+            return router._pick(candidates, self._session_key,
+                                hint=self._route_hint)
         if not candidates_fn():
             # nothing dispatchable at all (everything down / breaker-open /
             # excluded): fail over NOW like the pre-queue router — the queue
@@ -300,7 +347,8 @@ class RoutedRequest:
                 deadline_s=self._remaining_deadline_s(),
                 session_key=self._session_key,
                 timeout_s=(acquire_timeout_s if acquire_timeout_s is not None
-                           else router._config.global_queue.acquire_timeout_s))
+                           else router._config.global_queue.acquire_timeout_s),
+                hint=self._route_hint)
         except GlobalQueueFull as e:
             raise RoutingError(f"{what} leg rejected: {e}", status=429,
                                retry_after_s=e.retry_after_s) from e
@@ -714,12 +762,196 @@ class RoutedRequest:
         if replica is not None and replica.breaker is not None:
             replica.breaker.record_failure(trial=False)
 
+    # ------------------------------------------------------- work stealing --
+    def _steal_eligible(self) -> bool:
+        """Steal single-leg generate requests with deadline headroom only:
+        a resume leg's one-shot payload has nothing queued to move, the
+        disaggregated path re-dispatches its own decode leg, and a request
+        about to miss its deadline is better served by staying put than by
+        paying a second dispatch."""
+        scfg = self._router._config.steal
+        if not (scfg.enabled and not self._resume and not self._cancelled):
+            return False
+        remaining = self._remaining_deadline_s()
+        return remaining is None or remaining > scfg.min_deadline_headroom_s
+
+    def _attempt_steal(self, victim_id: str) -> Optional[dict]:
+        """One steal probe (at most one per request): verify the victim is
+        meaningfully hotter than the coldest healthy peer, then ask the
+        victim's scheduler — which executes the move on its own loop, the
+        exactly-once authority — to release the work. None = keep the
+        original leg (no peer, not hot enough, no handle, or the victim won
+        the race by finishing first)."""
+        router = self._router
+        scfg = router._config.steal
+        handle = getattr(self._leg1, "handle", None)
+        if handle is None:
+            return None
+        victim = router._manager_get(victim_id)
+        if victim is None:
+            return None
+        peers = router._healthy(self._pool_fn(), {victim_id})
+        if not peers:
+            return None
+        coldest = min(peers, key=lambda r: (r.load, r.id))
+        try:
+            # the steal decision must not act on a stale load reading
+            victim.probe(max_age_s=0.0)
+        except Exception:
+            return None
+        if victim.load <= scfg.load_ratio * coldest.load:
+            return None
+        with router._counter_lock:
+            router._counters["steal_attempts"] += 1
+        if router._metrics:
+            router._metrics.steal_attempts.inc()
+        faults = router._faults
+        if (faults is not None
+                and faults.fire("steal_race", victim_id) is not None):
+            # injected race: the victim finished while the steal decision
+            # was in flight — the answer is "finished" and the router keeps
+            # consuming the original leg, exactly-once by construction
+            router._count_fault()
+            out = {"status": "finished"}
+        else:
+            out = victim.steal(handle)
+        if out.get("status") not in ("queued", "exported"):
+            return None
+        with router._counter_lock:
+            router._counters["steals"] += 1
+        if router._metrics:
+            router._metrics.steals.inc()
+        logger.info(f"fleet: stole request {handle} from {victim_id} "
+                    f"({out['status']}, load {victim.load} vs "
+                    f"{coldest.load} on {coldest.id})")
+        return out
+
+    def _run_stealing(self) -> Iterator[int]:
+        """Single-leg streaming with the work-stealing monitor armed: while
+        no token has arrived within ``wait_budget_s``, the request — queued
+        or barely started on a hot replica — may be moved ONCE to a cold
+        peer. A "queued" victim re-dispatches from scratch (token-identical
+        trivially: same prompt, same seed); an "exported" victim ships its
+        live KV as a handoff frame and the continuation resumes on the peer,
+        with every pre-export token delivered from the victim's terminal
+        doc first so the client stream stays gapless. A lost race keeps the
+        original leg — exactly-once either way."""
+        router = self._router
+        scfg = router._config.steal
+        events: queue_mod.Queue = queue_mod.Queue()
+        victim_id = self._last_replica_id
+        leg1 = self._leg1
+        threading.Thread(target=self._reader,
+                         args=(0, leg1, victim_id, events),
+                         name="dstpu-steal-leg0", daemon=True).start()
+        yielded: List[int] = []
+        final: Optional[dict] = None
+        outcome: Optional[dict] = None
+        attempted = False
+        while final is None and outcome is None:
+            remaining = self._deadline_remaining_raw_s()
+            if remaining is not None and remaining <= 0:
+                try:
+                    idx, kind, val = events.get_nowait()
+                except queue_mod.Empty:
+                    leg1.cancel()
+                    final = self._deadline_cut_final(yielded)
+                    break
+            else:
+                budget = None
+                if not attempted and not yielded and not self._cancelled:
+                    budget = scfg.wait_budget_s
+                    if remaining is not None:
+                        budget = min(budget, remaining)
+                try:
+                    idx, kind, val = events.get(
+                        timeout=budget if budget is not None else remaining)
+                except queue_mod.Empty:
+                    if budget is None:
+                        continue  # deadline wake-up: the top of the loop cuts
+                    attempted = True
+                    outcome = self._attempt_steal(victim_id)
+                    continue
+            if kind == "err":
+                self._fail_replica(victim_id)
+                raise val
+            if kind == "done":
+                final = val
+                continue
+            yielded.append(val)
+            yield val
+        if outcome is not None:
+            # drain the victim's reader: a stolen request's CANCELLED leg
+            # still terminates through the stream, and its terminal doc is
+            # the authority on every token produced before the export
+            victim_final: Optional[dict] = None
+            while victim_final is None:
+                idx, kind, val = events.get()
+                if kind == "err":
+                    self._fail_replica(victim_id)
+                    raise val
+                if kind == "done":
+                    victim_final = val
+            self._leg_meta("steal-victim", victim_final)
+            for tok in list(victim_final.get("tokens") or []):
+                yielded.append(tok)
+                yield tok
+            if outcome["status"] == "queued":
+                leg2 = self._dispatch(
+                    self._leg_doc(prompt=self._doc["prompt"],
+                                  handoff=self._client_handoff,
+                                  deadline_s=self._remaining_deadline_s()),
+                    resume=False, pool_fn=self._pool_fn, what="steal",
+                    exclude={victim_id})
+            else:
+                sent = int(outcome.get("sent") or 0)
+                leg2 = self._dispatch(
+                    self._leg_doc(payload=outcome["payload"],
+                                  max_new_tokens=self._n - sent,
+                                  handoff=self._client_handoff,
+                                  deadline_s=self._remaining_deadline_s()),
+                    resume=True, pool_fn=self._pool_fn, what="steal-resume",
+                    exclude={victim_id}, internal_payload=True)
+            stolen_prefix = list(yielded)
+            try:
+                for tok in self._stream(leg2, self._last_replica_id):
+                    remaining = self._deadline_remaining_raw_s()
+                    if remaining is not None and remaining <= 0:
+                        leg2.cancel()
+                        final = self._deadline_cut_final(yielded)
+                        break
+                    yielded.append(tok)
+                    yield tok
+                if final is None:
+                    final2 = dict(leg2.result())
+                    self._leg_meta("steal", final2)
+                    final = final2
+                    if stolen_prefix:
+                        tokens = stolen_prefix + list(final2.get("tokens") or [])
+                        final = dict(final2)
+                        final["tokens"] = tokens
+                        final["n_tokens"] = len(tokens)
+                        final["cached_tokens"] = victim_final.get(
+                            "cached_tokens", 0)
+                        final["e2e_s"] = time.monotonic() - self._t0_s
+                    final["stolen"] = True
+            except ReplicaDied:
+                self._fail_current_replica()
+                raise
+            finally:
+                self._finish_leg(leg2)
+        else:
+            self._leg_meta("serve", final)
+        return final
+
     # --------------------------------------------------------------- route --
     def _run(self) -> Iterator[int]:
         router = self._router
         if not self._disagg:
             if self._hedge_eligible():
                 final = yield from self._run_hedged()
+            elif self._steal_eligible():
+                final = yield from self._run_stealing()
             else:
                 final = None
                 yielded: List[int] = []
@@ -888,7 +1120,9 @@ class FleetRouter:
         self._metrics = FleetMetrics.maybe_create()
         self._counters = {"requests": 0, "degraded": 0, "hedged": 0,
                           "hedge_wins": 0, "deadline_cuts": 0,
-                          "hedges_suppressed": 0}
+                          "hedges_suppressed": 0,
+                          "cache_route_hits": 0, "cache_route_misses": 0,
+                          "steals": 0, "steal_attempts": 0}
         self._counter_lock = threading.Lock()
         self._server = None
         self._thread = None
@@ -936,6 +1170,9 @@ class FleetRouter:
         if self._faults is not None:
             logger.warning(f"fleet: FAULT INJECTION ARMED "
                            f"(seed={self._faults.config.seed})")
+        # manager-installed hooks (peer prefix fetch) consult the same
+        # chaos schedule as router dispatch
+        self._manager.faults = self._faults
 
     @property
     def manager(self) -> ReplicaManager:
@@ -959,11 +1196,20 @@ class FleetRouter:
                 out.append(replica)
         return out
 
-    def _pick(self, candidates: List[Replica], session_key: Optional[str]) -> Replica:
-        """Affinity (rendezvous hash) when a session key rides the request,
-        least-loaded otherwise — with slow replicas (router-observed TTFT
-        EWMA above ``slow_demote_factor`` × the candidate median) demoted to
-        last resort; candidates are already healthy-filtered."""
+    def _pick(self, candidates: List[Replica], session_key: Optional[str],
+              hint=None) -> Replica:
+        """Cache-aware placement first (``hint`` carries the request's prefix
+        chain): the replica advertising the deepest cached prefix of this
+        prompt wins — KV reuse beats load balance, a hit skips whole prefill
+        blocks. Falling back: affinity (rendezvous hash) when a session key
+        rides the request, least-loaded otherwise — with slow replicas
+        (router-observed TTFT EWMA above ``slow_demote_factor`` × the
+        candidate median) demoted to last resort; candidates are already
+        healthy-filtered."""
+        if hint is not None:
+            choice = self._cache_pick(candidates, hint)
+            if choice is not None:
+                return choice
         if session_key:
             return max(candidates,
                        key=lambda r: _rendezvous_score(session_key, r.id))
@@ -975,9 +1221,45 @@ class FleetRouter:
                        key=lambda r: (r.id in demoted, r.load, r.id))
         return min(candidates, key=lambda r: (r.load, r.id))
 
+    def _cache_pick(self, candidates: List[Replica],
+                    routed: "RoutedRequest") -> Optional[Replica]:
+        """The replica whose probe-published digest catalog matches the
+        request's block-aligned prefix chain deepest (least-loaded breaks
+        ties); None = no candidate clears ``min_match_blocks``. Catalog
+        membership of the chain's i-th digest means that replica holds the
+        first i+1 blocks (digests are chained), so the deepest member wins —
+        no consecutiveness required, the bounded catalog may omit
+        intermediates. Staleness is bounded by the probe TTL; a stale hit
+        degrades to a shallower local match or a peer fetch replica-side,
+        never a wrong answer."""
+        best = None
+        best_key = (0, 0, "")
+        floor = self._config.cache_route.min_match_blocks
+        for r in candidates:
+            doc = r._probe_doc or {}
+            catalog = doc.get("prefix_digests")
+            block_size = doc.get("prefix_block_size")
+            if not catalog or not block_size:
+                continue
+            chain = routed._chain_for(int(block_size))
+            if not chain:
+                continue
+            catset = set(catalog)
+            depth = 0
+            for i, digest_hex in enumerate(chain):
+                if digest_hex in catset:
+                    depth = i + 1
+            if depth < floor:
+                continue
+            key = (depth, -r.load, r.id)
+            if best is None or key > best_key:
+                best, best_key = r, key
+        routed._note_cache_route(best is not None)
+        return best
+
     def _queue_pick(self, candidates: List[Replica],
                     session_key: Optional[str], pool=None,
-                    deadline=None) -> Optional[Replica]:
+                    deadline=None, hint=None) -> Optional[Replica]:
         """The global queue's grant policy: :meth:`_pick` semantics, except
         demotion is judged against the entry's WHOLE pool (not just the
         replicas with free slots) and a deadline-carrying entry is never
@@ -987,6 +1269,10 @@ class FleetRouter:
         instead (None = "rather wait"). Deadline-free work still flows to a
         demoted replica when nothing faster has capacity, which keeps its
         latency EWMAs fed and lets a recovered replica earn its way back."""
+        if hint is not None:
+            choice = self._cache_pick(candidates, hint)
+            if choice is not None:
+                return choice
         if session_key:
             return max(candidates,
                        key=lambda r: _rendezvous_score(session_key, r.id))
@@ -1099,6 +1385,7 @@ class FleetRouter:
         ``/v1/fleet/chaos`` handler and the chaos tests)."""
         self._faults = (FaultInjector(config)
                         if config is not None and config.enabled else None)
+        self._manager.faults = self._faults
         if self._faults is not None:
             logger.warning(f"fleet: FAULT INJECTION ARMED "
                            f"(seed={config.seed})")

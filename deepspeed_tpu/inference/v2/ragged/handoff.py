@@ -42,6 +42,11 @@ import numpy as np
 
 MAGIC = b"DSTPUKV1"
 VERSION = 1
+SUPPORTED_VERSIONS = frozenset({1})
+
+CONTENT_TYPE = "application/x-dstpu-handoff"
+"""HTTP content type for a raw (un-base64d) frame on the wire — the binary
+transport's negotiation token (``serving/server.py`` / ``fleet/replica.py``)."""
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -91,8 +96,40 @@ def pack_sequence(state_manager, uid: int, tokens, extra: Optional[dict] = None,
     raw = b"" if kv is None else np.ascontiguousarray(kv).tobytes()
     if kv is not None:
         header["kv_crc32"] = zlib.crc32(raw) & 0xFFFFFFFF
+    return _frame(header, raw)
+
+
+def _frame(header: dict, raw: bytes) -> bytes:
     hdr = json.dumps(header).encode()
     return MAGIC + struct.pack("<I", len(hdr)) + hdr + raw
+
+
+def pack_blocks(state_manager, block_ids, tokens,
+                extra: Optional[dict] = None) -> bytes:
+    """Frame arbitrary KV blocks (full blocks, no tracked sequence) as a v1
+    payload — the peer prefix-fetch transport. ``tokens`` is the token-id
+    history the blocks cover; every block must be full
+    (``len(tokens) == len(block_ids) * block_size``), which is exactly what
+    the prefix-cache trie stores."""
+    block_ids = list(block_ids)
+    bs = state_manager._kv_config.block_size
+    if len(tokens) != len(block_ids) * bs:
+        raise ValueError(
+            f"pack_blocks: {len(tokens)} tokens do not fill "
+            f"{len(block_ids)} blocks of {bs}")
+    kv = state_manager.kv_cache.gather_blocks(block_ids)
+    raw = np.ascontiguousarray(kv).tobytes()
+    header = {
+        "version": VERSION,
+        "uid": 0,
+        "seen_tokens": len(tokens),
+        "tokens": [int(t) for t in tokens],
+        "extra": extra or {},
+        "cache": _cache_signature(state_manager._kv_config),
+        "kv": {"shape": list(kv.shape), "dtype": str(kv.dtype)},
+        "kv_crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+    }
+    return _frame(header, raw)
 
 
 def _validate_header(header) -> None:
@@ -102,8 +139,13 @@ def _validate_header(header) -> None:
     deep inside the scheduler."""
     if not isinstance(header, dict):
         raise ValueError("handoff header must be a JSON object")
-    if header.get("version") != VERSION:
-        raise ValueError(f"unsupported handoff payload version {header.get('version')}")
+    if header.get("version") not in SUPPORTED_VERSIONS:
+        # loud reject, not best-effort parse: a future-version frame may have
+        # changed the geometry or the CRC coverage, and decoding it under v1
+        # rules would stream silently wrong tokens
+        raise ValueError(
+            f"unsupported handoff payload version {header.get('version')!r} "
+            f"(this build speaks {sorted(SUPPORTED_VERSIONS)})")
     if not isinstance(header.get("seen_tokens"), int) or header["seen_tokens"] < 0:
         raise ValueError("handoff header: seen_tokens must be a non-negative int")
     tokens = header.get("tokens")
@@ -145,18 +187,24 @@ def unpack(payload: bytes) -> Tuple[dict, Optional[np.ndarray]]:
     :func:`compatibility_error`."""
     if not isinstance(payload, (bytes, bytearray, memoryview)):
         raise ValueError("handoff payload must be bytes")
-    payload = bytes(payload)
-    if payload[:len(MAGIC)] != MAGIC:
+    # zero-copy: the KV region is the bulk of a multi-MB payload on the
+    # per-request handoff hot path — only the small header JSON is ever
+    # materialized; the KV array aliases the caller's buffer (read-only,
+    # which is fine: import scatters it into fresh device blocks)
+    view = memoryview(payload).cast("B") if not isinstance(payload, bytes) \
+        else memoryview(payload)
+    n_total = view.nbytes
+    if bytes(view[:len(MAGIC)]) != MAGIC:
         raise ValueError("not a DSTPU KV-handoff payload (bad magic)")
     off = len(MAGIC)
-    if len(payload) < off + 4:
+    if n_total < off + 4:
         raise ValueError("handoff payload truncated: no header length")
-    (hdr_len, ) = struct.unpack_from("<I", payload, off)
+    (hdr_len, ) = struct.unpack_from("<I", view, off)
     off += 4
-    if len(payload) < off + hdr_len:
+    if n_total < off + hdr_len:
         raise ValueError("handoff payload truncated: incomplete header")
     try:
-        header = json.loads(payload[off:off + hdr_len])
+        header = json.loads(bytes(view[off:off + hdr_len]))
     except json.JSONDecodeError as e:
         raise ValueError(f"handoff header is not valid JSON: {e}") from e
     _validate_header(header)
@@ -167,18 +215,16 @@ def unpack(payload: bytes) -> Tuple[dict, Optional[np.ndarray]]:
     dtype = _np_dtype(kv_meta["dtype"])
     shape = tuple(kv_meta["shape"])
     want = int(np.prod(shape)) * dtype.itemsize
-    if len(payload) - off != want:
-        raise ValueError(f"handoff payload truncated: {len(payload) - off} KV "
+    if n_total - off != want:
+        raise ValueError(f"handoff payload truncated: {n_total - off} KV "
                          f"bytes, header promises {want}")
     crc = header.get("kv_crc32")
-    # memoryview: the KV region is the bulk of a multi-MB payload on the
-    # per-request handoff hot path — checksum it without a second copy
-    if crc is not None and zlib.crc32(memoryview(payload)[off:]) & 0xFFFFFFFF != crc:
+    if crc is not None and zlib.crc32(view[off:]) & 0xFFFFFFFF != crc:
         # corruption-in-transit must be a loud reject here, never silently
         # wrong attention downstream (the framing checks above only catch
         # length damage; a flipped KV byte is invisible without this)
         raise ValueError("handoff payload corrupted: KV checksum mismatch")
-    kv = np.frombuffer(payload, dtype=dtype, count=int(np.prod(shape)),
+    kv = np.frombuffer(view, dtype=dtype, count=int(np.prod(shape)),
                        offset=off).reshape(shape)
     return header, kv
 

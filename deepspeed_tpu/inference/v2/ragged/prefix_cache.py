@@ -38,9 +38,39 @@ from any thread.
 """
 
 import hashlib
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+DIGEST_HEX = 16
+"""Truncated-hex width of a digest as published in probe docs / fleet stats.
+64 bits is plenty for a routing *hint* (the worst a collision costs is one
+misrouted dispatch that then misses locally); the fetch path always matches
+full 20-byte digests."""
+
+
+def digest_chain(tokens, block_size: int,
+                 base: Optional[List[bytes]] = None) -> List[bytes]:
+    """Chained sha1 digests of every *full* ``block_size`` run of ``tokens``:
+    ``digest[i] = sha1(digest[i-1] + token_bytes(block_i))``. The one hashing
+    authority — :meth:`PrefixCache.chain` and the fleet router's cache-aware
+    placement both call this, so a replica's published catalog and the
+    router's request chain can never disagree on the algorithm. ``base``
+    seeds the chain with already-computed leading digests."""
+    tokens = np.asarray(tokens, np.int32).reshape(-1)
+    n_full = tokens.size // block_size
+    out = list(base[:n_full]) if base else []
+    digest = out[-1] if out else b""
+    for i in range(len(out), n_full):
+        h = hashlib.sha1()
+        h.update(digest)
+        h.update(np.ascontiguousarray(
+            tokens[i * block_size:(i + 1) * block_size],
+            dtype=np.int32).tobytes())
+        digest = h.digest()
+        out.append(digest)
+    return out
 
 
 class _Node:
@@ -88,6 +118,9 @@ class PrefixCache:
         self._min_prefix_blocks = max(1, int(min_prefix_blocks))
         self._root = _Node(b"", -1, None)
         self._by_digest: Dict[bytes, _Node] = {}
+        # guards _by_digest's structure only: mutation stays on the scheduler
+        # thread, but digest_catalog() snapshots from probe threads
+        self._index_lock = threading.Lock()
         self._clock = 0  # monotonic LRU counter (no wall clock: deterministic)
         # stats (read lock-free from stats threads; written on scheduler thread)
         self.lookups = 0
@@ -104,19 +137,7 @@ class PrefixCache:
         scheduler hashes each prompt once at admission and extends over the
         generated tail at publish time, instead of re-hashing the whole
         history on the hot thread)."""
-        tokens = np.asarray(tokens, np.int32).reshape(-1)
-        bs = self._block_size
-        n_full = tokens.size // bs
-        out = list(base[:n_full]) if base else []
-        digest = out[-1] if out else b""
-        for i in range(len(out), n_full):
-            h = hashlib.sha1()
-            h.update(digest)
-            h.update(np.ascontiguousarray(tokens[i * bs:(i + 1) * bs],
-                                          dtype=np.int32).tobytes())
-            digest = h.digest()
-            out.append(digest)
-        return out
+        return digest_chain(tokens, self._block_size, base=base)
 
     # -------------------------------------------------------------- lookup --
     def acquire(self, prompt, digests: Optional[List[bytes]] = None) -> PrefixHit:
@@ -200,6 +221,42 @@ class PrefixCache:
             node, rem = nxt, np.empty(0, np.int32)
         return np.asarray(out, np.int32)
 
+    # ------------------------------------------------------- fleet export --
+    def digest_catalog(self, limit: int = 64) -> List[str]:
+        """The trie's fleet-visible shape: up to ``limit`` node digests
+        (truncated hex, recency-first) for the replica's probe doc. A chained
+        digest pins the whole prefix up to its block, so the router needs no
+        structure — membership of the request chain's i-th digest means this
+        replica holds the first ``i+1`` blocks. Safe from probe threads (the
+        index lock guards the snapshot; staleness is bounded by the probe
+        TTL)."""
+        with self._index_lock:
+            nodes = list(self._by_digest.values())
+        nodes.sort(key=lambda n: n.last_touch, reverse=True)
+        return [n.digest.hex()[:DIGEST_HEX] for n in nodes[:max(0, limit)]]
+
+    def export_nodes(self, digests: List[bytes]) -> Tuple[List[int], np.ndarray]:
+        """Deepest indexed path along ``digests`` (full chained digests):
+        returns ``(block_ids, tokens)`` covering the matched prefix — the
+        peer-fetch donor's read. Takes NO block references: the caller must
+        run on the scheduler thread (the replica routes the fetch through the
+        scheduler's control queue) and frame the blocks before yielding it."""
+        node = self._root
+        blocks: List[int] = []
+        tokens: List[np.ndarray] = []
+        self._clock += 1
+        for digest in digests:
+            child = node.children.get(digest)
+            if child is None:
+                break
+            child.last_touch = self._clock  # a fetched path is a hot path
+            blocks.append(child.block)
+            tokens.append(child.tokens)
+            node = child
+        if not blocks:
+            return [], np.empty(0, np.int32)
+        return blocks, np.concatenate(tokens)
+
     # ------------------------------------------------------------- publish --
     def publish(self, tokens, block_ids, committed_tokens: int,
                 digests: Optional[List[bytes]] = None) -> int:
@@ -233,7 +290,8 @@ class PrefixCache:
                               tokens=np.array(tokens[i * bs:(i + 1) * bs],
                                               np.int32, copy=True))
                 node.children[digest] = child
-                self._by_digest[digest] = child
+                with self._index_lock:
+                    self._by_digest[digest] = child
                 added += 1
             child.last_touch = self._clock
             node = child
@@ -282,7 +340,8 @@ class PrefixCache:
     def _remove(self, node: _Node) -> None:
         assert not node.children
         del node.parent.children[node.digest]
-        del self._by_digest[node.digest]
+        with self._index_lock:
+            del self._by_digest[node.digest]
         self._kv.free([node.block])
 
     def _make_room(self, n: int, protect=frozenset()) -> bool:
@@ -300,7 +359,8 @@ class PrefixCache:
         for node in list(self._by_digest.values()):
             node.children.clear()
         for node in list(self._by_digest.values()):
-            del self._by_digest[node.digest]
+            with self._index_lock:
+                del self._by_digest[node.digest]
             self._kv.free([node.block])
         self._root.children.clear()
 

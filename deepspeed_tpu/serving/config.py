@@ -37,6 +37,11 @@ class PrefixCacheConfig(DeepSpeedConfigModel):
     """Smallest cached-prefix match (in blocks) worth applying to a request;
     shorter matches prefill cold."""
 
+    digest_catalog_limit: int = Field(64, ge=0)
+    """How many trie-node digests (truncated hex, recency-first) the replica
+    publishes in its probe doc for the fleet's cache-aware routing; 0 turns
+    publication off (the replica then only receives hash-routed traffic)."""
+
 
 class SpeculativeConfig(DeepSpeedConfigModel):
     """Speculative decoding via model-free self-drafting

@@ -19,6 +19,7 @@ blocks, sequence descriptors — is freed there); ``cancel()`` from any thread
 just raises a flag the scheduler honors on its next tick.
 """
 
+import itertools
 import queue
 import threading
 import time
@@ -45,6 +46,11 @@ TERMINAL_STATES = frozenset(
     {RequestState.DONE, RequestState.CANCELLED, RequestState.FAILED, RequestState.TIMED_OUT})
 
 _END = object()
+
+# process-unique steal handles (request.handle): the fleet router addresses a
+# victim's in-flight request across the HTTP boundary by handle, never by uid
+# (uids are per-scheduler and unassigned until admission)
+_HANDLE_IDS = itertools.count()
 
 
 class TokenStream:
@@ -118,6 +124,9 @@ class Request:
         self.priority = validate_priority(priority)
 
         self.uid: Optional[int] = None  # assigned at admission by the scheduler
+        # stable cross-thread identity from birth: the work-stealing path
+        # must address a request while it is still QUEUED (uid is None)
+        self.handle: str = f"r{next(_HANDLE_IDS)}"
         # distributed-tracing identity: the scheduler assigns both when a
         # telemetry session is active; every lifecycle span parents under
         # root_span_id and the HTTP layer returns trace_id to the client.

@@ -116,7 +116,9 @@ class ServingScheduler:
                            "prefix_hits", "prefix_tokens_saved", "prefix_evictions",
                            "shed_admission", "shed_queue", "brownout_rejected",
                            "brownout_clamped", "spec_drafted", "spec_accepted",
-                           "spec_steps", "spec_rollback")}
+                           "spec_steps", "spec_rollback",
+                           "peer_fetch_hits", "peer_fetch_rejects",
+                           "peer_fetch_blocks", "steals")}
         self._stopping = False   # no new submits
         self._shutdown = False   # thread exit
         self._stopped = False
@@ -129,6 +131,21 @@ class ServingScheduler:
         # pool capacity for permanent-infeasibility checks (a prompt needing
         # more KV blocks than the whole pool can never run)
         self._capacity_blocks = engine._state_manager.kv_cache.num_blocks
+
+        # fleet data motion: cross-thread control calls (prefix export for a
+        # peer fetch, work-stealing) run on THIS loop via _call_on_loop — the
+        # engine, the trie and the block allocator are all single-threaded
+        # state, so a probe/handler thread must never touch them directly
+        self._control: deque = deque()
+        # router-installed hook: fn(digests, have_blocks) -> payload | None.
+        # Called on the scheduler thread at admission when the local trie
+        # match is shallower than the request's chain; a returned frame is
+        # CRC/digest-validated before any block lands.
+        self._peer_fetch = None
+        # companion hook: fn("hit" | "reject") — lets the fleet layer mirror
+        # peer-fetch outcomes into its own metric registry without reaching
+        # into scheduler counters
+        self._peer_fetch_notify = None
 
         # overload control (serving/overload.py): the measured-rate estimator
         # feeds admission feasibility + Retry-After; the brownout controller
@@ -270,7 +287,10 @@ class ServingScheduler:
         only the tokens generated HERE; the caller merges with the prefill
         leg's."""
         from deepspeed_tpu.inference.v2.ragged.handoff import unpack
-        payload = bytes(payload)
+        if not isinstance(payload, (bytes, bytearray)):
+            # materialize views; a bytearray from the streaming body decoder
+            # is kept as-is (copying it would double the resume peak memory)
+            payload = bytes(payload)
         header, kv = unpack(payload)  # validate framing before queueing
         extra = header.get("extra") or {}
         if "next_token" not in extra:
@@ -525,6 +545,7 @@ class ServingScheduler:
     def step(self) -> bool:
         """One scheduling iteration; returns True iff a batch executed.
         Runs on the scheduler thread — or inline when ``start=False``."""
+        self._drain_control()
         now = time.monotonic()
         for req in list(self._active.values()):
             # the deadline check doubles as the decode feed-stop: a request
@@ -665,6 +686,222 @@ class ServingScheduler:
             req._fed = req.prompt.size  # the whole history is already prefilled
             return "ok"
 
+    # -------------------------------------------------- fleet data motion --
+    def _call_on_loop(self, fn, timeout: float = 5.0):
+        """Run ``fn`` on the scheduler (engine-owning) thread and return its
+        result — the cross-thread entry for fleet control operations (peer
+        prefix export, work-stealing). A manually-stepped scheduler
+        (``start=False``) runs inline; otherwise the call is queued and
+        drained at the top of the next ``step()``. Raises ``TimeoutError``
+        when the loop does not service it in ``timeout`` (a wedged or
+        mutually-fetching peer: the caller degrades, never deadlocks) and
+        :class:`SchedulerStopped` when the scheduler dies first."""
+        if self._stopped or self._killed:
+            raise SchedulerStopped("scheduler is stopped")
+        if self._thread is None:
+            return fn()
+        box = {"done": threading.Event(), "result": None, "error": None}
+        self._control.append((fn, box))
+        if not box["done"].wait(timeout):
+            raise TimeoutError(f"scheduler control call not serviced in {timeout}s")
+        if box["error"] is not None:
+            raise box["error"]
+        return box["result"]
+
+    def _drain_control(self) -> None:
+        """Service queued control calls (scheduler thread, top of every tick)."""
+        while self._control:
+            try:
+                fn, box = self._control.popleft()
+            except IndexError:  # pragma: no cover - single consumer
+                break
+            try:
+                box["result"] = fn()
+            except BaseException as e:
+                box["error"] = e
+            box["done"].set()
+
+    def _fail_control(self) -> None:
+        """Unblock every pending control caller at stop/kill — a waiter must
+        observe the death, not its timeout."""
+        while self._control:
+            try:
+                _, box = self._control.popleft()
+            except IndexError:  # pragma: no cover - single consumer
+                break
+            box["error"] = SchedulerStopped("scheduler stopped before the "
+                                            "control call was serviced")
+            box["done"].set()
+
+    def prefix_digest_catalog(self) -> Optional[List[str]]:
+        """Truncated-hex digests of this replica's hottest trie paths — what
+        the probe doc publishes for the fleet's cache-aware routing. Safe from
+        probe threads (lock-guarded snapshot; staleness is bounded by the
+        probe TTL). None = cache off or publication disabled."""
+        if self._prefix_cache is None:
+            return None
+        limit = self._config.prefix_cache.digest_catalog_limit
+        if limit <= 0:
+            return None
+        return self._prefix_cache.digest_catalog(limit)
+
+    def export_prefix(self, digests, min_blocks: int = 1,
+                      timeout: float = 5.0) -> Optional[bytes]:
+        """Frame this replica's cached KV along ``digests`` (full chained
+        block digests) as a portable payload — the peer prefix-fetch donor
+        side. Any thread; the trie walk AND the device gather run on the
+        scheduler loop so no block can be freed or recycled mid-gather (the
+        allocator is not thread-safe, and a CRC computed over a recycled
+        block would certify garbage). None = no path at least ``min_blocks``
+        deep (or the cache is off)."""
+        if self._prefix_cache is None:
+            return None
+        digests = list(digests)
+        floor = max(1, min_blocks)
+
+        def _do():
+            from deepspeed_tpu.inference.v2.ragged.handoff import pack_blocks
+            blocks, tokens = self._prefix_cache.export_nodes(digests)
+            if len(blocks) < floor:
+                return None
+            return pack_blocks(self._engine._state_manager, blocks, tokens,
+                               extra={"kind": "prefix"})
+        return self._call_on_loop(_do, timeout=timeout)
+
+    def _import_peer_prefix(self, req: Request, have: int) -> bool:
+        """Fetch KV blocks along the request's prefix chain from a fleet peer
+        (the router-installed hook) and publish them into the local trie;
+        True = the trie now indexes a deeper prefix than ``have`` blocks and
+        the caller should re-acquire. Every failure mode — transport error,
+        CRC mismatch, geometry drift, a payload whose tokens do not extend
+        THIS prompt's chain — rejects loudly and degrades to a cold prefill:
+        recompute is always correct."""
+        from deepspeed_tpu.inference.v2.ragged.handoff import (
+            compatibility_error, unpack)
+        from deepspeed_tpu.inference.v2.ragged.prefix_cache import digest_chain
+        pc = self._prefix_cache
+        sm = self._engine._state_manager
+        notify = self._peer_fetch_notify or (lambda outcome: None)
+        try:
+            payload = self._peer_fetch(list(req._prefix_digests), have)
+        except Exception as e:
+            self._counters["peer_fetch_rejects"] += 1
+            notify("reject")
+            logger.warning(f"serving: peer prefix fetch failed: {e}")
+            return False
+        if payload is None:
+            return False
+        try:
+            header, kv = unpack(payload)  # CRC verified here: a flipped byte
+            # in the KV region is a ValueError, never silently wrong attention
+            err = compatibility_error(sm, header)
+            if err:
+                raise ValueError(err)
+            tokens = np.asarray(header["tokens"], np.int32)
+            if kv is None or tokens.size != kv.shape[2] * sm.kv_block_size:
+                raise ValueError("peer prefix payload is not block-aligned")
+            got = digest_chain(tokens, sm.kv_block_size)
+            if len(got) <= have or got != req._prefix_digests[:len(got)]:
+                raise ValueError("peer prefix does not extend this prompt's "
+                                 "cached chain")
+        except ValueError as e:
+            self._counters["peer_fetch_rejects"] += 1
+            notify("reject")
+            logger.warning(f"serving: rejecting peer prefix payload: {e}")
+            return False
+        needed = int(kv.shape[2])
+        while True:
+            try:
+                ids = sm.kv_cache.scatter_blocks(kv)
+                break
+            except Exception:
+                if self._engine.free_blocks >= needed:
+                    self._counters["peer_fetch_rejects"] += 1
+                    notify("reject")
+                    return False  # not a capacity problem: give up, recompute
+                if not self._evict_one({req.uid}):
+                    return False  # pool genuinely can't hold it right now
+        # publish takes trie references on the NEW nodes only; dropping the
+        # import reference then frees exactly the blocks that duplicated an
+        # already-indexed prefix
+        pc.publish(tokens, ids, int(tokens.size), digests=got)
+        sm.kv_cache.free(ids)
+        self._counters["peer_fetch_hits"] += 1
+        self._counters["peer_fetch_blocks"] += needed
+        notify("hit")
+        if self._metrics:
+            self._metrics.prefix_trie_blocks.set(pc.n_blocks)
+        return True
+
+    def _find_by_handle(self, handle: str) -> Optional[Request]:
+        with self._not_full:
+            for req in self._queue:
+                if req.handle == handle:
+                    return req
+        for req in list(self._active.values()):
+            if req.handle == handle:
+                return req
+        return None
+
+    def request_steal(self, handle: str, timeout: float = 5.0) -> dict:
+        """Fleet work-stealing entry (any thread): move the request addressed
+        by ``handle`` off this replica so the router can re-grant it to a
+        cold one. Runs on the scheduler loop; outcomes:
+
+        - ``{"status": "queued"}`` — the request had consumed no decode state
+          (still QUEUED, or prefilling with nothing streamed): finalized here
+          with a ``stolen:`` error; the router re-dispatches the original
+          request from scratch (token-identical trivially — same prompt,
+          same seed);
+        - ``{"status": "exported", "payload": .., "sent": n}`` — early
+          decode: the live sequence is exported token-identically (the same
+          frame as a prefill→decode handoff) and finalized here; the router
+          resumes it on the peer and skips the ``n`` tokens already streamed;
+        - ``{"status": "finished"}`` — the victim won the race (request
+          already terminal, unknown, or not exportable): exactly-once
+          completion, the router keeps consuming the original leg.
+        """
+        def _do():
+            req = self._find_by_handle(handle)
+            if req is None or req.finished:
+                return {"status": "finished"}
+            with self._not_full:
+                try:
+                    self._queue.remove(req)
+                    queued = True
+                    self._not_full.notify()
+                except ValueError:
+                    queued = False
+            if queued or req.state is RequestState.PREFILL or not req.tokens:
+                # no decode state worth moving: a restart on the cold peer
+                # beats shipping a partial prefill's KV (and a PREFILL
+                # sequence has no next-input token to export yet)
+                self._counters["steals"] += 1
+                self._finalize(req, RequestState.CANCELLED,
+                               error="stolen: re-granted to a peer replica")
+                return {"status": "queued"}
+            if (req.state is not RequestState.DECODE or req._next is None
+                    or self._engine._state_manager.get_sequence(req.uid) is None):
+                return {"status": "finished"}  # not exportable: let it finish here
+            sent = len(req.tokens)
+            # the continuable-export shape: _export_handoff ships next_token
+            # only for a "length" finish, and mid-steal the invariant is the
+            # same — the last kept token is the next decode input
+            req.finish_reason = "length"
+            try:
+                payload = self._export_handoff(req)
+            except Exception as e:
+                req.finish_reason = None
+                logger.warning(f"serving: steal export failed for uid "
+                               f"{req.uid}: {e}")
+                return {"status": "finished"}
+            req.finish_reason = None
+            self._counters["steals"] += 1
+            self._finalize(req, RequestState.CANCELLED,
+                           error="stolen: exported to a peer replica")
+            return {"status": "exported", "payload": payload, "sent": sent}
+        return self._call_on_loop(_do, timeout=timeout)
+
     # ---------------------------------------------------------- prefix cache --
     def _apply_prefix_hit(self, req: Request) -> None:
         """Map the longest cached prefix of ``req.prompt`` into a
@@ -682,6 +919,16 @@ class ServingScheduler:
         # lookup here and both publish points (prefill completion + finalize)
         req._prefix_digests = pc.chain(req.prompt)
         hit = pc.acquire(req.prompt, digests=req._prefix_digests)
+        if (self._peer_fetch is not None
+                and len(hit.blocks) < len(req._prefix_digests)
+                and self._import_peer_prefix(req, have=len(hit.blocks))):
+            # a peer held a deeper prefix and its blocks now live in the
+            # local trie: re-acquire over the extended index. One admission
+            # stays one lookup in the hit-rate denominator — the retry must
+            # not dilute the rate the fleet routing gate reads.
+            pc.release(hit.blocks)
+            hit = pc.acquire(req.prompt, digests=req._prefix_digests)
+            pc.lookups -= 1
         if self._metrics:
             self._metrics.prefix_lookups.inc()
             self._metrics.prefix_lookup_depth.observe(len(hit.blocks))
@@ -1413,6 +1660,7 @@ class ServingScheduler:
             self._finalize(self._queue.popleft(), RequestState.FAILED, error=error)
         self._shutdown = True
         self._killed = True
+        self._fail_control()  # waiters observe the death, not a timeout
         if self._prefix_cache is not None:
             self._prefix_cache.clear()  # unpin the trie's blocks
             if self._metrics:
@@ -1451,6 +1699,7 @@ class ServingScheduler:
                     time.sleep(self._config.scheduler_tick_s)
         # cancel whatever drain didn't finish (scheduler thread is dead, so
         # touching the engine from here is safe)
+        self._fail_control()
         for req in list(self._active.values()):
             self._finalize(req, RequestState.CANCELLED)
         while self._queue:
@@ -1546,6 +1795,18 @@ class ServingScheduler:
 
     def _stats_doc(self, queued: List[Request], active: List[Request]) -> dict:
         now = time.monotonic()
+        prefix_stats = None
+        if self._prefix_cache is not None:
+            prefix_stats = self._prefix_cache.stats()
+            # the router hashes a request's chain with the replica's block
+            # size — it must ride the same doc as the digest catalog
+            prefix_stats["block_size"] = self._engine._state_manager.kv_block_size
+            digests = self.prefix_digest_catalog()
+            if digests is not None:
+                # the fleet-visible trie shape: an HTTP replica's probe reads
+                # /v1/stats, so the digest catalog rides the same doc the
+                # local probe reads directly
+                prefix_stats["digests"] = digests
         return {
             "queue_depth": len(queued),
             "active": {
@@ -1561,8 +1822,7 @@ class ServingScheduler:
                 "capacity_blocks": self._capacity_blocks,
                 "tracked_sequences": self._engine._state_manager.n_tracked_sequences,
             },
-            "prefix_cache": (self._prefix_cache.stats()
-                             if self._prefix_cache is not None else None),
+            "prefix_cache": prefix_stats,
             "speculative": self._spec_stats(),
             "overload": {
                 "enabled": self._config.overload.enabled,

@@ -49,13 +49,17 @@ then the listener shuts down.
 """
 
 import base64
+import itertools
 import json
 import math
 import queue
+import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from deepspeed_tpu.inference.v2.ragged.handoff import \
+    CONTENT_TYPE as HANDOFF_CONTENT_TYPE
 from deepspeed_tpu.serving.config import (DEFAULT_MAX_RESUME_BODY_BYTES,
                                           ServingConfig)
 from deepspeed_tpu.serving.overload import validate_priority
@@ -78,6 +82,16 @@ PARENT_SPAN_HEADER = "X-DSTPU-Parent-Span"
 # priority class (interactive | batch) — header form; the JSON body's
 # "priority" field wins when both are present
 PRIORITY_HEADER = "X-DSTPU-Priority"
+# fleet data motion: the request's steal handle (sent up-front on SSE
+# responses so the router can address a live request), the generation params
+# riding a binary-transport resume POST, the client's handoff-return
+# negotiation ("ref" = stash the frame server-side, return a claim-once
+# handoff_ref instead of base64-in-JSON), and the already-streamed token
+# count on an exported-steal response
+HANDLE_HEADER = "X-DSTPU-Request-Handle"
+PARAMS_HEADER = "X-DSTPU-Params"
+HANDOFF_TRANSPORT_HEADER = "X-DSTPU-Handoff-Transport"
+STEAL_SENT_HEADER = "X-DSTPU-Steal-Sent"
 
 
 def request_priority(handler, doc: dict) -> Optional[str]:
@@ -94,35 +108,117 @@ def retry_after_header(seconds: float) -> str:
     return str(max(1, math.ceil(seconds)))
 
 
+_PAYLOAD_KEY_RE = re.compile(rb'"payload"\s*:\s*"')
+_DECODE_CHUNK = 1 << 20
+
+
+def read_resume_body(rfile, length: int) -> dict:
+    """Stream a base64 ``/v1/resume`` JSON body off the socket, decoding the
+    ``payload`` string incrementally so peak memory is ~1x the decoded
+    payload — the old read-then-parse-then-decode path held wire bytes
+    (4/3x) + the parsed str (4/3x) + the decoded bytes (1x) simultaneously,
+    a ~3.7x peak on a multi-hundred-MB handoff. The payload value must be a
+    contiguous base64 string with no JSON escapes, which is exactly what
+    ``_request_doc`` and the fleet router emit."""
+    skeleton = bytearray()  # the JSON doc with the payload value spliced out
+    raw = bytearray()       # decoded payload (amortized growth, ~1x)
+    b64_tail = b""          # undecoded remainder (4-char alignment carry)
+    in_payload = False
+    found = False
+    remaining = length
+    search_from = 0
+    while remaining > 0:
+        chunk = rfile.read(min(_DECODE_CHUNK, remaining))
+        if not chunk:
+            raise ValueError("resume body truncated mid-read")
+        remaining -= len(chunk)
+        while chunk:
+            if not in_payload:
+                skeleton += chunk
+                chunk = b""
+                if found:
+                    continue
+                m = _PAYLOAD_KEY_RE.search(skeleton, search_from)
+                if m is None:
+                    # the key marker may straddle the next chunk boundary:
+                    # back the resume point up by the marker's width
+                    search_from = max(0, len(skeleton) - 16)
+                    continue
+                found = True
+                in_payload = True
+                chunk = bytes(skeleton[m.end():])
+                del skeleton[m.end():]  # keep the opening quote; value moves out
+            else:
+                end = chunk.find(b'"')
+                data, chunk = (chunk, b"") if end < 0 else \
+                    (chunk[:end], chunk[end:])  # chunk resumes AT the close quote
+                if b64_tail:
+                    data = b64_tail + data
+                    b64_tail = b""
+                if end < 0:
+                    cut = len(data) - (len(data) & 3)
+                    b64_tail = data[cut:]
+                    data = data[:cut]
+                else:
+                    in_payload = False
+                raw += base64.b64decode(data)  # binascii.Error IS a ValueError
+    if in_payload or b64_tail:
+        raise ValueError("resume body truncated inside the payload string")
+    doc = json.loads(bytes(skeleton))
+    if not isinstance(doc, dict):
+        raise ValueError("resume body must be a JSON object")
+    if not found:
+        raise KeyError("payload")
+    # hand the bytearray over as-is: a bytes() copy here would undo the whole
+    # streaming exercise (1x decoded + 1x copy = the 2x peak again); the
+    # scheduler treats the payload as immutable and nobody else holds it
+    doc["payload"] = raw
+    return doc
+
+
 def parse_request_body(handler, resume: bool, max_bytes: Optional[int] = None) -> dict:
     """Read + validate a ``/v1/generate`` | ``/v1/resume`` JSON body from an
     http.server request handler — the single wire-format authority, shared by
     :class:`ServingServer` and the fleet router (whose contract is that a
     client cannot tell it from a single replica). Returns the parsed doc,
-    with ``doc["payload"]`` base64-decoded to bytes for resume. Raises
-    ``ValueError``/``KeyError``/``TypeError`` on malformed input (callers
-    answer 400)."""
+    with ``doc["payload"]`` decoded to bytes for resume. A resume POST with
+    ``Content-Type: application/x-dstpu-handoff`` carries the raw frame as
+    the whole body (zero-copy: no base64, no JSON buffer) with the
+    generation params in the ``X-DSTPU-Params`` header; ``doc["_transport"]``
+    records which wire form arrived. Raises ``ValueError``/``KeyError``/
+    ``TypeError`` on malformed input (callers answer 400)."""
     if max_bytes is None:
         max_bytes = _MAX_RESUME_BODY_BYTES if resume else _MAX_BODY_BYTES
     length = int(handler.headers.get("Content-Length", 0))
     if not 0 < length <= max_bytes:
         raise ValueError(f"body length {length} out of bounds")
-    doc = json.loads(handler.rfile.read(length))
     if resume:
-        # fleet decode-role continuation: the body carries a peer engine's
-        # export_sequence payload instead of a prompt
-        doc["payload"] = base64.b64decode(doc["payload"])
-    else:
-        prompt = doc["prompt"]
-        if (not isinstance(prompt, list) or not prompt
-                or not all(isinstance(t, int) for t in prompt)):
-            raise ValueError("'prompt' must be a non-empty list of token ids")
+        ctype = (handler.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype == HANDOFF_CONTENT_TYPE:
+            doc = json.loads(handler.headers.get(PARAMS_HEADER) or "{}")
+            if not isinstance(doc, dict):
+                raise ValueError(f"{PARAMS_HEADER} must be a JSON object")
+            doc["payload"] = handler.rfile.read(length)
+            doc["_transport"] = "binary"
+            return doc
+        # fleet decode-role continuation, base64 compatibility form: the body
+        # carries a peer engine's export_sequence payload instead of a prompt
+        doc = read_resume_body(handler.rfile, length)
+        doc["_transport"] = "base64"
+        return doc
+    doc = json.loads(handler.rfile.read(length))
+    prompt = doc["prompt"]
+    if (not isinstance(prompt, list) or not prompt
+            or not all(isinstance(t, int) for t in prompt)):
+        raise ValueError("'prompt' must be a non-empty list of token ids")
     return doc
 
 
-def _request_doc(req: Request, raw_handoff: bool = False) -> dict:
+def _request_doc(req: Request, raw_handoff: bool = False,
+                 handoff_ref: Optional[str] = None) -> dict:
     doc = {
         "uid": req.uid,
+        "handle": req.handle,
         "tokens": list(req.tokens),
         "n_tokens": len(req.tokens),
         "cached_tokens": req.cached_tokens,
@@ -150,10 +246,16 @@ def _request_doc(req: Request, raw_handoff: bool = False) -> dict:
         doc["retry_after_s"] = req.retry_after_s
     if req.handoff_payload is not None:
         # fleet prefill→decode handoff: the exported KV/generation state, for
-        # POST /v1/resume on a decode-role peer. Bytes ride JSON as base64;
-        # an in-process leg (fleet LocalReplica) keeps them raw.
-        doc["handoff"] = (req.handoff_payload if raw_handoff else
-                          base64.b64encode(req.handoff_payload).decode())
+        # POST /v1/resume on a decode-role peer. An in-process leg (fleet
+        # LocalReplica) keeps the bytes raw; a client that negotiated the
+        # binary transport gets a claim-once ref (GET /v1/handoff/<ref>
+        # returns the raw frame — zero base64 tax); everyone else gets the
+        # base64-in-JSON compatibility form.
+        if handoff_ref is not None:
+            doc["handoff_ref"] = handoff_ref
+        else:
+            doc["handoff"] = (req.handoff_payload if raw_handoff else
+                              base64.b64encode(req.handoff_payload).decode())
     return doc
 
 
@@ -170,6 +272,27 @@ class ServingServer:
         self._server = None
         self._thread = None
         self._draining = threading.Event()
+        # claim-once binary handoff returns: a client that negotiated
+        # "X-DSTPU-Handoff-Transport: ref" gets a handoff_ref in the final
+        # doc and fetches the raw frame from GET /v1/handoff/<ref> — the
+        # frame never pays the base64 tax. Bounded so unclaimed refs (a
+        # router that died between the done event and the claim) cannot
+        # accumulate payload-sized garbage.
+        self._handoff_store: dict = {}
+        self._handoff_lock = threading.Lock()
+        self._handoff_ids = itertools.count()
+
+    def _stash_handoff(self, payload: bytes) -> str:
+        with self._handoff_lock:
+            ref = f"h{next(self._handoff_ids)}"
+            self._handoff_store[ref] = payload
+            while len(self._handoff_store) > 32:
+                self._handoff_store.pop(next(iter(self._handoff_store)))
+        return ref
+
+    def _claim_handoff(self, ref: str) -> Optional[bytes]:
+        with self._handoff_lock:
+            return self._handoff_store.pop(ref, None)
 
     @property
     def scheduler(self) -> ServingScheduler:
@@ -188,9 +311,19 @@ class ServingServer:
     # ----------------------------------------------------------------- start --
     def start(self) -> "ServingServer":
         scheduler, draining = self._scheduler, self._draining
+        outer = self
         cfg: ServingConfig = scheduler._config
 
         class Handler(BaseHTTPRequestHandler):
+
+            def _send_bytes(self, code, payload, headers=()):
+                self.send_response(code)
+                self.send_header("Content-Type", HANDOFF_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(payload)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(payload)
 
             def _send_json(self, code, doc, trace_id=None, retry_after=None):
                 data = json.dumps(doc).encode()
@@ -210,6 +343,15 @@ class ServingServer:
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"
                 if path == "/v1/stats":
                     self._send_json(200, scheduler.stats())
+                elif path.startswith("/v1/handoff/"):
+                    # claim-once binary handoff fetch (the "ref" transport's
+                    # second half): the raw frame, exactly once
+                    payload = outer._claim_handoff(path.rsplit("/", 1)[1])
+                    if payload is None:
+                        self._send_json(404, {"error": "no such handoff ref "
+                                                       "(already claimed?)"})
+                    else:
+                        self._send_bytes(200, payload)
                 elif path == "/healthz":
                     # readiness-gated liveness: "starting" until the scheduler
                     # loop ticks (a supervisor registers a replica only on
@@ -236,8 +378,73 @@ class ServingServer:
                     parent_span_id = None
                 return trace_id, parent_span_id
 
+            def _small_json_body(self, cap: int = 1 << 20) -> dict:
+                length = int(self.headers.get("Content-Length", 0))
+                if not 0 < length <= cap:
+                    raise ValueError(f"body length {length} out of bounds")
+                doc = json.loads(self.rfile.read(length))
+                if not isinstance(doc, dict):
+                    raise ValueError("body must be a JSON object")
+                return doc
+
+            def _steal(self):
+                """Fleet work-stealing victim side: move the addressed
+                request off this replica. An exported continuation goes out
+                as the raw binary frame (zero-copy), with the count of
+                already-streamed tokens in a header."""
+                try:
+                    doc = self._small_json_body()
+                    handle = doc["handle"]
+                    if not isinstance(handle, str):
+                        raise ValueError("'handle' must be a string")
+                except (KeyError, ValueError, TypeError) as e:
+                    self._send_json(400, {"error": str(e)})
+                    return
+                try:
+                    out = scheduler.request_steal(handle)
+                except (SchedulerStopped, TimeoutError) as e:
+                    self._send_json(503, {"error": str(e)})
+                    return
+                if out["status"] == "exported":
+                    self._send_bytes(200, out["payload"],
+                                     headers=((STEAL_SENT_HEADER,
+                                               str(out["sent"])),))
+                else:
+                    self._send_json(200, {"status": out["status"]})
+
+            def _prefix_export(self):
+                """Peer prefix-fetch donor side: the deepest cached KV run
+                along the posted digest chain, as a raw binary frame."""
+                try:
+                    doc = self._small_json_body()
+                    digests = [bytes.fromhex(d) for d in doc["digests"]]
+                    min_blocks = int(doc.get("min_blocks") or 1)
+                except (KeyError, ValueError, TypeError) as e:
+                    self._send_json(400, {"error": str(e)})
+                    return
+                try:
+                    payload = scheduler.export_prefix(digests,
+                                                      min_blocks=min_blocks,
+                                                      timeout=2.0)
+                except (SchedulerStopped, TimeoutError) as e:
+                    self._send_json(503, {"error": str(e)})
+                    return
+                if payload is None:
+                    self._send_json(404, {"error": f"no cached path at least "
+                                                   f"{min_blocks} blocks deep"})
+                else:
+                    self._send_bytes(200, payload)
+
             def do_POST(self):
                 path = self.path.split("?", 1)[0].rstrip("/")
+                # steal + prefix export stay routable while draining: they
+                # move state OUT of this replica, admitting nothing
+                if path == "/v1/steal":
+                    self._steal()
+                    return
+                if path == "/v1/prefix/export":
+                    self._prefix_export()
+                    return
                 if path not in ("/v1/generate", "/v1/resume"):
                     self._send_json(404, {"error": f"no route {path}"})
                     return
@@ -291,8 +498,10 @@ class ServingServer:
                     # max_new_tokens, ...) are client errors, not handler crashes
                     self._send_json(400, {"error": str(e)})
                     return
+                ref_mode = (self.headers.get(HANDOFF_TRANSPORT_HEADER)
+                            or "").strip().lower() == "ref"
                 if doc.get("stream"):
-                    self._stream_sse(req)
+                    self._stream_sse(req, ref_mode=ref_mode)
                 else:
                     req.wait()  # terminal by deadline/max_new_tokens/cancel
                     if req.shed_reason is not None or (
@@ -303,10 +512,16 @@ class ServingServer:
                                         trace_id=req.trace_id,
                                         retry_after=req.retry_after_s)
                     else:
-                        self._send_json(200, _request_doc(req),
+                        self._send_json(200, self._final_doc(req, ref_mode),
                                         trace_id=req.trace_id)
 
-            def _stream_sse(self, req):
+            def _final_doc(self, req, ref_mode):
+                if ref_mode and req.handoff_payload is not None:
+                    return _request_doc(
+                        req, handoff_ref=outer._stash_handoff(req.handoff_payload))
+                return _request_doc(req)
+
+            def _stream_sse(self, req, ref_mode=False):
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
@@ -314,6 +529,10 @@ class ServingServer:
                     # the trace id is known at admission, so streaming clients
                     # get it up-front (it repeats in the final `done` event)
                     self.send_header(TRACE_HEADER, req.trace_id)
+                # the steal handle goes out before the first token: the fleet
+                # router must be able to address a request that is still
+                # queued or mid-decode
+                self.send_header(HANDLE_HEADER, req.handle)
                 self.end_headers()
                 try:
                     i = 0
@@ -335,7 +554,7 @@ class ServingServer:
                         self.wfile.flush()
                         i += 1
                     self.wfile.write(
-                        f"data: {json.dumps({'done': True, **_request_doc(req)})}\n\n".encode())
+                        f"data: {json.dumps({'done': True, **self._final_doc(req, ref_mode)})}\n\n".encode())
                     self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError):
                     # client went away: cancel so the sequence's KV blocks

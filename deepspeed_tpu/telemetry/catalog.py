@@ -139,4 +139,15 @@ METRIC_FAMILIES = {
     "fleet_hedge_slow_demotions_total": "dispatch picks where a slow replica (TTFT EWMA) was demoted",
     "fleet_deadline_stream_cuts_total": "streams cut at the router because the deadline passed mid-decode",
     "fleet_hedge_suppressed_total": "hedges suppressed by the storm brake (no evidence, bucket dry)",
+    # fleet data motion (fleet/router.py cache-aware routing, fleet/replica.py
+    # zero-copy transport, fleet/manager.py peer prefix fetch, work stealing)
+    "fleet_cache_route_hits_total": "dispatches placed by digest match (a replica advertised the request's prefix chain)",
+    "fleet_cache_route_misses_total": "cache-aware placements that fell back to rendezvous/least-loaded",
+    "fleet_peer_prefix_fetches_total": "cross-replica prefix-KV fetches that imported blocks into the local trie",
+    "fleet_peer_prefix_fetch_rejects_total": "peer prefix fetches rejected at import (CRC/geometry/digest mismatch) and recomputed cold",
+    "fleet_kv_transport_bytes_total": "KV payload bytes moved across replica dispatch interfaces, all transports",
+    "fleet_kv_transport_binary_bytes_total": "KV payload bytes moved as raw handoff frames (zero-copy wire transport)",
+    "fleet_kv_transport_base64_bytes_total": "KV payload bytes moved as base64 text (compatibility transport, encoded size)",
+    "fleet_steals_total": "requests moved off a hot replica by work stealing (re-granted or exported mid-decode)",
+    "fleet_steal_attempts_total": "steal probes sent to victim replicas (includes races the victim won)",
 }

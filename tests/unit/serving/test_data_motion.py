@@ -1,0 +1,243 @@
+"""Fleet data motion, replica side (ISSUE 16): handoff frame versioning and
+CRC tamper rejection, zero-copy unpack, the streaming base64 resume-body
+decoder, scheduler-level work stealing (queued + exported, token-identical
+continuation), and peer prefix export framing."""
+
+import base64
+import io
+import json
+import struct
+import tracemalloc
+import zlib
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.ragged import handoff
+from deepspeed_tpu.inference.v2.ragged.prefix_cache import digest_chain
+from deepspeed_tpu.serving import (PrefixCacheConfig, RequestState,
+                                   ServingConfig, ServingScheduler)
+from deepspeed_tpu.serving.server import read_resume_body
+
+MAX_STEPS = 400
+
+
+def _run_until(sched, pred, max_steps=MAX_STEPS):
+    for _ in range(max_steps):
+        if pred():
+            return
+        sched.step()
+    raise AssertionError(f"predicate not reached in {max_steps} steps")
+
+
+def _prompt(n=9, vocab=64):
+    return (np.arange(n) % vocab).tolist()
+
+
+def _frame_with(version=1, flip_kv_byte=None, truncate=0):
+    """A hand-built v1 frame over synthetic KV — the tamper-test substrate
+    (no engine needed: the framing layer is pure bytes)."""
+    kv = np.arange(2 * 1 * 2 * 16 * 1 * 4, dtype=np.float32).reshape(
+        (2, 1, 2, 16, 1, 4))
+    raw = kv.tobytes()
+    header = {
+        "version": version,
+        "uid": 7,
+        "seen_tokens": 32,
+        "tokens": list(range(32)),
+        "extra": {},
+        "cache": {"block_size": 16, "num_layers": 1, "kv_heads": 1,
+                  "head_dim": 4, "dtype": "float32"},
+        "kv": {"shape": list(kv.shape), "dtype": "float32"},
+        "kv_crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+    }
+    hdr = json.dumps(header).encode()
+    payload = bytearray(handoff.MAGIC + struct.pack("<I", len(hdr)) + hdr + raw)
+    if flip_kv_byte is not None:
+        off = len(handoff.MAGIC) + 4 + len(hdr) + flip_kv_byte
+        payload[off] ^= 0xFF
+    if truncate:
+        payload = payload[:-truncate]
+    return bytes(payload)
+
+
+# ------------------------------------------------------------ frame tamper --
+def test_handoff_roundtrips_and_carries_version():
+    header, kv = handoff.unpack(_frame_with())
+    assert header["version"] == 1 and 1 in handoff.SUPPORTED_VERSIONS
+    assert kv.shape == (2, 1, 2, 16, 1, 4)
+    assert handoff.CONTENT_TYPE == "application/x-dstpu-handoff"
+
+
+def test_handoff_unknown_version_rejected_loudly():
+    with pytest.raises(ValueError, match="unsupported handoff payload version"):
+        handoff.unpack(_frame_with(version=2))
+    with pytest.raises(ValueError, match="unsupported handoff payload version"):
+        handoff.unpack(_frame_with(version=None))
+
+
+def test_handoff_crc_flip_and_truncation_rejected():
+    # a flipped byte anywhere in the CRC-covered KV region is a loud reject
+    for off in (0, 100, 2 * 1 * 2 * 16 * 1 * 4 * 4 - 1):
+        with pytest.raises(ValueError, match="checksum mismatch"):
+            handoff.unpack(_frame_with(flip_kv_byte=off))
+    with pytest.raises(ValueError, match="truncated"):
+        handoff.unpack(_frame_with(truncate=5))
+    with pytest.raises(ValueError, match="bad magic"):
+        handoff.unpack(b"NOTDSTPU" + _frame_with()[8:])
+
+
+def test_unpack_kv_aliases_payload_no_copy():
+    """The zero-copy contract: the KV array returned by unpack aliases the
+    payload buffer — no payload-sized intermediate is allocated."""
+    payload = _frame_with()
+    _, kv = handoff.unpack(payload)
+    assert np.shares_memory(kv, np.frombuffer(payload, dtype=np.uint8))
+
+
+# ----------------------------------------------- streaming base64 resume body --
+def test_read_resume_body_decodes_payload_and_keeps_fields():
+    payload = bytes(range(256)) * 33  # not 4-aligned in b64 chunks
+    doc = {"max_new_tokens": 3, "payload": base64.b64encode(payload).decode(),
+           "temperature": 0.5}
+    body = json.dumps(doc).encode()
+    out = read_resume_body(io.BytesIO(body), len(body))
+    assert out["payload"] == payload
+    assert out["max_new_tokens"] == 3 and out["temperature"] == 0.5
+
+
+def test_read_resume_body_peak_memory_stays_near_1x():
+    """The double-buffering fix (ISSUE satellite): decoding an N-byte payload
+    must not hold wire (4/3x) + str (4/3x) + decoded (1x) simultaneously —
+    peak traced allocation stays well under 2x (the old path was ~3.7x)."""
+    n = 8 << 20
+    payload = np.random.default_rng(0).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+    body = json.dumps({"payload": base64.b64encode(payload).decode(),
+                       "max_new_tokens": 1}).encode()
+    rfile = io.BytesIO(body)
+    tracemalloc.start()
+    try:
+        out = read_resume_body(rfile, len(body))
+        _, peak = tracemalloc.get_traced_memory()
+    finally:
+        tracemalloc.stop()
+    assert out["payload"] == payload
+    assert peak < 1.5 * n, f"peak {peak} bytes for a {n}-byte payload"
+
+
+def test_read_resume_body_truncation_is_value_error():
+    payload = base64.b64encode(b"x" * 64).decode()
+    body = json.dumps({"payload": payload}).encode()
+    with pytest.raises(ValueError, match="truncated"):
+        read_resume_body(io.BytesIO(body[:-10]), len(body))
+    with pytest.raises(KeyError):
+        body = json.dumps({"prompt": [1, 2]}).encode()
+        read_resume_body(io.BytesIO(body), len(body))
+
+
+# ------------------------------------------------------------ work stealing --
+def test_steal_queued_request_regrants_token_identical(make_engine, llama_setup):
+    """A still-queued request is released as ``queued``: finalized CANCELLED
+    on the victim, and a from-scratch rerun elsewhere is trivially
+    token-identical (same prompt, same seed)."""
+    cfg, _, _ = llama_setup
+    prompt = _prompt(11, cfg.vocab_size)
+    victim = ServingScheduler(make_engine(), ServingConfig(), start=False)
+    peer = ServingScheduler(make_engine(), ServingConfig(), start=False)
+    req = victim.submit(prompt, max_new_tokens=4, seed=3)
+    out = victim.request_steal(req.handle)
+    assert out == {"status": "queued"}
+    assert req.state is RequestState.CANCELLED and "stolen" in req.error
+    assert victim.stats()["counters"]["steals"] == 1
+
+    rerun = peer.submit(prompt, max_new_tokens=4, seed=3)
+    _run_until(peer, lambda: rerun.finished)
+    baseline = peer.submit(prompt, max_new_tokens=4, seed=3)
+    _run_until(peer, lambda: baseline.finished)
+    assert rerun.result(timeout=1) == baseline.result(timeout=1)
+    victim.stop(drain=False)
+    peer.stop(drain=False)
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_steal_exported_mid_decode_resumes_token_identical(
+        make_engine, llama_setup, temperature):
+    """Early-decode steal: the victim exports the live sequence as a handoff
+    frame; resuming it on a peer continues the EXACT token stream — greedy
+    and seeded-sampled — with the victim's KV and sequence verifiably freed."""
+    cfg, _, _ = llama_setup
+    prompt = _prompt(13, cfg.vocab_size)
+    n = 8
+    peer = ServingScheduler(make_engine(), ServingConfig(), start=False)
+    truth_req = peer.submit(prompt, max_new_tokens=n,
+                            temperature=temperature, seed=1234)
+    _run_until(peer, lambda: truth_req.finished)
+    truth = truth_req.result(timeout=1)
+    assert len(truth) == n
+
+    victim = ServingScheduler(make_engine(), ServingConfig(), start=False)
+    free0 = victim._engine.free_blocks
+    req = victim.submit(prompt, max_new_tokens=n,
+                        temperature=temperature, seed=1234)
+    _run_until(victim, lambda: req.state is RequestState.DECODE
+               and len(req.tokens) >= 3)
+    out = victim.request_steal(req.handle)
+    assert out["status"] == "exported"
+    sent = out["sent"]
+    assert sent >= 3 and list(req.tokens) == truth[:sent]
+    assert req.state is RequestState.CANCELLED
+    assert victim._engine.free_blocks == free0  # the export freed the donor KV
+    assert victim._engine._state_manager.n_tracked_sequences == 0
+
+    resumed = peer.submit_resume(out["payload"], max_new_tokens=n - sent,
+                                 temperature=temperature, seed=1234)
+    _run_until(peer, lambda: resumed.finished)
+    assert resumed.result(timeout=1) == truth[sent:]  # bitwise continuation
+    assert peer._engine._state_manager.n_tracked_sequences == 0
+    victim.stop(drain=False)
+    peer.stop(drain=False)
+
+
+def test_steal_unknown_or_finished_handle_is_finished(make_engine, llama_setup):
+    """Exactly-once: a handle the victim no longer owns (done, or never seen)
+    answers ``finished`` and the request's terminal state is untouched."""
+    cfg, _, _ = llama_setup
+    sched = ServingScheduler(make_engine(), ServingConfig(), start=False)
+    assert sched.request_steal("r999999") == {"status": "finished"}
+    req = sched.submit(_prompt(7, cfg.vocab_size), max_new_tokens=2)
+    _run_until(sched, lambda: req.finished)
+    tokens = req.result(timeout=1)
+    assert sched.request_steal(req.handle) == {"status": "finished"}
+    assert req.state is RequestState.DONE and req.result(timeout=1) == tokens
+    assert sched.stats()["counters"]["steals"] == 0
+    sched.stop(drain=False)
+
+
+# ------------------------------------------------------- peer prefix export --
+def test_export_prefix_frames_full_trie_blocks(make_engine, llama_setup):
+    """Donor side of the peer fetch: the published trie path comes back as a
+    CRC'd v1 frame whose tokens are exactly the full-block prefix."""
+    cfg, _, _ = llama_setup
+    engine = make_engine()
+    sched = ServingScheduler(
+        engine, ServingConfig(prefix_cache=PrefixCacheConfig(enabled=True)),
+        start=False)
+    prompt = _prompt(40, cfg.vocab_size)  # 2 full blocks + a partial
+    req = sched.submit(prompt, max_new_tokens=2)
+    _run_until(sched, lambda: req.finished)
+
+    chain = digest_chain(np.asarray(prompt, np.int32),
+                         engine._state_manager.kv_block_size)
+    assert len(chain) == 2
+    payload = sched.export_prefix(chain)
+    header, kv = handoff.unpack(payload)
+    assert header["tokens"] == prompt[:32] and header["seen_tokens"] == 32
+    assert kv.shape[2] == 2  # two full blocks, nothing partial
+    assert header["extra"] == {"kind": "prefix"}
+    # the truncated-hex catalog the probe doc publishes names the same chain
+    catalog = sched.prefix_digest_catalog()
+    assert chain[-1].hex()[:16] in catalog
+    # asking deeper than the trie holds is a clean None, not a short frame
+    assert sched.export_prefix(chain, min_blocks=3) is None
+    sched.stop(drain=False)
