@@ -295,6 +295,121 @@ def gang_report(gang_dir):
     return 1 if bad else 0
 
 
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values, width=40):
+    """Render a value list as a fixed-height unicode sparkline (newest-last,
+    truncated to ``width`` points, scaled to the visible min..max)."""
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        vals = vals[-width:]
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK_CHARS[0] * len(vals)
+    top = len(_SPARK_CHARS) - 1
+    return "".join(_SPARK_CHARS[min(top, int((v - lo) / (hi - lo) * len(_SPARK_CHARS)))]
+                   for v in vals)
+
+
+def _load_timeseries_doc(src):
+    """A ``--timeseries`` operand is either a saved JSON file or a live
+    router/engine address (``/v1/fleet/timeseries`` is fetched)."""
+    import json
+    import os
+    import urllib.request
+
+    if os.path.isfile(src):
+        with open(src) as f:
+            return json.load(f)
+    base = src if src.startswith(("http://", "https://")) else "http://" + src
+    base = base.rstrip("/")
+    if not base.endswith("/v1/fleet/timeseries"):
+        base += "/v1/fleet/timeseries"
+    with urllib.request.urlopen(base, timeout=5) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _render_timeseries_snapshot(label, snap):
+    series = (snap or {}).get("series") or {}
+    interval = snap.get("interval_s", 0) or 0
+    retention = snap.get("retention_points", 0) or 0
+    print(f"{label}  interval={interval:g}s  retention={retention} pts "
+          f"(~{interval * retention:g}s)  window={snap.get('window_s', '?')}s  "
+          f"ticks={snap.get('ticks', '?')}")
+    if not series:
+        print("  (no series sampled yet)")
+        return
+
+    def fmt_ms(v):
+        return f"{v * 1e3:.1f}ms" if v is not None else "—"
+
+    def fmt_rate(v):
+        return f"{v:.2f}/s" if v is not None else "—"
+
+    for name in sorted(series):
+        fam = series[name]
+        pts = fam.get("points") or []
+        if fam.get("kind") == "histogram":
+            # cumulative counts -> per-interval deltas for the sparkline
+            counts = [p[1] for p in pts]
+            deltas = [max(0, b - a) for a, b in zip(counts, counts[1:])]
+            spark = _sparkline(deltas or counts)
+            tail = (f"p50={fmt_ms(fam.get('p50'))} p95={fmt_ms(fam.get('p95'))} "
+                    f"p99={fmt_ms(fam.get('p99'))} rate={fmt_rate(fam.get('rate'))}")
+        elif fam.get("kind") == "counter":
+            values = [p[1] for p in pts]
+            deltas = [max(0.0, b - a) for a, b in zip(values, values[1:])]
+            spark = _sparkline(deltas or values)
+            last = values[-1] if values else None
+            tail = (f"total={last:g} " if last is not None else "") \
+                + f"rate={fmt_rate(fam.get('rate'))}"
+        else:  # gauge
+            values = [p[1] for p in pts]
+            spark = _sparkline(values)
+            tail = f"last={values[-1]:g}" if values else ""
+        print(f"  {name:<34} {spark:<40} {tail}")
+
+
+def timeseries_report(src):
+    """``dstpu_report --timeseries <file | host:port>``: sparkline tables from
+    a ``/v1/fleet/timeseries`` export (router + per-replica sections), a bare
+    store snapshot, or a ``/v1/stats`` doc carrying a ``timeseries`` block."""
+    try:
+        doc = _load_timeseries_doc(src)
+    except Exception as e:
+        print(f"cannot load time series from {src}: {e}")
+        return 2
+    if isinstance(doc, dict) and "series" in doc:
+        sections = [("snapshot", doc)]
+    elif isinstance(doc, dict) and ("router" in doc or "replicas" in doc):
+        sections = []
+        if doc.get("router"):
+            sections.append(("router", doc["router"]))
+        for rid, snap in sorted((doc.get("replicas") or {}).items()):
+            if snap:
+                sections.append((f"replica {rid}", snap))
+    elif isinstance(doc, dict) and isinstance(doc.get("timeseries"), dict):
+        sections = [("engine", doc["timeseries"])]
+    else:
+        print(f"{src}: not a time-series doc (expected 'series', "
+              f"'router'/'replicas', or a stats doc with 'timeseries')")
+        return 2
+    print("-" * 78)
+    print(f"time series ............ {src}")
+    print("-" * 78)
+    if not sections:
+        print("no time-series data (enable telemetry.timeseries on the "
+              "replicas and the router)")
+        return 0
+    for label, snap in sections:
+        _render_timeseries_snapshot(label, snap)
+        print()
+    return 0
+
+
 def overload_report(path):
     """``dstpu_report --overload <loadgen-json>``: render the goodput-vs-
     offered-load table from ``bin/dstpu_loadgen --overload --json`` and flag
@@ -332,14 +447,28 @@ def overload_report(path):
           f"{doc.get('interactive_frac', '?')}, "
           f"{doc.get('requests_per_step', '?')} requests/step)")
     print(f"knee floor ............. {knee_floor:.2f} req/s (90% of capacity)")
+    has_slo = any(isinstance(s.get("slo"), dict) for s in steps)
+    if has_slo:
+        spec = doc.get("slo_spec") or {}
+        print(f"slo .................... {spec.get('metric', 'ttft')} <= "
+              f"{spec.get('target_s', '?')}s for {spec.get('target_ratio', '?')} "
+              f"of requests (burn alert at {spec.get('burn_threshold', '?')}x)")
     print("-" * 78)
     print(f"{'offered':>8} {'req/s':>8} {'goodput':>8} {'ok':>5} "
           f"{'on-ddl':>6} {'shed':>5} {'degr':>5} {'hedged':>6} "
-          f"{'ttft_i_p99':>11} {'ttft_b_p99':>11}")
+          f"{'ttft_i_p99':>11} {'ttft_b_p99':>11}"
+          + (f" {'burn':>7}" if has_slo else ""))
 
     def _p99_ms(step, cls):
         p99 = ((step.get("ttft") or {}).get(cls) or {}).get("p99_s")
         return f"{p99 * 1e3:>9.1f}ms" if p99 is not None else f"{'—':>11}"
+
+    def _burn(step):
+        slo = step.get("slo") or {}
+        burn = slo.get("burn_rate")
+        if burn is None:
+            return f" {'—':>7}"
+        return f" {burn:>6.2f}{'!' if slo.get('breached') else ' '}"
 
     for step in steps:
         marker = "  <- knee" if step is knee else ""
@@ -349,8 +478,19 @@ def overload_report(path):
               f"{step.get('on_deadline', 0):>6} {step.get('shed', 0):>5} "
               f"{step.get('degraded', 0):>5} {step.get('hedged', 0):>6} "
               f"{_p99_ms(step, 'interactive')} {_p99_ms(step, 'batch')}"
-              f"{marker}")
+              + (_burn(step) if has_slo else "")
+              + marker)
     print("-" * 78)
+    if has_slo:
+        first = doc.get("slo_first_breach_step")
+        if first is None:
+            print(f"slo verdict ............ {GREEN_OK} no step breached the "
+                  f"SLO burn threshold")
+        else:
+            breach = steps[first] if 0 <= first < len(steps) else {}
+            print(f"slo verdict ............ first breach at step {first} "
+                  f"({breach.get('offered_x', '?')}x offered, burn "
+                  f"{(breach.get('slo') or {}).get('burn_rate', float('nan')):.2f})")
     if knee is None:
         print(f"verdict ................ {GREEN_OK} goodput held >= 90% of "
               f"capacity through {steps[-1].get('offered_x', 0):.1f}x offered "
@@ -409,6 +549,12 @@ def main(argv=None):
             print("usage: dstpu_report --trace <chrome-trace.json | flight-dump.json>")
             return 2
         return trace_report(argv[idx + 1])
+    if "--timeseries" in argv:
+        idx = argv.index("--timeseries")
+        if idx + 1 >= len(argv):
+            print("usage: dstpu_report --timeseries <timeseries.json | host:port>")
+            return 2
+        return timeseries_report(argv[idx + 1])
     import deepspeed_tpu
     print("-" * 60)
     print("DeepSpeed-TPU C++/JAX environment report")

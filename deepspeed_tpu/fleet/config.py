@@ -189,6 +189,12 @@ class AutoscaleConfig(DeepSpeedConfigModel):
     """Consecutive fully-idle observations (zero queued, zero in-flight,
     pressure below the threshold) before one replica is drained."""
 
+    slo_scale_up: bool = False
+    """Treat an open SLO breach episode (``telemetry.slo`` engine,
+    fast+slow burn over threshold) as a saturated observation — and veto
+    scale-down while it is open. Requires an active telemetry session with
+    SLOs configured; off by default."""
+
 
 class SupervisorConfig(DeepSpeedConfigModel):
     """Knobs for :class:`deepspeed_tpu.fleet.supervisor.ReplicaSupervisor`."""

@@ -316,9 +316,12 @@ class ReplicaManager:
         live = [p for p in probes if p.get("healthy")]
         if self._metrics:
             self._metrics.queue_depth.set(sum(p["queue_depth"] for p in live))
-            if live:
-                self._metrics.kv_pressure.set(
-                    sum(1.0 - p.get("kv_free_frac", 1.0) for p in live) / len(live))
+            # no live replicas means no occupancy — resetting (not freezing at
+            # the last live value) keeps the gauge honest after the final
+            # member is drained, quarantined or removed
+            self._metrics.kv_pressure.set(
+                sum(1.0 - p.get("kv_free_frac", 1.0) for p in live) / len(live)
+                if live else 0.0)
         return probes
 
     def stats(self) -> dict:

@@ -137,6 +137,13 @@ class FleetMetrics:
             "fleet_steal_attempts_total",
             "Steal probes sent to victim replicas (includes races the victim "
             "won by finishing first)")
+        # fleet observability plane (telemetry/collector.py)
+        self.trace_collections = registry.counter(
+            "fleet_trace_collections_total",
+            "Trace-collector pull rounds across the fleet's span rings")
+        self.trace_spans_collected = registry.counter(
+            "fleet_trace_spans_collected_total",
+            "Spans merged into the fleet trace store (deduped, clock-corrected)")
 
     @classmethod
     def maybe_create(cls) -> Optional["FleetMetrics"]:

@@ -144,8 +144,16 @@ class FleetAutoscaler:
                 return "up"
         saturated = (obs["queue_per_replica"] >= cfg.scale_up_queue_depth
                      or obs["kv_pressure"] >= cfg.scale_up_kv_pressure)
+        slo_breach = False
+        if cfg.slo_scale_up:
+            # config-gated: an open SLO breach episode counts as saturation —
+            # the budget is burning even if queue/KV look fine this tick
+            engine = telemetry.get_slo_engine()
+            slo_breach = engine is not None and engine.in_breach()
+            saturated = saturated or slo_breach
         idle = (obs["healthy"] > 0 and obs["queued"] == 0 and obs["active"] == 0
-                and obs["kv_pressure"] < cfg.scale_up_kv_pressure)
+                and obs["kv_pressure"] < cfg.scale_up_kv_pressure
+                and not slo_breach)
         self._saturated_ticks = self._saturated_ticks + 1 if saturated else 0
         self._idle_ticks = self._idle_ticks + 1 if idle else 0
 

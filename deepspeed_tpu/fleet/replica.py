@@ -52,6 +52,7 @@ import urllib.parse
 from enum import Enum
 from typing import Iterator, Optional
 
+from deepspeed_tpu import telemetry
 from deepspeed_tpu.fleet.breaker import CircuitBreaker, backoff_delay
 from deepspeed_tpu.inference.v2.ragged.handoff import \
     CONTENT_TYPE as HANDOFF_CONTENT_TYPE
@@ -268,6 +269,18 @@ class Replica:
         :class:`ReplicaUnavailable` when this replica cannot admit."""
         raise NotImplementedError
 
+    # -------------------------------------------------------- observability --
+    # the in-process SpanRecorder this replica's spans land in, when it shares
+    # one with the caller (LocalReplica); the trace collector dedupes sources
+    # by recorder identity so a shared ring is only drained once
+    span_recorder = None
+
+    def collect_spans(self, since_us: int = 0) -> Optional[dict]:
+        """Drain this replica's span ring for the fleet trace collector:
+        ``{"now_us", "pid", "dropped", "spans": [...]}`` with ``since_us`` in
+        the replica's own clock. None = this replica kind exports nothing."""
+        return None
+
     # ----------------------------------------------------------- data motion --
     def fetch_prefix(self, digests, min_blocks: int = 1,
                      timeout: float = 2.0) -> Optional[bytes]:
@@ -401,7 +414,23 @@ class LocalReplica(Replica):
             doc["prefix_stats"] = {k: s.get(k) for k in
                                    ("lookups", "hits", "hit_rate",
                                     "trie_blocks")}
+        ts = telemetry.get_timeseries()
+        if ts is not None:
+            # fleet time-series rollup rides the probe doc (bounded: the
+            # windowed summary, not the full retention)
+            doc["timeseries"] = ts.snapshot(max_points=64)
         return doc
+
+    @property
+    def span_recorder(self):
+        # an in-process scheduler records into the process-global ring — the
+        # same one the router drains directly; exposing it lets the collector
+        # skip this replica instead of double-ingesting
+        return telemetry.get_span_recorder()
+
+    def collect_spans(self, since_us: int = 0) -> Optional[dict]:
+        recorder = telemetry.get_span_recorder()
+        return recorder.export_since(since_us) if recorder is not None else None
 
     def dispatch(self, doc: dict, resume: bool = False,
                  trace_id: Optional[str] = None,
@@ -676,7 +705,15 @@ class HttpReplica(Replica):
             doc["prefix_stats"] = {k: prefix.get(k) for k in
                                    ("lookups", "hits", "hit_rate",
                                     "trie_blocks")}
+        if isinstance(stats.get("timeseries"), dict):
+            doc["timeseries"] = stats["timeseries"]
         return doc
+
+    def collect_spans(self, since_us: int = 0) -> Optional[dict]:
+        """Pull the subprocess's span ring over the wire; the caller samples
+        its own clock around this call to estimate the offset."""
+        return self._get_json(f"/trace/export?since_us={int(since_us)}",
+                              timeout=5.0)
 
     def dispatch(self, doc: dict, resume: bool = False,
                  trace_id: Optional[str] = None,

@@ -1173,6 +1173,12 @@ class FleetRouter:
         # manager-installed hooks (peer prefix fetch) consult the same
         # chaos schedule as router dispatch
         self._manager.faults = self._faults
+        # fleet trace collector: merges every process's span ring into one
+        # per-trace store (None without a telemetry session — the disabled
+        # path never touches it)
+        self._collector: Optional[telemetry.TraceCollector] = None
+        if telemetry.get_span_recorder() is not None:
+            self._collector = telemetry.TraceCollector(metrics=self._metrics)
 
     @property
     def manager(self) -> ReplicaManager:
@@ -1453,6 +1459,8 @@ class FleetRouter:
         faults = self._faults
         if faults is not None:
             doc["faults"] = faults.report()
+        if self._collector is not None:
+            doc["router"]["trace_collector"] = self._collector.describe()
         return doc
 
     def stats(self) -> dict:
@@ -1462,13 +1470,58 @@ class FleetRouter:
         live = [p for p in probes if p.get("healthy")]
         with self._counter_lock:
             counters = dict(self._counters)
+        slo = telemetry.get_slo_engine()
         return {
             "queue_depth": sum(p["queue_depth"] for p in live),
             "active": {"total": sum(p["active"] for p in live)},
             "replicas": len(probes),
             "draining": self._draining.is_set(),
             "counters": counters,
+            "slo": slo.status() if slo is not None else None,
         }
+
+    # -------------------------------------------------------- observability --
+    def collect_traces(self) -> Optional[telemetry.TraceCollector]:
+        """One collection round over every span source: the router's own
+        recorder plus each replica ring (HttpReplica over ``/trace/export``,
+        LocalReplica deduped against the shared in-process ring). On-demand —
+        the ``/v1/fleet/trace`` handler and tests drive it; probe sweeps stay
+        light."""
+        if self._collector is None:
+            return None
+        self._collector.collect(recorder=telemetry.get_span_recorder(),
+                                replicas=self._manager.replicas())
+        return self._collector
+
+    def fleet_trace(self, trace_id: Optional[str] = None) -> dict:
+        """``/v1/fleet/trace`` body: the merged, clock-corrected Chrome-trace
+        doc (``bin/dstpu_report --trace`` and Perfetto load it unchanged)."""
+        collector = self.collect_traces()
+        if collector is None:
+            return {"traceEvents": [], "displayTimeUnit": "ms",
+                    "collector": None}
+        return collector.chrome_trace(trace_id)
+
+    def fleet_timeseries(self) -> dict:
+        """``/v1/fleet/timeseries`` body: the router process's series plus
+        each replica's rollup off its probe doc."""
+        ts = telemetry.get_timeseries()
+        doc = {"router": ts.snapshot() if ts is not None else None,
+               "replicas": {}}
+        self._manager.sweep_probes()
+        for replica in self._manager.replicas():
+            probe = replica._probe_doc or {}
+            if isinstance(probe.get("timeseries"), dict):
+                doc["replicas"][replica.id] = probe["timeseries"]
+        return doc
+
+    def fleet_slo(self) -> dict:
+        """``/v1/fleet/slo`` body: the SLO engine's objective status (burn
+        rates, open breach episodes), or ``enabled: false`` without one."""
+        engine = telemetry.get_slo_engine()
+        if engine is None:
+            return {"enabled": False, "objectives": [], "in_breach": False}
+        return {"enabled": True, **engine.status()}
 
     # ----------------------------------------------------------------- HTTP --
     @property
@@ -1503,6 +1556,16 @@ class FleetRouter:
                     self._send_json(200, router.fleet_stats())
                 elif path == "/v1/stats":
                     self._send_json(200, router.stats())
+                elif path == "/v1/fleet/trace":
+                    trace_id = None
+                    for part in self.path.partition("?")[2].split("&"):
+                        if part.startswith("trace_id="):
+                            trace_id = part.split("=", 1)[1] or None
+                    self._send_json(200, router.fleet_trace(trace_id))
+                elif path == "/v1/fleet/timeseries":
+                    self._send_json(200, router.fleet_timeseries())
+                elif path == "/v1/fleet/slo":
+                    self._send_json(200, router.fleet_slo())
                 elif path == "/healthz":
                     self._send_json(200, {"status": "draining" if draining.is_set()
                                           else "ok"})
@@ -1652,7 +1715,8 @@ class FleetRouter:
                                         name="dstpu-fleet-router", daemon=True)
         self._thread.start()
         logger.info(f"fleet router: /v1/generate /v1/resume /v1/stats "
-                    f"/v1/fleet/stats /healthz on {self.url}")
+                    f"/v1/fleet/stats /v1/fleet/trace /v1/fleet/timeseries "
+                    f"/v1/fleet/slo /healthz on {self.url}")
         return self
 
     def stop(self, drain: bool = True, timeout: Optional[float] = None) -> None:

@@ -150,6 +150,13 @@ class OverloadConfig(DeepSpeedConfigModel):
     """Bounds on the ``Retry-After`` estimate derived from the measured queue
     drain rate (429/503 responses)."""
 
+    slo_pressure: bool = False
+    """Feed the SLO engine's breach signal (fast-window burn normalized by
+    its alert threshold, in [0, 1]) into the brownout pressure sample as a
+    floor — a burning error budget browns the replica out even while queue
+    depth and KV occupancy look healthy. Requires an active telemetry
+    session with ``telemetry.slo`` configured; off by default."""
+
     @model_validator(mode="after")
     def _ordered_thresholds(self):
         if list(self.brownout_stage_thresholds) != sorted(self.brownout_stage_thresholds):

@@ -483,8 +483,14 @@ class ServingScheduler:
             depth = len(self._queue)
         kv_occupancy = (1.0 - self._engine.free_blocks / self._capacity_blocks
                         if self._capacity_blocks else 0.0)
-        stage = self._brownout.update(max(depth / self._config.queue_capacity,
-                                          kv_occupancy))
+        pressure = max(depth / self._config.queue_capacity, kv_occupancy)
+        if self._config.overload.slo_pressure:
+            # config-gated: a burning error budget floors the pressure sample
+            # even while queue depth and KV occupancy look healthy
+            slo = telemetry.get_slo_engine()
+            if slo is not None:
+                pressure = max(pressure, slo.breach_signal())
+        stage = self._brownout.update(pressure)
         if self._brownout.transitions != self._brownout_transitions_seen:
             delta = self._brownout.transitions - self._brownout_transitions_seen
             self._brownout_transitions_seen = self._brownout.transitions
@@ -769,6 +775,22 @@ class ServingScheduler:
         return self._call_on_loop(_do, timeout=timeout)
 
     def _import_peer_prefix(self, req: Request, have: int) -> bool:
+        """Traced wrapper around :meth:`_import_peer_prefix_inner`: the fetch
+        is a leg of the request's trace — it records under the request root
+        with the original trace id, so a cross-replica KV import shows up in
+        the merged fleet trace instead of as unexplained prefill latency."""
+        spans = self._spans
+        if spans is None:
+            return self._import_peer_prefix_inner(req, have)
+        _t0 = now_us()
+        ok = self._import_peer_prefix_inner(req, have)
+        spans.record("peer_prefix_fetch", cat="serving", ts_us=_t0,
+                     dur_us=now_us() - _t0, trace_id=req.trace_id,
+                     parent_id=req.root_span_id,
+                     args={"uid": req.uid, "have_blocks": have, "imported": ok})
+        return ok
+
+    def _import_peer_prefix_inner(self, req: Request, have: int) -> bool:
         """Fetch KV blocks along the request's prefix chain from a fleet peer
         (the router-installed hook) and publish them into the local trie;
         True = the trie now indexes a deeper prefix than ``have`` blocks and
@@ -1824,6 +1846,12 @@ class ServingScheduler:
             },
             "prefix_cache": prefix_stats,
             "speculative": self._spec_stats(),
+            "timeseries": (ts.snapshot(max_points=64)
+                           if (ts := telemetry.get_timeseries()) is not None
+                           else None),
+            "slo": (slo.status()
+                    if (slo := telemetry.get_slo_engine()) is not None
+                    else None),
             "overload": {
                 "enabled": self._config.overload.enabled,
                 "brownout_stage": self._brownout.stage,

@@ -52,12 +52,14 @@ import base64
 import itertools
 import json
 import math
+import os
 import queue
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from deepspeed_tpu import telemetry
 from deepspeed_tpu.inference.v2.ragged.handoff import \
     CONTENT_TYPE as HANDOFF_CONTENT_TYPE
 from deepspeed_tpu.serving.config import (DEFAULT_MAX_RESUME_BODY_BYTES,
@@ -363,6 +365,26 @@ class ServingServer:
                     else:
                         status = "ok" if scheduler.ready else "starting"
                     self._send_json(200, {"status": status})
+                elif path == "/trace/export":
+                    # fleet trace collection: drain this process's span ring
+                    # for the router-side TraceCollector (since_us is in OUR
+                    # clock; now_us in the reply lets the puller estimate the
+                    # offset from its round-trip)
+                    since_us = 0
+                    query = self.path.partition("?")[2]
+                    for part in query.split("&"):
+                        if part.startswith("since_us="):
+                            try:
+                                since_us = int(part.split("=", 1)[1])
+                            except ValueError:
+                                pass
+                    recorder = telemetry.get_span_recorder()
+                    if recorder is None:
+                        self._send_json(200, {"now_us": telemetry.now_us(),
+                                              "pid": os.getpid(),
+                                              "dropped": 0, "spans": []})
+                    else:
+                        self._send_json(200, recorder.export_since(since_us))
                 else:
                     self._send_json(404, {"error": f"no route {path}"})
 

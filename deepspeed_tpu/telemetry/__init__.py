@@ -21,11 +21,15 @@ Usage::
 import threading
 
 from deepspeed_tpu.telemetry import compile_watch as compile_watch
-from deepspeed_tpu.telemetry.config import (FlightRecorderConfig, TelemetryConfig,
-                                            TelemetryHTTPConfig)
+from deepspeed_tpu.telemetry.collector import TraceCollector
+from deepspeed_tpu.telemetry.config import (FlightRecorderConfig, SLOConfig,
+                                            SLOObjectiveConfig, TelemetryConfig,
+                                            TelemetryHTTPConfig, TimeSeriesConfig)
 from deepspeed_tpu.telemetry.exporter import (TelemetryHTTPServer, scrape_metrics,
                                               start_http_server)
 from deepspeed_tpu.telemetry.flight_recorder import FlightRecorder
+from deepspeed_tpu.telemetry.slo import SLOEngine
+from deepspeed_tpu.telemetry.timeseries import TimeSeriesStore
 from deepspeed_tpu.telemetry.registry import (Counter, Gauge, Histogram, MetricsRegistry,
                                               parse_prometheus_text)
 from deepspeed_tpu.telemetry.spans import (Span, SpanRecorder, TracingTimers,
@@ -35,9 +39,12 @@ from deepspeed_tpu.utils.logging import logger
 
 __all__ = [
     "TelemetryConfig", "TelemetryHTTPConfig", "FlightRecorderConfig", "MetricsRegistry",
+    "TimeSeriesConfig", "SLOConfig", "SLOObjectiveConfig", "TimeSeriesStore",
+    "SLOEngine", "TraceCollector",
     "Counter", "Gauge", "Histogram", "SpanRecorder", "Span", "TracingTimers",
     "TelemetryHTTPServer", "TelemetrySession", "FlightRecorder", "configure",
     "shutdown", "get_registry", "get_span_recorder", "get_flight_recorder",
+    "get_timeseries", "get_slo_engine",
     "is_active", "record_comm_op", "wrap_timers", "start_http_server", "scrape_metrics",
     "parse_prometheus_text", "state", "now_us", "new_trace_id", "new_span_id",
     "trace_context", "current_trace", "compile_watch",
@@ -58,6 +65,8 @@ class _TelemetryState:
         self.spans = None
         self.session = None
         self.flight_recorder = None
+        self.timeseries = None
+        self.slo = None
         self._lock = threading.RLock()
         self._comm_metrics = {}
 
@@ -83,6 +92,16 @@ def get_flight_recorder():
     return state.flight_recorder
 
 
+def get_timeseries():
+    """The active :class:`TimeSeriesStore` (None unless configured)."""
+    return state.timeseries
+
+
+def get_slo_engine():
+    """The active :class:`SLOEngine` (None unless configured)."""
+    return state.slo
+
+
 def is_active():
     return state.active
 
@@ -101,6 +120,9 @@ class TelemetrySession:
         self.config = config
         self.registry = get_registry()
         self.spans = SpanRecorder(max_spans=config.max_spans)
+        self.spans.drop_counter = self.registry.counter(
+            "spans_dropped_total",
+            "Spans dropped from the ring buffer past max_spans")
         self.server = None
         self._closed = False
         # metrics/spans record on every rank (cheap, local); the export
@@ -129,8 +151,23 @@ class TelemetrySession:
             self.flight_recorder = FlightRecorder(config.flight_recorder,
                                                   self.registry,
                                                   spans=self.spans).install()
+        self.timeseries = None
+        self.slo = None
+        if config.timeseries.enabled or config.slo.enabled:
+            # the SLO engine reads windowed deltas from the store, so
+            # enabling SLOs implies the sampler even without timeseries
+            ts_cfg = config.timeseries
+            self.timeseries = TimeSeriesStore(
+                self.registry, interval_s=ts_cfg.interval_s,
+                retention_points=ts_cfg.retention_points,
+                families=ts_cfg.families or None)
+            if config.slo.enabled:
+                self.slo = SLOEngine(config.slo, self.timeseries, self.registry)
+            self.timeseries.start()
         state.spans = self.spans
         state.flight_recorder = self.flight_recorder
+        state.timeseries = self.timeseries
+        state.slo = self.slo
         state.session = self
         state.active = True
 
@@ -152,6 +189,8 @@ class TelemetrySession:
             return
         self._closed = True
         self.flush()
+        if self.timeseries is not None:
+            self.timeseries.stop()
         if self.server is not None:
             self.server.stop()
             self.server = None
@@ -165,6 +204,8 @@ class TelemetrySession:
             state.active = False
             state.session = None
             state.spans = None
+            state.timeseries = None
+            state.slo = None
             if state.flight_recorder is self.flight_recorder:
                 state.flight_recorder = None
             with state._lock:

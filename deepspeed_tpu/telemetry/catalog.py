@@ -150,4 +150,11 @@ METRIC_FAMILIES = {
     "fleet_kv_transport_base64_bytes_total": "KV payload bytes moved as base64 text (compatibility transport, encoded size)",
     "fleet_steals_total": "requests moved off a hot replica by work stealing (re-granted or exported mid-decode)",
     "fleet_steal_attempts_total": "steal probes sent to victim replicas (includes races the victim won)",
+    # fleet observability plane (telemetry/spans.py, telemetry/collector.py,
+    # telemetry/slo.py, fleet/metrics.py)
+    "spans_dropped_total": "spans dropped from the ring buffer past max_spans",
+    "fleet_trace_collections_total": "trace-collector pull rounds across the fleet's span rings",
+    "fleet_trace_spans_collected_total": "spans merged into the fleet trace store (deduped, clock-corrected)",
+    "slo_breaches_total": "SLO breach episodes (fast and slow burn both over threshold)",
+    "slo_burn_rate": "error-budget burn rate per objective and window (fast/slow)",
 }

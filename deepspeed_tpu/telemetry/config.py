@@ -5,7 +5,7 @@ reference scatters over ``comms_logger`` / ``monitor`` / ``flops_profiler``
 into one switch for the metrics registry, span recorder and HTTP exporter.
 """
 
-from typing import Optional
+from typing import List, Optional
 
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 
@@ -58,6 +58,64 @@ class FlightRecorderConfig(DeepSpeedConfigModel):
     """How often the watchdog checks heartbeat ages."""
 
 
+class TimeSeriesConfig(DeepSpeedConfigModel):
+    """Metric time-series history: fixed-interval snapshots of selected
+    registry families into bounded rings, so windowed percentiles/rates
+    ("p99 TTFT over the last minute") are computable locally. Memory is
+    ``retention_points`` points per family; wall coverage is
+    ``interval_s * retention_points`` seconds (defaults: 1s × 600 = 10 min).
+    See ``telemetry/timeseries.py`` and the README retention math."""
+
+    enabled: bool = False
+
+    interval_s: float = 1.0
+    """Sampling resolution (seconds between snapshots)."""
+
+    retention_points: int = 600
+    """Ring capacity per family; oldest points drop beyond this."""
+
+    families: List[str] = []
+    """Registry families to sample; empty = the curated serving/fleet
+    default set (``timeseries.DEFAULT_FAMILIES``)."""
+
+
+class SLOObjectiveConfig(DeepSpeedConfigModel):
+    """One declarative SLO: a metric objective, its target, and the
+    fast/slow burn-rate windows it is evaluated over."""
+
+    name: str = ""
+    """Label for metrics/events/status docs (defaults to the metric kind)."""
+
+    metric: str = "ttft"
+    """Objective kind: ``ttft`` | ``itl`` | ``e2e`` (latency percentile
+    objectives), ``error_rate``, or ``goodput``."""
+
+    target_s: float = 1.0
+    """Latency bound (seconds) an observation must meet — latency kinds."""
+
+    target_ratio: float = 0.99
+    """Promised good fraction; the error budget is ``1 - target_ratio``."""
+
+    fast_window_s: float = 60.0
+    """Fast burn window (quick detection)."""
+
+    slow_window_s: float = 300.0
+    """Slow burn window (blip filtering); both must burn to alert."""
+
+    burn_threshold: float = 2.0
+    """Burn-rate level that counts as a breach in both windows."""
+
+
+class SLOConfig(DeepSpeedConfigModel):
+    """SLO burn-rate engine over the time-series store (requires
+    ``timeseries.enabled``); breaches bump ``slo_breaches_total``, emit a
+    registry event and fire one flight-recorder dump per episode."""
+
+    enabled: bool = False
+
+    objectives: List[SLOObjectiveConfig] = []
+
+
 class TelemetryConfig(DeepSpeedConfigModel):
     enabled: bool = False
 
@@ -88,3 +146,7 @@ class TelemetryConfig(DeepSpeedConfigModel):
     http: TelemetryHTTPConfig = {}
 
     flight_recorder: FlightRecorderConfig = {}
+
+    timeseries: TimeSeriesConfig = {}
+
+    slo: SLOConfig = {}

@@ -210,6 +210,8 @@ class FlightRecorder:
                                  for name, (last, _) in beats.items()},
             "spans": (self._spans.tail(self._config.max_spans)
                       if self._spans is not None else []),
+            "spans_dropped": (self._spans.dropped
+                              if self._spans is not None else 0),
             "events": self._registry.recent_events_snapshot(),
             "metrics": self._registry.snapshot(),
             "state": {},

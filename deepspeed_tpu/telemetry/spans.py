@@ -101,6 +101,9 @@ class SpanRecorder:
         self._lock = threading.Lock()
         self._spans = deque(maxlen=max_spans)
         self.dropped = 0
+        # optional Counter (``spans_dropped_total``) attached by the
+        # telemetry session; a bare recorder stays registry-free
+        self.drop_counter = None
 
     def __len__(self):
         return len(self._spans)
@@ -117,10 +120,15 @@ class SpanRecorder:
             span_id = new_span_id()
         span = Span(name, cat, now_us() if ts_us is None else int(ts_us),
                     int(dur_us), args, trace_id, span_id, parent_id)
+        overflowed = False
         with self._lock:
             if len(self._spans) == self._spans.maxlen:
                 self.dropped += 1
+                overflowed = True
             self._spans.append(span)
+        if overflowed and self.drop_counter is not None:
+            # outside the ring lock: the counter takes the registry lock
+            self.drop_counter.inc()
         return span
 
     @contextmanager
@@ -158,6 +166,16 @@ class SpanRecorder:
             spans = list(self._spans)[-n:]
         return [s.to_dict() for s in spans]
 
+    def export_since(self, since_us=0):
+        """Drain doc for the fleet trace collector (``/trace/export``): spans
+        at or after ``since_us`` plus this process's ``now_us()`` clock so the
+        puller can estimate the clock offset from its round-trip."""
+        with self._lock:
+            spans = [s.to_dict() for s in self._spans if s.ts_us >= since_us]
+            dropped = self.dropped
+        return {"now_us": now_us(), "pid": os.getpid(), "dropped": dropped,
+                "spans": spans}
+
     # -------------------------------------------------------------- export --
     def chrome_trace(self):
         """Chrome-trace dict: complete ("X") events sorted by ts (viewers
@@ -186,7 +204,8 @@ class SpanRecorder:
         meta = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
                  "args": {"name": f"request {trace_id}"}}
                 for trace_id, tid in trace_tids.items()]
-        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+                "spansDropped": self.dropped}
 
     def export_chrome_trace(self, path):
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)

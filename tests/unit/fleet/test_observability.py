@@ -1,0 +1,315 @@
+"""Fleet observability plane (ISSUE acceptance): the router's
+``/v1/fleet/{trace,timeseries,slo}`` surface, the flagship CPU gate (a
+supervised two-subprocess fleet rendering ONE merged cross-process trace),
+and the SLO gate (a seeded overload burst drives the TTFT fast-window burn
+over threshold while the fault-free control at the identical seed stays
+below)."""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.fleet import (FaultConfig, FleetConfig, FleetRouter,
+                                 ReplicaManager, SupervisorConfig)
+from deepspeed_tpu.fleet.config import GlobalQueueConfig
+from deepspeed_tpu.fleet.supervisor import ReplicaSupervisor
+from deepspeed_tpu.telemetry import TelemetryConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+TTFT_OBJECTIVE = {"name": "ttft", "metric": "ttft", "target_s": 0.06,
+                  "target_ratio": 0.9, "fast_window_s": 30.0,
+                  "slow_window_s": 90.0, "burn_threshold": 2.0}
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _post_generate(url, doc, timeout=120):
+    req = urllib.request.Request(url + "/v1/generate",
+                                 data=json.dumps(doc).encode(),
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+# ---------------------------------------------------------------------------
+# the HTTP surface over a local fleet
+# ---------------------------------------------------------------------------
+def test_fleet_observability_endpoints(make_fleet, tmp_path):
+    """One request through a telemetry-enabled fleet surfaces on every new
+    endpoint: the merged trace, the time-series rollup (router + per-replica
+    probe docs), the SLO status, and the scheduler's /v1/stats blocks."""
+    telemetry.configure(TelemetryConfig(
+        enabled=True,
+        timeseries={"enabled": True, "interval_s": 60.0},
+        slo={"enabled": True, "objectives": [TTFT_OBJECTIVE]}))
+    fleet = make_fleet(roles=("mixed",))
+    router = FleetRouter(fleet).start()
+    try:
+        final = _post_generate(router.url,
+                               {"prompt": (np.arange(7) % 64).tolist(),
+                                "max_new_tokens": 2})
+        assert final["state"] == "DONE"
+        trace_id = final["trace_id"]
+        telemetry.get_timeseries().tick()  # one sample -> snapshots have points
+
+        doc = _get(router.url + "/v1/fleet/trace")
+        evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"
+               and e["args"]["trace_id"] == trace_id]
+        assert {"route", "request"} <= {e["name"] for e in evs}
+        assert doc["collector"]["collections"] >= 1
+        assert "local" in doc["collector"]["sources"]
+        only = _get(router.url + f"/v1/fleet/trace?trace_id={trace_id}")
+        assert {e["args"]["trace_id"] for e in only["traceEvents"]
+                if e.get("ph") == "X"} == {trace_id}
+        # the merged doc is exactly what dstpu_report --trace consumes
+        from deepspeed_tpu.env_report import trace_report
+        path = tmp_path / "fleet_trace.json"
+        path.write_text(json.dumps(doc))
+        assert trace_report(str(path)) == 0
+
+        ts_doc = _get(router.url + "/v1/fleet/timeseries")
+        assert ts_doc["router"]["ticks"] >= 1
+        assert "serving_ttft_seconds" in ts_doc["router"]["series"]
+        # per-replica rollup rides the probe doc (LocalReplica shares the
+        # process store here; the shape is what HttpReplica ships)
+        assert set(ts_doc["replicas"]) == {r.id for r in fleet.replicas()}
+
+        slo_doc = _get(router.url + "/v1/fleet/slo")
+        assert slo_doc["enabled"] is True and not slo_doc["in_breach"]
+        assert [o["name"] for o in slo_doc["objectives"]] == ["ttft"]
+
+        # the scheduler's own stats doc carries the same engine + store
+        stats = fleet.replicas()[0].scheduler.stats()
+        assert isinstance(stats["timeseries"], dict)
+        assert stats["slo"]["objectives"][0]["name"] == "ttft"
+    finally:
+        router.stop(drain=False)
+
+
+def test_observability_surface_without_telemetry_is_inert(make_fleet):
+    """Telemetry off (ISSUE acceptance): every surface answers a well-formed
+    'nothing' instead of crashing, the router never builds a collector, and
+    a full routed request plus every observability read costs ZERO registry
+    calls — the disabled paths are one None/boolean check each."""
+    fleet = make_fleet(roles=("mixed",))
+    router = FleetRouter(fleet)
+    final = router.route({"prompt": (np.arange(7) % 64).tolist(),
+                          "max_new_tokens": 2}).result()
+    assert final["state"] == "DONE" and final["trace_id"] is None
+    assert router._collector is None
+    assert router.collect_traces() is None
+    assert router.fleet_trace() == {"traceEvents": [], "displayTimeUnit": "ms",
+                                    "collector": None}
+    ts_doc = router.fleet_timeseries()
+    assert ts_doc["router"] is None and ts_doc["replicas"] == {}
+    assert router.fleet_slo() == {"enabled": False, "objectives": [],
+                                  "in_breach": False}
+    stats = fleet.replicas()[0].scheduler.stats()
+    assert stats["timeseries"] is None and stats["slo"] is None
+    router.fleet_stats()
+    # the zero-cost contract, extended to the collector/time-series/SLO
+    # hooks: nothing above touched the registry
+    assert telemetry.get_registry().api_calls == 0
+
+
+# ---------------------------------------------------------------------------
+# the flagship CPU gate: one trace across three real processes
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_flagship_cross_process_fleet_trace():
+    """A supervised two-subprocess fleet (prefill + decode roles, real
+    ``bin/dstpu_replica`` processes with ``--telemetry``) serves one traced
+    request; ``/v1/fleet/trace`` then renders a SINGLE merged Perfetto doc:
+    router span + prefill leg + decode leg from three distinct pids, all
+    under one trace id, leg spans nested inside the router span after
+    clock-offset correction."""
+    pytest.importorskip("jax")
+    telemetry.configure(TelemetryConfig(enabled=True))
+    cmd = [sys.executable, os.path.join(REPO, "bin", "dstpu_replica"),
+           "--port-file", "{port_file}", "--vocab-size", "64",
+           "--num-blocks", "32", "--max-context", "64", "--telemetry"]
+    manager = ReplicaManager(config=FleetConfig(
+        probe_ttl_s=0.0, connect_timeout_s=5.0, read_timeout_s=180.0))
+    supervisor = ReplicaSupervisor(manager, SupervisorConfig(
+        max_crashes=2, crash_window_s=120.0, poll_interval_s=0.1,
+        ready_timeout_s=300.0, restart_backoff_base_s=0.1,
+        restart_backoff_cap_s=0.5, restart_jitter_frac=0.0))
+    slots = [supervisor.add_process(cmd, role=role,
+                                    env={"JAX_PLATFORMS": "cpu"})
+             for role in ("prefill", "decode")]
+    supervisor.start()
+    try:
+        assert supervisor.wait_ready(timeout=480.0), \
+            [s.describe() for s in slots]
+        router = FleetRouter(manager)
+        routed = router.route({"prompt": (np.arange(9) % 64).tolist(),
+                               "max_new_tokens": 3})
+        final = dict(routed.result())
+        assert final["state"] == "DONE"
+        assert [leg["kind"] for leg in final["legs"]] == ["prefill", "decode"]
+        trace_id = final["trace_id"]
+
+        doc = router.fleet_trace(trace_id)
+        evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"
+               and e["args"]["trace_id"] == trace_id]
+        by_name = {}
+        for e in evs:
+            by_name.setdefault(e["name"], []).append(e)
+
+        # three DISTINCT processes under the one trace id
+        pids = {e["pid"] for e in evs}
+        assert len(pids) == 3 and os.getpid() in pids
+
+        (route, ) = by_name["route"]
+        (hop_prefill, ) = by_name["dispatch:prefill"]
+        (hop_decode, ) = by_name["dispatch:decode"]
+        assert route["pid"] == os.getpid()
+        for hop in (hop_prefill, hop_decode):
+            assert hop["pid"] == os.getpid()
+            assert hop["args"]["parent_id"] == route["args"]["span_id"]
+
+        requests = by_name["request"]
+        assert len(requests) == 2
+        leg_pids = {r["pid"] for r in requests}
+        assert len(leg_pids) == 2 and os.getpid() not in leg_pids
+        assert {r["args"]["parent_id"] for r in requests} == \
+            {hop_prefill["args"]["span_id"], hop_decode["args"]["span_id"]}
+        assert {r["args"]["source"] for r in requests} == \
+            {f"replica:{r.id}" for r in manager.replicas()}
+
+        # the offset-corrected leg spans NEST inside the router span (the
+        # pull round-trip bounds the residual error; allow a little slack)
+        slack = 150_000  # us
+        t0, t1 = route["ts"], route["ts"] + route["dur"]
+        for r in requests:
+            assert r["ts"] >= t0 - slack, (r["ts"], t0)
+            assert r["ts"] + r["dur"] <= t1 + slack, (r["ts"] + r["dur"], t1)
+
+        # the Perfetto metadata names each process track
+        sources = {m["args"]["name"]
+                   for m in doc["traceEvents"]
+                   if m.get("ph") == "M" and m["name"] == "process_name"}
+        assert "local" in sources
+        assert {f"replica:{r.id}" for r in manager.replicas()} <= sources
+    finally:
+        supervisor.stop()
+
+
+# ---------------------------------------------------------------------------
+# the SLO gate: seeded overload burst vs fault-free control, identical seed
+# ---------------------------------------------------------------------------
+def _slo_arm(make_fleet, tmp_path, tag, mean_gap_s, faults):
+    """One gate arm: a fresh telemetry session + single-slot fleet, the
+    PR-14-style seeded open-loop workload (Poisson arrivals, seed 7 in both
+    arms — ``mean_gap_s`` scales the identical schedule), manual window
+    ticks. Returns (slo status, flight-dump count, breach-counter delta)."""
+    dump_dir = str(tmp_path / tag)
+    session = telemetry.configure(TelemetryConfig(
+        enabled=True,
+        flight_recorder={"enabled": True, "dir": dump_dir,
+                         "watchdog_enabled": False, "signal_enabled": False},
+        timeseries={"interval_s": 3600.0},
+        slo={"enabled": True, "objectives": [TTFT_OBJECTIVE]}))
+    # the registry (and slo_breaches_total) persists across the two arms'
+    # sessions: read the counter as a per-arm delta
+    breach_base = telemetry.get_registry().counter("slo_breaches_total").value
+    try:
+        manager = make_fleet(
+            roles=(),
+            config=FleetConfig(
+                probe_ttl_s=0.0, drain_timeout_s=10.0,
+                global_queue=GlobalQueueConfig(max_inflight_per_replica=8,
+                                               capacity=256)),
+            max_tracked_sequences=1)
+        manager.add_local(role="mixed", replica_id="r0")
+        router = FleetRouter(manager)
+        prompt = (np.arange(9) % 64).tolist()
+        # warm OUTSIDE the window and BEFORE the fault arm arms: compiles
+        # must not read as overload
+        for _ in range(2):
+            assert router.route({"prompt": prompt, "max_new_tokens": 24,
+                                 "seed": 0}).result()["state"] == "DONE"
+        if faults is not None:
+            router.set_faults(faults)
+        store = telemetry.get_slo_engine().store
+        store.tick(now=0.0)  # the measurement window opens here
+        # PR-14-style open loop: Poisson arrivals from one seed; the burst
+        # arm compresses the IDENTICAL schedule past the single-slot
+        # replica's capacity, so requests pile up in the scheduler queue and
+        # the queue wait lands in their TTFT. The control's spacing keeps
+        # every request finishing before the next arrives.
+        rng = np.random.default_rng(7)
+        offsets = np.cumsum(rng.exponential(mean_gap_s, 8))
+        finals = [None] * len(offsets)
+        t0 = time.monotonic()
+
+        def _one(i, at):
+            delay = at - (time.monotonic() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            finals[i] = dict(router.route({"prompt": prompt,
+                                           "max_new_tokens": 24,
+                                           "seed": 0}).result())
+
+        threads = [threading.Thread(target=_one, args=(i, at))
+                   for i, at in enumerate(offsets)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert all(f is not None and f["state"] == "DONE" for f in finals)
+        if faults is not None:
+            # the chaos point fired: phantom admissions rode the real burst
+            gq = router.fleet_stats()["router"]["global_queue"]
+            assert gq["phantoms_injected"] > 0
+        store.tick(now=1.0)  # close the window: on_tick evaluates the SLO
+        status = telemetry.get_slo_engine().status()
+        dumps = ([f for f in os.listdir(dump_dir) if "slo_breach" in f]
+                 if os.path.isdir(dump_dir) else [])
+        breaches = (telemetry.get_registry()
+                    .counter("slo_breaches_total").value - breach_base)
+        return status, len(dumps), breaches
+    finally:
+        session.close()
+
+
+@pytest.mark.slow
+def test_slo_gate_burst_breaches_while_control_stays_below(make_fleet,
+                                                           tmp_path):
+    """The SLO gate (ISSUE acceptance): under the PR-14 seeded overload
+    burst — the identical seed-7 open-loop schedule compressed past the
+    single-slot replica's capacity, with the ``overload_burst`` chaos point
+    armed — the TTFT SLO's fast-window burn rate crosses its alert
+    threshold: breach counted, flight dump fired. The fault-free control
+    run at the identical seed, spaced within capacity, stays below."""
+    control, control_dumps, control_breaches = _slo_arm(
+        make_fleet, tmp_path, "control", mean_gap_s=0.6, faults=None)
+    burst, burst_dumps, burst_breaches = _slo_arm(
+        make_fleet, tmp_path, "burst", mean_gap_s=0.02,
+        faults=FaultConfig(enabled=True, seed=3, overload_burst_p=1.0,
+                           overload_burst_requests=4,
+                           overload_burst_hold_s=0.5))
+
+    ctrl_obj = control["objectives"][0]
+    burst_obj = burst["objectives"][0]
+    assert burst_obj["fast_burn"] >= burst_obj["burn_threshold"], burst_obj
+    assert burst_obj["in_breach"] and burst["in_breach"]
+    assert burst_breaches == 1 and burst_dumps == 1
+
+    assert ctrl_obj["fast_burn"] < ctrl_obj["burn_threshold"], ctrl_obj
+    assert not control["in_breach"]
+    assert control_breaches == 0 and control_dumps == 0
+    # the separation is real, not a threshold graze
+    assert burst_obj["fast_burn"] > 2 * max(ctrl_obj["fast_burn"], 0.1)

@@ -164,6 +164,40 @@ def test_quarantined_replica_is_absent_capacity_for_autoscaler(make_fleet):
     assert scaler.step() is None
 
 
+def test_sweep_gauges_reset_when_the_fleet_goes_absent(make_fleet):
+    """ISSUE satellite: the probe-sweep gauges (``fleet_kv_pressure``,
+    ``fleet_queue_depth``) must RESET when every replica is quarantined or
+    removed — a gauge frozen at the last live value reads as healthy
+    occupancy on a fleet that no longer exists."""
+    from deepspeed_tpu import telemetry
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+    manager = make_fleet(roles=("mixed",), config=_fleet_config())
+    reg = telemetry.get_registry()
+    pressure = reg.gauge("fleet_kv_pressure")
+    depth = reg.gauge("fleet_queue_depth")
+    victim = manager.replicas()[0]
+
+    blocker = victim.scheduler.submit((np.arange(7) % 64).tolist(),
+                                      max_new_tokens=100)
+
+    def _pressured():
+        manager.sweep_probes()
+        return pressure.value > 0.0
+
+    _wait(_pressured, timeout=60.0, what="nonzero kv pressure under load")
+    frozen = pressure.value
+
+    victim.state = ReplicaState.QUARANTINED
+    manager.sweep_probes()
+    assert pressure.value == 0.0, \
+        f"kv_pressure froze at {frozen} with zero live replicas"
+    assert depth.value == 0
+
+    victim.state = ReplicaState.UP  # let the blocker finish cleanly
+    blocker.result(timeout=300)
+    manager.sweep_probes()  # back to live: the gauge tracks reality again
+
+
 def test_autoscaler_does_not_double_fill_a_restarting_slot(make_fleet):
     """A supervised slot mid-restart (BACKOFF) is capacity in flight, not a
     hole: the below-min replacement must wait for the supervisor, else every

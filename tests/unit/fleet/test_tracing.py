@@ -1,6 +1,8 @@
 """Cross-replica tracing (ISSUE satellite): one routed request — prefill on
 one replica, decode on another — renders as a SINGLE parented trace:
-route → dispatch:prefill → replica request, dispatch:decode → replica request."""
+route → dispatch:prefill → replica request, dispatch:decode → replica request.
+Plus trace continuity for the ``steal-victim``/``steal`` and peer-prefix-fetch
+legs: every leg a request takes carries the ORIGINAL trace id end-to-end."""
 
 import json
 import urllib.request
@@ -8,7 +10,10 @@ import urllib.request
 import numpy as np
 
 from deepspeed_tpu import telemetry
-from deepspeed_tpu.fleet import FleetRouter
+from deepspeed_tpu.fleet import FleetConfig, FleetRouter
+from deepspeed_tpu.fleet.config import CacheRouteConfig, StealConfig
+from deepspeed_tpu.fleet.router import _rendezvous_score
+from deepspeed_tpu.serving import PrefixCacheConfig, ServingConfig
 from deepspeed_tpu.serving.server import TRACE_HEADER
 
 
@@ -65,3 +70,116 @@ def test_disaggregated_request_is_one_parented_trace(make_fleet):
     # every lifecycle span of both replica legs shares the one trace id
     names = {e["name"] for e in evs}
     assert {"queued", "prefill", "decode"} <= names
+
+
+def _pin_key(target_id, other_id):
+    """A session key whose rendezvous winner is ``target_id``."""
+    for i in range(1000):
+        k = f"pin{i}"
+        if _rendezvous_score(k, target_id) > _rendezvous_score(k, other_id):
+            return k
+    raise AssertionError("rendezvous never favored the target")
+
+
+def _by_name(evs):
+    by = {}
+    for e in evs:
+        by.setdefault(e["name"], []).append(e)
+    return by
+
+
+def test_steal_legs_carry_the_original_trace_id(make_fleet):
+    """Trace continuity through a steal (ISSUE satellite): the victim leg AND
+    the stolen leg — two replicas, two schedulers — both parent under the one
+    router trace, so the Perfetto view shows the regrant, not two orphans."""
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+    manager = make_fleet(roles=(),
+                         config=FleetConfig(probe_ttl_s=0.0,
+                                            drain_timeout_s=10.0,
+                                            steal=StealConfig(
+                                                enabled=True,
+                                                wait_budget_s=0.1,
+                                                load_ratio=1.5)),
+                         max_tracked_sequences=1)
+    manager.add_local(role="mixed", replica_id="r0")
+    manager.add_local(role="mixed", replica_id="r1")
+    r0, _ = manager.replicas()
+    blocker = r0.scheduler.submit((np.arange(7) % 64).tolist(),
+                                  max_new_tokens=300)
+    router = FleetRouter(manager)
+    routed = router.route({"prompt": (np.arange(9) % 64).tolist(),
+                           "max_new_tokens": 4, "seed": 0},
+                          session_key=_pin_key("r0", "r1"))
+    final = dict(routed.result())
+    blocker.result(timeout=300)
+
+    assert final["state"] == "DONE"
+    assert [leg["kind"] for leg in final["legs"]] == ["steal-victim", "steal"]
+    trace_id = final["trace_id"]
+    assert trace_id == routed.trace_id is not None
+
+    by_name = _by_name(_events(trace_id))
+    (route, ) = by_name["route"]
+    (hop_serve, ) = by_name["dispatch:generate"]
+    (hop_steal, ) = by_name["dispatch:steal"]
+    for hop in (hop_serve, hop_steal):
+        assert hop["args"]["parent_id"] == route["args"]["span_id"]
+
+    # BOTH request roots — the cancelled victim and the stolen serve — carry
+    # the original trace id and parent under their own dispatch hop
+    requests = by_name["request"]
+    assert len(requests) == 2
+    states = {r["args"]["state"] for r in requests}
+    assert states == {"CANCELLED", "DONE"}
+    parents = {r["args"]["parent_id"] for r in requests}
+    assert parents == {hop_serve["args"]["span_id"],
+                       hop_steal["args"]["span_id"]}
+    # the stolen leg's lifecycle spans ride the same trace
+    assert {"queued", "prefill", "decode"} <= set(by_name)
+
+
+def test_peer_prefix_fetch_leg_carries_the_trace_id(make_fleet, llama_setup):
+    """Trace continuity through a peer prefix fetch (ISSUE satellite): the
+    cross-replica KV import records a ``peer_prefix_fetch`` span under the
+    request root, on the request's ORIGINAL trace id — cache-warm latency is
+    attributable in the merged trace, not invisible."""
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+    cfg = llama_setup[0]
+    manager = make_fleet(
+        roles=("mixed", "mixed"),
+        serving_config=ServingConfig(
+            prefix_cache=PrefixCacheConfig(enabled=True)),
+        config=FleetConfig(probe_ttl_s=0.0, drain_timeout_s=10.0,
+                           cache_route=CacheRouteConfig(peer_fetch=True)))
+    router = FleetRouter(manager)
+    rng = np.random.default_rng(33)
+    prefix = rng.integers(0, cfg.vocab_size, 3 * 16).tolist()
+
+    warm = router.route({"prompt": prefix
+                         + rng.integers(0, cfg.vocab_size, 6).tolist(),
+                         "max_new_tokens": 1})
+    warm.result()
+    holder_id = warm._legs_meta[0]["replica"]
+    (cold_id, ) = [r.id for r in manager.replicas() if r.id != holder_id]
+
+    routed = router.route({"prompt": prefix
+                           + rng.integers(0, cfg.vocab_size, 6).tolist(),
+                           "max_new_tokens": 2, "routing": "hash"},
+                          session_key=_pin_key(cold_id, holder_id))
+    final = dict(routed.result())
+    assert final["cached_tokens"] == 3 * 16  # the import actually happened
+    trace_id = final["trace_id"]
+    assert trace_id != warm.trace_id  # distinct traces, shared recorder
+
+    by_name = _by_name(_events(trace_id))
+    (route, ) = by_name["route"]
+    (hop, ) = by_name["dispatch:generate"]
+    (request, ) = by_name["request"]
+    (fetch, ) = by_name["peer_prefix_fetch"]
+    assert hop["args"]["parent_id"] == route["args"]["span_id"]
+    assert request["args"]["parent_id"] == hop["args"]["span_id"]
+    assert fetch["args"]["parent_id"] == request["args"]["span_id"]
+    assert fetch["args"]["imported"] is True
+    # the warm (donor-priming) request never leaked into this trace
+    assert all(e["args"]["trace_id"] == trace_id
+               for evs in by_name.values() for e in evs)

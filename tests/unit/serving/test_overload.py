@@ -441,6 +441,94 @@ def test_report_overload_flags_the_knee(tmp_path, capsys):
     assert overload_report(str(tmp_path / "missing.json")) == 2
 
 
+def _loadgen_module():
+    """Load bin/dstpu_loadgen as a module (top-level imports are stdlib-only;
+    main() is __main__-guarded) so its SLO helpers are unit-testable."""
+    import importlib.util
+    from importlib.machinery import SourceFileLoader
+    loader = SourceFileLoader("_dstpu_loadgen_under_test",
+                              os.path.join(REPO, "bin", "dstpu_loadgen"))
+    spec = importlib.util.spec_from_loader(loader.name, loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod
+
+
+class _R:
+    """A loadgen _Result stand-in: just the fields _slo_step_eval reads."""
+
+    def __init__(self, ok=True, ttft_s=None, itl_s=(), e2e_s=None):
+        self.ok = ok
+        self.ttft_s = ttft_s
+        self.itl_s = list(itl_s)
+        self.e2e_s = e2e_s
+
+
+def test_loadgen_slo_spec_and_step_eval(tmp_path):
+    """ISSUE satellite: ``--slo <spec.json>`` parsing (defaults, validation)
+    and the per-step burn-rate scoring the recovery report prints."""
+    lg = _loadgen_module()
+    spec_path = tmp_path / "slo.json"
+    spec_path.write_text(json.dumps({"metric": "ttft", "target_s": 0.05,
+                                     "target_ratio": 0.9}))
+    spec = lg._load_slo_spec(str(spec_path))
+    assert spec == {"metric": "ttft", "target_s": 0.05, "target_ratio": 0.9,
+                    "burn_threshold": 2.0}  # defaults fill the rest
+    for bad in ({"metric": "latency"}, {"target_ratio": 1.5},
+                {"target_s": -1.0}):
+        spec_path.write_text(json.dumps(bad))
+        with pytest.raises(ValueError):
+            lg._load_slo_spec(str(spec_path))
+
+    # ttft scores COMPLETED observations: 3 of 4 over target, the failed
+    # request contributes nothing; burn = 0.75 / (1 - 0.9)
+    step = lg._slo_step_eval([_R(ttft_s=0.01), _R(ttft_s=0.2),
+                              _R(ttft_s=0.3), _R(ttft_s=0.4), _R(ok=False)],
+                             spec)
+    assert (step["bad"], step["total"]) == (3, 4)
+    assert step["burn_rate"] == pytest.approx(7.5)
+    assert step["breached"] is True
+
+    # goodput scores EVERY request against the step deadline
+    g = lg._slo_step_eval([_R(e2e_s=0.5), _R(e2e_s=3.0), _R(ok=False)],
+                          {"metric": "goodput", "target_s": 1.0,
+                           "target_ratio": 0.5, "burn_threshold": 2.0},
+                          deadline_s=2.0)
+    assert (g["bad"], g["total"]) == (2, 3)
+    assert g["breached"] is False  # burn 4/3 < 2
+
+    # itl flattens the per-request inter-token gap lists
+    i = lg._slo_step_eval([_R(itl_s=[0.01, 0.2]), _R(itl_s=[0.02])],
+                          {"metric": "itl", "target_s": 0.1,
+                           "target_ratio": 0.9, "burn_threshold": 2.0})
+    assert (i["bad"], i["total"]) == (1, 3)
+
+
+def test_report_overload_slo_burn_column_and_first_breach(tmp_path, capsys):
+    """ISSUE satellite: an --slo ramp doc renders a per-step burn column
+    (breached steps flagged), the spec line, and the first-breach verdict —
+    riding the existing knee detection unchanged."""
+    from deepspeed_tpu.env_report import overload_report
+    doc = _overload_doc([9.8, 9.5, 6.0, 4.0])
+    for i, (step, burn) in enumerate(zip(doc["steps"],
+                                         [0.5, 1.0, 4.0, 9.0])):
+        step["slo"] = {"metric": "ttft", "bad": i, "total": 8,
+                       "bad_fraction": burn / 10.0, "burn_rate": burn,
+                       "breached": burn >= 2.0}
+    doc["slo_spec"] = {"metric": "ttft", "target_s": 0.05,
+                       "target_ratio": 0.9, "burn_threshold": 2.0}
+    doc["slo_first_breach_step"] = 2
+    path = tmp_path / "ramp.json"
+    path.write_text(json.dumps(doc))
+    assert overload_report(str(path)) == 0
+    out = capsys.readouterr().out
+    assert "burn" in out
+    assert "4.00!" in out          # breached step carries the flag
+    assert "0.50 " in out          # healthy step: burn, no flag
+    assert "first breach at step 2" in out and "1.5x offered" in out
+    assert "<- knee" in out        # knee detection unchanged alongside SLO
+
+
 def test_loadgen_overload_ramp_end_to_end(make_engine, llama_setup):
     """bin/dstpu_loadgen --overload against a live server: capacity phase,
     two ramp steps, JSON artifact, and dstpu_report rendering it."""

@@ -108,6 +108,34 @@ def test_sse_stream_carries_trace_header_and_done_ids(traced_server):
     assert final["uid"] is not None            # ...and the engine uid
 
 
+def test_trace_export_endpoint_drains_the_ring(traced_server):
+    """``GET /trace/export?since_us=`` (ISSUE tentpole): the fleet trace
+    collector's wire surface — the raw span ring as JSON, stamped with the
+    process pid, the remote clock, and the drop count."""
+    import os
+    srv, cfg = traced_server
+    prompt = (np.arange(5) % cfg.vocab_size).tolist()
+    with _post(srv.url, {"prompt": prompt, "max_new_tokens": 2}) as resp:
+        done = json.loads(resp.read())
+    doc = json.loads(urllib.request.urlopen(srv.url + "/trace/export",
+                                            timeout=10).read())
+    assert doc["pid"] == os.getpid()  # in-process server: our pid
+    assert doc["now_us"] > 0 and doc["dropped"] == 0
+    names = {s["name"] for s in doc["spans"]}
+    assert {"request", "queued", "prefill"} <= names
+    root = next(s for s in doc["spans"] if s["name"] == "request")
+    assert root["trace_id"] == done["trace_id"]
+    # incremental pull: a since_us past the high-water mark drains nothing
+    later = json.loads(urllib.request.urlopen(
+        srv.url + f"/trace/export?since_us={doc['now_us'] + 1_000_000}",
+        timeout=10).read())
+    assert later["spans"] == []
+    # a garbage since_us is ignored, not a 500
+    ok = json.loads(urllib.request.urlopen(
+        srv.url + "/trace/export?since_us=banana", timeout=10).read())
+    assert ok["spans"]
+
+
 def test_stats_rows_carry_uid_trace_and_percentiles(traced_server):
     srv, cfg = traced_server
     prompt = (np.arange(4) % cfg.vocab_size).tolist()

@@ -1,0 +1,176 @@
+"""TraceCollector: clock-offset correction, dedupe, incremental pulls with
+lookback, shared-recorder skip, trace eviction, and the merged Chrome doc."""
+
+import json
+import os
+
+from deepspeed_tpu.telemetry import MetricsRegistry, SpanRecorder
+from deepspeed_tpu.telemetry.collector import LOOKBACK_US, TraceCollector
+from deepspeed_tpu.telemetry.spans import now_us
+
+
+class _FakeReplica:
+    """A wire replica: spans stamped on a skewed remote clock."""
+
+    def __init__(self, replica_id, pid, skew_us=0, shared=None):
+        self.id = replica_id  # fleet Replica identity attribute
+        self.pid = pid
+        self.skew_us = skew_us
+        self.span_recorder = shared  # None = over-the-wire (HttpReplica)
+        self.spans = []
+        self.calls = []  # since_us of every pull, for incremental asserts
+        self.fail = False
+
+    def add(self, name, ts_us, dur_us=10, trace_id="t1", span_id=None,
+            parent_id=None):
+        self.spans.append({"name": name, "cat": "serving", "ts_us": ts_us,
+                           "dur_us": dur_us, "trace_id": trace_id,
+                           "span_id": span_id, "parent_id": parent_id,
+                           "args": {}})
+
+    def collect_spans(self, since_us):
+        self.calls.append(since_us)
+        if self.fail:
+            raise OSError("replica unreachable")
+        return {"pid": self.pid,
+                "now_us": now_us() + self.skew_us,
+                "dropped": 0,
+                "spans": [s for s in self.spans if s["ts_us"] >= since_us]}
+
+
+def test_clock_offset_correction_aligns_remote_spans():
+    """A replica whose clock runs 5s ahead: its spans come back corrected
+    onto the collector's clock — a leg span lands INSIDE the router span
+    instead of five seconds in the future."""
+    collector = TraceCollector()
+    local = SpanRecorder()
+    t = now_us()
+    local.record("route", ts_us=t, dur_us=2000, trace_id="t1", span_id="r")
+
+    skew = 5_000_000
+    replica = _FakeReplica("r0", pid=4242, skew_us=skew)
+    # the leg started 100us into the route — stamped on the skewed clock
+    replica.add("request", ts_us=t + 100 + skew, dur_us=1000,
+                span_id="q", parent_id="r")
+
+    collector.collect(recorder=local, replicas=[replica])
+    evs = collector.spans_for("t1")
+    assert [e["name"] for e in evs] == ["route", "request"]
+    route, request = evs
+    # corrected: nested inside the route span, not 5s away (the pull
+    # round-trip bounds the residual error; be generous)
+    assert abs(request["ts"] - (t + 100)) < 100_000
+    assert route["ts"] <= request["ts"]
+    assert request["ts"] + request["dur"] <= route["ts"] + route["dur"] + 100_000
+    assert request["pid"] == 4242 and request["args"]["source"] == "replica:r0"
+    assert route["pid"] == os.getpid() and route["args"]["source"] == "local"
+
+
+def test_incremental_pulls_lookback_and_dedupe():
+    collector = TraceCollector()
+    replica = _FakeReplica("r0", pid=7, skew_us=0)
+    base = now_us()
+    replica.add("a", ts_us=base, span_id="s-a")
+    collector.collect(replicas=[replica])
+    assert replica.calls == [0]  # first pull drains from the beginning
+    assert collector.spans_collected == 1
+
+    # the next pull asks only for the recent window (high-water - lookback)
+    collector.collect(replicas=[replica])
+    assert replica.calls[1] > 0
+    assert replica.calls[1] >= base - LOOKBACK_US - 1_000_000
+    # span "a" was re-sent inside the lookback overlap: deduped, not doubled
+    assert collector.spans_collected == 1
+    assert len(collector.spans_for("t1")) == 1
+
+    # same span_id from a DIFFERENT pid is a distinct span (no cross-process
+    # id collision risk)
+    other = _FakeReplica("r1", pid=8)
+    other.add("a", ts_us=base, span_id="s-a")
+    collector.collect(replicas=[other])
+    assert len(collector.spans_for("t1")) == 2
+
+
+def test_shared_recorder_replicas_are_skipped():
+    """LocalReplica shares the process-global ring with the router: reading
+    it again would double every span, so recorder-identity dedupe skips it
+    (and skips the offset math — same process, same clock)."""
+    collector = TraceCollector()
+    local = SpanRecorder()
+    local.record("route", ts_us=now_us(), dur_us=5, trace_id="t1", span_id="r")
+    shared = _FakeReplica("local0", pid=1, shared=local)
+    collector.collect(recorder=local, replicas=[shared])
+    assert shared.calls == []  # never pulled
+    assert len(collector.spans_for("t1")) == 1
+    # two local replicas sharing one ring: only the first is read
+    collector2 = TraceCollector()
+    a = _FakeReplica("a", pid=1, shared=local)
+    b = _FakeReplica("b", pid=1, shared=local)
+    a.add("x", ts_us=now_us(), span_id="sx")
+    collector2.collect(replicas=[a, b])
+    assert a.calls and b.calls == []
+
+
+def test_unreachable_replica_skips_the_round_not_the_fleet():
+    collector = TraceCollector()
+    dead = _FakeReplica("dead", pid=2)
+    dead.fail = True
+    live = _FakeReplica("live", pid=3)
+    live.add("request", ts_us=now_us(), span_id="s1")
+    collector.collect(replicas=[dead, live])
+    assert len(collector.spans_for("t1")) == 1
+    assert "replica:dead" not in collector.describe()["sources"]
+
+
+def test_spans_without_trace_id_are_dropped_and_traces_evict():
+    collector = TraceCollector(max_traces=2)
+    replica = _FakeReplica("r0", pid=9)
+    t = now_us()
+    replica.add("orphan", ts_us=t, trace_id=None, span_id="o")
+    for i in range(3):
+        replica.add("request", ts_us=t + i, trace_id=f"trace{i}",
+                    span_id=f"s{i}")
+    collector.collect(replicas=[replica])
+    assert collector.trace_ids() == ["trace1", "trace2"]  # oldest evicted
+    assert collector.spans_collected == 3  # the orphan never counted
+
+
+def test_chrome_trace_meta_and_counters():
+    reg = MetricsRegistry()
+
+    class _M:  # the FleetMetrics shape the collector consumes
+        trace_collections = reg.counter("fleet_trace_collections_total", "c")
+        trace_spans_collected = reg.counter("fleet_trace_spans_collected_total", "s")
+
+    collector = TraceCollector(metrics=_M())
+    local = SpanRecorder()
+    t = now_us()
+    local.record("route", ts_us=t, dur_us=10, trace_id="tA", span_id="r1")
+    replica = _FakeReplica("r0", pid=555)
+    replica.add("request", ts_us=t + 1, trace_id="tA", span_id="q1",
+                parent_id="r1")
+    replica.add("request", ts_us=t + 2, trace_id="tB", span_id="q2")
+    collector.collect(recorder=local, replicas=[replica])
+
+    doc = collector.chrome_trace()
+    json.dumps(doc)  # wire-clean
+    assert doc["displayTimeUnit"] == "ms"
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    names = {e["name"] for e in meta}
+    assert names == {"process_name", "thread_name"}
+    proc_names = {e["args"]["name"] for e in meta if e["name"] == "process_name"}
+    assert "local" in proc_names and "replica:r0" in proc_names
+    # one tid per trace, stable across processes
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    tids = {e["args"]["trace_id"]: e["tid"] for e in events}
+    assert len(set(tids.values())) == 2
+    assert doc["collector"]["collections"] == 1
+    assert doc["collector"]["spans_collected"] == 3
+
+    # filtered export: one trace only
+    one = collector.chrome_trace("tB")
+    assert {e["args"]["trace_id"] for e in one["traceEvents"]
+            if e["ph"] == "X"} == {"tB"}
+
+    assert reg.counter("fleet_trace_collections_total").value == 1
+    assert reg.counter("fleet_trace_spans_collected_total").value == 3

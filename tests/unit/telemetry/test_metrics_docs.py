@@ -1,4 +1,4 @@
-"""New metrics cannot land undocumented (ISSUE satellite).
+"""New metrics and HTTP endpoints cannot land undocumented (ISSUE satellite).
 
 Three-way diff chain:
 
@@ -7,6 +7,9 @@ Three-way diff chain:
 2. the catalog and the README metric tables must match exactly;
 3. the families cheap to instantiate at runtime (serving, compile watch,
    flight recorder) must register only cataloged names.
+
+Plus the HTTP-surface audit: every route literal the serving server, fleet
+router, and telemetry exporter handle must appear somewhere in the README.
 """
 
 import os
@@ -52,6 +55,31 @@ def test_readme_tables_match_catalog_exactly():
     assert not missing, f"cataloged metrics missing from README tables: {sorted(missing)}"
     stale = documented - set(METRIC_FAMILIES)
     assert not stale, f"README documents metrics the catalog doesn't know: {sorted(stale)}"
+
+
+# the files that own an HTTP request handler (routes are literal path
+# comparisons inside do_GET/do_POST)
+_SERVER_SOURCES = ("serving/server.py", "fleet/router.py",
+                   "telemetry/exporter.py")
+# a quoted path literal: "/v1/...", "/trace...", "/flight", "/metrics",
+# "/healthz" — quote-anchored so prose inside f-string log lines is skipped
+_ROUTE_RE = re.compile(r"[\"'](/(?:v1|trace|flight|metrics|healthz)[A-Za-z0-9_/]*)[\"']")
+
+
+def test_every_http_route_is_documented_in_readme():
+    routes = set()
+    for rel in _SERVER_SOURCES:
+        with open(os.path.join(SRC, rel)) as f:
+            routes.update(_ROUTE_RE.findall(f.read()))
+    assert {"/v1/generate", "/healthz", "/metrics"} <= routes, (
+        f"the route scan missed known endpoints — regex rotted? got {sorted(routes)}")
+    with open(README) as f:
+        readme = f.read()
+    undocumented = sorted(r for r in routes if r not in readme)
+    assert not undocumented, (
+        f"HTTP routes handled in {_SERVER_SOURCES} but never mentioned in "
+        f"README.md (document them — the Fleet observability section keeps "
+        f"the full surface list): {undocumented}")
 
 
 def test_runtime_registration_stays_within_catalog(tmp_path):

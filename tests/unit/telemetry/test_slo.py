@@ -1,0 +1,162 @@
+"""SLOEngine: burn-rate math, multi-window breach episodes, the flight-dump
+trigger, and the config-gated consumer signals."""
+
+import glob
+import os
+
+import pytest
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.telemetry import MetricsRegistry, SLOConfig, TelemetryConfig
+from deepspeed_tpu.telemetry.slo import SLOEngine
+from deepspeed_tpu.telemetry.timeseries import TimeSeriesStore
+
+TTFT_BUCKETS = (0.1, 0.5, 1.0)
+
+
+def _engine(reg, **objective):
+    spec = {"name": "ttft", "metric": "ttft", "target_s": 0.1,
+            "target_ratio": 0.9, "fast_window_s": 10.0, "slow_window_s": 30.0,
+            "burn_threshold": 2.0}
+    spec.update(objective)
+    store = TimeSeriesStore(reg, interval_s=1.0)
+    config = SLOConfig(enabled=True, objectives=[spec])
+    return SLOEngine(config, store, reg), store
+
+
+def test_burn_rate_is_bad_fraction_over_budget():
+    reg = MetricsRegistry()
+    h = reg.histogram("serving_ttft_seconds", "ttft", buckets=TTFT_BUCKETS)
+    engine, store = _engine(reg)
+    store.tick(now=0.0)
+    # 10 observations, 2 bad (above 0.1s): bad_frac 0.2, allowed 0.1 → burn 2
+    for _ in range(8):
+        h.observe(0.05)
+    for _ in range(2):
+        h.observe(0.9)
+    store.tick(now=1.0)  # on_tick drives evaluate()
+    status = store is engine.store and engine.status()
+    obj = status["objectives"][0]
+    assert obj["fast_burn"] == pytest.approx(2.0, rel=0.01)
+    assert obj["slow_burn"] == pytest.approx(2.0, rel=0.01)
+    # the burn gauges are registered per objective/window and sampled
+    fast = reg.gauge("slo_burn_rate", labels={"slo": "ttft", "window": "fast"})
+    assert fast.value == pytest.approx(2.0, rel=0.01)
+
+
+def test_no_traffic_burns_nothing():
+    reg = MetricsRegistry()
+    reg.histogram("serving_ttft_seconds", "ttft", buckets=TTFT_BUCKETS)
+    engine, store = _engine(reg)
+    store.tick(now=0.0)
+    store.tick(now=1.0)
+    status = engine.status()
+    assert status["objectives"][0]["fast_burn"] == 0.0
+    assert not status["in_breach"]
+    assert engine.breach_signal() == 0.0
+
+
+def test_breach_requires_both_windows_and_counts_episodes_once():
+    reg = MetricsRegistry()
+    h = reg.histogram("serving_ttft_seconds", "ttft", buckets=TTFT_BUCKETS)
+    engine, store = _engine(reg)
+    store.tick(now=0.0)
+    for _ in range(20):
+        h.observe(0.9)  # all bad: burn 10x
+    store.tick(now=1.0)
+    assert engine.in_breach()
+    breaches = reg.counter("slo_breaches_total")
+    assert breaches.value == 1
+    # still breaching on the next tick: same episode, no new count
+    for _ in range(20):
+        h.observe(0.9)
+    store.tick(now=2.0)
+    assert breaches.value == 1
+    # the fast window drains (no new observations) → episode closes even
+    # though the slow window still remembers the burn
+    store.tick(now=12.0)
+    store.tick(now=12.5)
+    assert not engine.in_breach()
+    status = engine.status()["objectives"][0]
+    assert status["fast_burn"] == 0.0 and status["slow_burn"] > 2.0
+    # a fresh burn opens a NEW episode
+    for _ in range(20):
+        h.observe(0.9)
+    store.tick(now=13.0)
+    assert engine.in_breach()
+    assert breaches.value == 2
+    assert status["breaches"] == 1  # snapshot from before the second episode
+    assert engine.status()["objectives"][0]["breaches"] == 2
+
+
+def test_error_rate_and_goodput_objectives():
+    reg = MetricsRegistry()
+    done = reg.counter("serving_completions_total", "done")
+    failed = reg.counter("serving_failures_total", "failed")
+    shed = reg.counter("serving_shed_admission_total", "shed")
+    engine, store = _engine(reg, name="errors", metric="error_rate",
+                            target_ratio=0.95)
+    store.tick(now=0.0)
+    done.inc(8)
+    failed.inc(2)
+    shed.inc(10)
+    store.tick(now=1.0)
+    # error_rate ignores sheds: 2 bad / 10 terminal = 0.2 over 0.05 → 4x
+    obj = engine.status()["objectives"][0]
+    assert obj["fast_burn"] == pytest.approx(4.0)
+
+    reg2 = MetricsRegistry()
+    done2 = reg2.counter("serving_completions_total", "done")
+    shed2 = reg2.counter("serving_shed_admission_total", "shed")
+    engine2, store2 = _engine(reg2, name="goodput", metric="goodput",
+                              target_ratio=0.5)
+    store2.tick(now=0.0)
+    done2.inc(5)
+    shed2.inc(15)
+    store2.tick(now=1.0)
+    # goodput counts sheds: 15 bad / 20 outcomes = 0.75 over 0.5 → 1.5x
+    obj2 = engine2.status()["objectives"][0]
+    assert obj2["fast_burn"] == pytest.approx(1.5)
+
+
+def test_breach_signal_is_normalized_and_clamped():
+    reg = MetricsRegistry()
+    h = reg.histogram("serving_ttft_seconds", "ttft", buckets=TTFT_BUCKETS)
+    engine, store = _engine(reg)
+    store.tick(now=0.0)
+    for _ in range(10):
+        h.observe(0.9)
+    store.tick(now=1.0)
+    assert engine.breach_signal() == 1.0  # 10x burn over a 2x threshold, clamped
+    no_objectives = SLOEngine(SLOConfig(enabled=True), store, reg)
+    assert no_objectives.breach_signal() == 0.0
+
+
+def test_breach_fires_one_flight_dump_per_episode(tmp_path, fresh_telemetry):
+    session = telemetry.configure(TelemetryConfig(
+        enabled=True,
+        flight_recorder={"enabled": True, "dir": str(tmp_path),
+                         "watchdog_enabled": False},
+        timeseries={"interval_s": 60.0},
+        slo={"enabled": True,
+             "objectives": [{"name": "ttft", "metric": "ttft",
+                             "target_s": 0.1, "target_ratio": 0.9,
+                             "fast_window_s": 10.0, "slow_window_s": 30.0,
+                             "burn_threshold": 2.0}]}))
+    try:
+        reg = telemetry.get_registry()
+        store = telemetry.get_timeseries()
+        assert store is not None  # SLO implies the store even without timeseries
+        h = reg.histogram("serving_ttft_seconds", "ttft", buckets=TTFT_BUCKETS)
+        store.tick(now=0.0)
+        for _ in range(20):
+            h.observe(0.9)
+        store.tick(now=1.0)   # breach opens → one dump
+        store.tick(now=2.0)   # same episode → no second dump
+        dumps = glob.glob(os.path.join(str(tmp_path), "*slo_breach*.json"))
+        assert len(dumps) == 1
+        # the stats/fleet surface reads the same engine
+        assert telemetry.get_slo_engine().status()["in_breach"]
+    finally:
+        session.close()
+    assert telemetry.get_slo_engine() is None

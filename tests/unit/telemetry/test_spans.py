@@ -3,7 +3,8 @@
 import json
 import time
 
-from deepspeed_tpu.telemetry import SpanRecorder, TracingTimers
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.telemetry import SpanRecorder, TelemetryConfig, TracingTimers
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
 
 
@@ -15,6 +16,44 @@ def test_ring_buffer_bound_and_drop_count():
     assert rec.dropped == 6
     names = [e["name"] for e in rec.chrome_trace()["traceEvents"]]
     assert names == ["s6", "s7", "s8", "s9"]
+
+
+def test_ring_overflow_increments_spans_dropped_total(tmp_path):
+    """ISSUE satellite: ring overflow is VISIBLE — the session's recorder
+    feeds ``spans_dropped_total``, the ``/trace`` doc carries the drop count,
+    and flight dumps record it too."""
+    session = telemetry.configure(TelemetryConfig(
+        enabled=True, max_spans=4,
+        flight_recorder={"enabled": True, "dir": str(tmp_path),
+                         "watchdog_enabled": False}))
+    try:
+        rec = telemetry.get_span_recorder()
+        for i in range(10):
+            rec.record(f"s{i}", ts_us=i, dur_us=1)
+        counter = telemetry.get_registry().counter("spans_dropped_total")
+        assert counter.value == 6
+        assert rec.chrome_trace()["spansDropped"] == 6
+        path = telemetry.get_flight_recorder().dump("api")
+        with open(path) as f:
+            assert json.load(f)["spans_dropped"] == 6
+        # export_since surfaces the same count for the fleet collector
+        assert rec.export_since(0)["dropped"] == 6
+    finally:
+        session.close()
+    # a bare recorder (no session) stays registry-free: no counter, no crash
+    bare = SpanRecorder(max_spans=2)
+    for i in range(5):
+        bare.record(f"b{i}", ts_us=i)
+    assert bare.dropped == 3
+
+
+def test_export_since_filters_by_timestamp():
+    rec = SpanRecorder()
+    rec.record("old", ts_us=100, dur_us=1)
+    rec.record("new", ts_us=5000, dur_us=1)
+    doc = rec.export_since(1000)
+    assert [s["name"] for s in doc["spans"]] == ["new"]
+    assert doc["pid"] > 0 and doc["now_us"] > 0 and doc["dropped"] == 0
 
 
 def test_span_context_manager_measures():

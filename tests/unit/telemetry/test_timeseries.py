@@ -1,0 +1,152 @@
+"""TimeSeriesStore: bounded rings, windowed deltas/rates/percentiles, the
+snapshot export, the session lifecycle, and the sparkline report."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.telemetry import MetricsRegistry, TelemetryConfig
+from deepspeed_tpu.telemetry.timeseries import TimeSeriesStore, bad_fraction
+
+
+def _store(reg, **kw):
+    kw.setdefault("families", ("req_total", "inflight", "lat_seconds"))
+    return TimeSeriesStore(reg, interval_s=1.0, **kw)
+
+
+def test_windowed_counter_and_gauge_reads():
+    reg = MetricsRegistry()
+    c = reg.counter("req_total", "requests")
+    g = reg.gauge("inflight", "in flight")
+    store = _store(reg)
+    for t in range(5):
+        c.inc(10)
+        g.set(t)
+        store.tick(now=float(t))
+    assert store.ticks == 5
+    assert store.last("req_total") == 50
+    assert store.last("inflight") == 4
+    # window [2, 4]: 50 - 30 over 2 s
+    assert store.window_delta("req_total", 2.0) == 20
+    assert store.window_rate("req_total", 2.0) == pytest.approx(10.0)
+    # unsampled family / single point → None, not a crash
+    assert store.window_delta("missing", 2.0) is None
+
+
+def test_windowed_histogram_percentiles_see_only_the_window():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "lat", buckets=(0.1, 0.5, 1.0))
+    store = _store(reg)
+    # 100 fast observations before the window opens...
+    for _ in range(100):
+        h.observe(0.05)
+    store.tick(now=0.0)
+    # ...then 10 slow ones inside it: the windowed p50 must see ONLY the
+    # slow tail (the cumulative quantile would still say "fast")
+    for _ in range(10):
+        h.observe(0.9)
+    store.tick(now=1.0)
+    p50 = store.window_percentile("lat_seconds", 0.5, window_s=1.5)
+    assert 0.5 < p50 <= 1.0
+    assert h.quantile(0.5) < 0.1  # cumulative view disagrees — that's the point
+    # every window observation is above a 0.5s threshold
+    assert store.window_bad_fraction("lat_seconds", 0.5, 1.5) == pytest.approx(1.0)
+    assert store.window_bad_fraction("lat_seconds", 1.0, 1.5) == pytest.approx(0.0)
+    assert store.window_rate_hist_count("lat_seconds", 1.5) == pytest.approx(10.0)
+
+
+def test_bad_fraction_interpolates_inside_the_straddling_bucket():
+    # 10 observations uniformly assumed inside (0.1, 0.5]; threshold 0.3
+    # sits 50% into the bucket → half are bad
+    assert bad_fraction(10, (0.1, 0.5, 1.0), [0, 10, 0], 0.3) == pytest.approx(0.5)
+    assert bad_fraction(0, (0.1,), [0], 0.05) == 0.0
+
+
+def test_retention_bound_and_label_aggregation():
+    reg = MetricsRegistry()
+    reg.counter("req_total", "r", labels={"op": "a"}).inc(2)
+    reg.counter("req_total", "r", labels={"op": "b"}).inc(3)
+    store = _store(reg, retention_points=4)
+    for t in range(10):
+        store.tick(now=float(t))
+    snap = store.snapshot()
+    points = snap["series"]["req_total"]["points"]
+    assert len(points) == 4  # ring bound
+    assert points[-1][1] == 5  # label sets summed per family
+
+
+def test_snapshot_shape_and_max_points():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "lat", buckets=(0.1, 1.0))
+    c = reg.counter("req_total", "r")
+    store = _store(reg)
+    for t in range(8):
+        h.observe(0.05)
+        c.inc()
+        store.tick(now=float(t))
+    snap = store.snapshot(max_points=3, window_s=10.0)
+    assert snap["interval_s"] == 1.0 and snap["ticks"] == 8
+    hist = snap["series"]["lat_seconds"]
+    assert hist["kind"] == "histogram"
+    assert len(hist["points"]) == 3
+    # histogram points are [t, count, sum]; percentiles ride precomputed
+    assert hist["points"][-1][1] == 8
+    assert hist["p50"] is not None and hist["p99"] is not None
+    ctr = snap["series"]["req_total"]
+    assert ctr["kind"] == "counter" and ctr["rate"] == pytest.approx(1.0)
+    json.dumps(snap)  # must be wire-clean
+
+
+def test_on_tick_hooks_run_and_survive_exceptions():
+    reg = MetricsRegistry()
+    reg.counter("req_total", "r")
+    store = _store(reg)
+    seen = []
+    store.on_tick(lambda s: (_ for _ in ()).throw(RuntimeError("boom")))
+    store.on_tick(seen.append)
+    store.tick(now=0.0)
+    assert seen == [store]
+
+
+def test_session_wires_store_and_disabled_is_none(fresh_telemetry):
+    assert telemetry.get_timeseries() is None
+    session = telemetry.configure(TelemetryConfig(
+        enabled=True, timeseries={"enabled": True, "interval_s": 60.0,
+                                  "retention_points": 16}))
+    try:
+        store = telemetry.get_timeseries()
+        assert store is not None
+        reg = telemetry.get_registry()
+        before = reg.api_calls
+        store.tick()  # sampling reads the registry; it must not count as API
+        assert reg.api_calls == before
+        assert store.ticks >= 1
+    finally:
+        session.close()
+    assert telemetry.get_timeseries() is None
+
+
+def test_report_renders_sparklines(tmp_path, capsys):
+    from deepspeed_tpu.env_report import timeseries_report
+    reg = MetricsRegistry()
+    c = reg.counter("req_total", "r")
+    h = reg.histogram("lat_seconds", "lat", buckets=(0.1, 1.0))
+    store = _store(reg)
+    for t in range(6):
+        c.inc(t)
+        h.observe(0.05 * (t + 1))
+        store.tick(now=float(t))
+    doc = {"router": store.snapshot(), "replicas": {"r0": store.snapshot()}}
+    path = tmp_path / "ts.json"
+    path.write_text(json.dumps(doc))
+    assert timeseries_report(str(path)) == 0
+    out = capsys.readouterr().out
+    assert "router" in out and "replica r0" in out
+    assert "req_total" in out and "lat_seconds" in out
+    assert "p99=" in out
+    # garbage input is a loud rc 2, not a traceback
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    assert timeseries_report(str(bad)) == 2
+    assert timeseries_report(str(tmp_path / "missing.json")) == 2
