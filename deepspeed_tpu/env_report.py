@@ -410,6 +410,127 @@ def timeseries_report(src):
     return 0
 
 
+def _load_kv_doc(src):
+    """A ``--kv`` operand is either a saved JSON stats doc or a live
+    address: ``/v1/fleet/stats`` is tried first (router form), then
+    ``/v1/stats`` (single-replica form)."""
+    import json
+    import os
+    import urllib.request
+
+    if os.path.isfile(src):
+        with open(src) as f:
+            return json.load(f)
+    base = src if src.startswith(("http://", "https://")) else "http://" + src
+    base = base.rstrip("/")
+    if base.endswith("/v1/fleet/stats") or base.endswith("/v1/stats"):
+        urls = [base]
+    else:
+        urls = [base + "/v1/fleet/stats", base + "/v1/stats"]
+    last = None
+    for url in urls:
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return json.loads(resp.read().decode())
+        except Exception as e:  # try the next form; re-raise the last
+            last = e
+    raise last
+
+
+def _render_kv_tiers(tiers):
+    dev_used = tiers.get("device_blocks_used", 0)
+    dev_total = tiers.get("device_blocks_total", 0)
+    budget = tiers.get("host_bytes_budget")
+    print("tier occupancy:")
+    print(f"  device ............... {dev_used}/{dev_total} blocks")
+    print(f"  host ................. {tiers.get('host_entries', 0)} entries, "
+          f"{tiers.get('host_blocks', 0)} blocks, "
+          f"{tiers.get('host_bytes', 0)} bytes"
+          + (f" (budget {budget})" if budget else " (budget unbounded)"))
+    print(f"  disk ................. {tiers.get('disk_entries', 0)} entries, "
+          f"{tiers.get('disk_blocks', 0)} blocks, "
+          f"{tiers.get('disk_bytes', 0)} bytes")
+    print("ladder counters:")
+    print(f"  pressure demotions ... {tiers.get('pressure_demotions', 0)} "
+          f"(demote-before-shed passes, device blocks)")
+    print(f"  host->disk commits ... {tiers.get('demotions', 0)}")
+    print(f"  demote races ......... {tiers.get('demote_races', 0)} "
+          f"(reader won mid-spill; reclaimed to host)")
+    print(f"  writeback ............ {tiers.get('writeback_pending', 0)} "
+          f"pending, {tiers.get('writeback_joins', 0)} joined reads")
+    print(f"  reads ................ host {tiers.get('reads_host', 0)} / "
+          f"disk {tiers.get('reads_disk', 0)}")
+    if "trie_demotions" in tiers:
+        print(f"  prefix trie .......... {tiers.get('trie_offloaded_nodes', 0)} "
+              f"offloaded nodes, {tiers.get('trie_demotions', 0)} demotions, "
+              f"{tiers.get('trie_promotions', 0)} promotions")
+
+
+def _render_park(park):
+    print(f"park store ............. {park.get('sessions', 0)} sessions, "
+          f"{park.get('bytes', 0)} bytes (caps: "
+          f"{park.get('max_sessions', '?')} sessions / "
+          f"{park.get('max_bytes', '?')} bytes, ttl {park.get('ttl_s', '?')}s)")
+    print(f"  parks ................ {park.get('parks', 0)}")
+    print(f"  rehydrate hits ....... {park.get('rehydrate_hits', 0)}")
+    print(f"  rehydrate misses ..... {park.get('rehydrate_misses', 0)} "
+          f"(expired or diverged)")
+    print(f"  corrupt rejects ...... {park.get('corrupt_rejects', 0)}")
+    print(f"  evictions ............ {park.get('evictions', 0)}")
+    inventory = park.get("inventory") or []
+    if inventory:
+        print("parked sessions:")
+        print(f"  {'session':<24} {'tokens':>7} {'bytes':>10} "
+              f"{'tier':<7} {'parked_by':<12} {'age_s':>8}")
+        for row in inventory:
+            print(f"  {str(row.get('session', '?')):<24} "
+                  f"{row.get('tokens', 0):>7} {row.get('bytes', 0):>10} "
+                  f"{str(row.get('tier_source') or '-'):<7} "
+                  f"{str(row.get('parked_by') or '-'):<12} "
+                  f"{row.get('age_s', 0):>8}")
+
+
+def kv_report(src):
+    """``dstpu_report --kv <stats.json | host:port>``: render the tiered KV
+    memory surface — per-tier occupancy and the demotion/promotion counters
+    from a serving ``/v1/stats`` doc (its ``kv_tiers`` block), and the
+    router's parked-session inventory from a ``/v1/fleet/stats`` doc."""
+    try:
+        doc = _load_kv_doc(src)
+    except Exception as e:
+        print(f"cannot load KV stats from {src}: {e}")
+        return 2
+    if not isinstance(doc, dict):
+        print(f"{src}: not a stats doc")
+        return 2
+    print("-" * 78)
+    print(f"tiered KV memory ....... {src}")
+    print("-" * 78)
+    rendered = False
+    if "kv_tiers" in doc:
+        rendered = True
+        tiers = doc.get("kv_tiers")
+        if isinstance(tiers, dict):
+            _render_kv_tiers(tiers)
+        else:
+            print("kv tiers ............... disabled "
+                  "(KVTierConfig.enabled=false)")
+    router = doc.get("router")
+    if isinstance(router, dict):
+        rendered = True
+        park = router.get("park")
+        if isinstance(park, dict):
+            _render_park(park)
+        else:
+            print("park store ............. disabled "
+                  "(ParkConfig.enabled=false)")
+    if not rendered:
+        print(f"{src}: no kv_tiers or router.park block (is this a /v1/stats "
+              f"or /v1/fleet/stats doc?)")
+        return 2
+    return 0
+
+
 def overload_report(path):
     """``dstpu_report --overload <loadgen-json>``: render the goodput-vs-
     offered-load table from ``bin/dstpu_loadgen --overload --json`` and flag
@@ -555,6 +676,12 @@ def main(argv=None):
             print("usage: dstpu_report --timeseries <timeseries.json | host:port>")
             return 2
         return timeseries_report(argv[idx + 1])
+    if "--kv" in argv:
+        idx = argv.index("--kv")
+        if idx + 1 >= len(argv):
+            print("usage: dstpu_report --kv <stats.json | host:port>")
+            return 2
+        return kv_report(argv[idx + 1])
     import deepspeed_tpu
     print("-" * 60)
     print("DeepSpeed-TPU C++/JAX environment report")

@@ -43,11 +43,13 @@ from deepspeed_tpu.fleet.breaker import (BreakerConfig, BreakerState,
                                          CircuitBreaker, backoff_delay)
 from deepspeed_tpu.fleet.config import (AutoscaleConfig, FleetConfig,
                                         GlobalQueueConfig, HedgeConfig,
-                                        ReplicaRole, SupervisorConfig)
+                                        ParkConfig, ReplicaRole,
+                                        SupervisorConfig)
 from deepspeed_tpu.fleet.faults import FaultConfig, FaultInjector
 from deepspeed_tpu.fleet.global_queue import (GlobalQueue, GlobalQueueFull,
                                               QueueWaitExpired)
 from deepspeed_tpu.fleet.manager import ReplicaManager
+from deepspeed_tpu.fleet.park_store import ParkedSession, ParkStore
 from deepspeed_tpu.fleet.metrics import FleetMetrics
 from deepspeed_tpu.fleet.policy import FleetAutoscaler
 from deepspeed_tpu.fleet.replica import (HttpReplica, Leg, LocalReplica, Replica,
@@ -59,8 +61,8 @@ from deepspeed_tpu.fleet.supervisor import ReplicaSlot, ReplicaSupervisor, SlotS
 __all__ = [
     "AutoscaleConfig", "BreakerConfig", "BreakerState", "CircuitBreaker",
     "FaultConfig", "FaultInjector", "FleetConfig", "GlobalQueue",
-    "GlobalQueueConfig", "GlobalQueueFull", "HedgeConfig", "QueueWaitExpired",
-    "ReplicaRole",
+    "GlobalQueueConfig", "GlobalQueueFull", "HedgeConfig", "ParkConfig",
+    "ParkStore", "ParkedSession", "QueueWaitExpired", "ReplicaRole",
     "SupervisorConfig", "ReplicaManager", "FleetMetrics", "FleetAutoscaler",
     "HttpReplica", "Leg", "LocalReplica", "Replica", "ReplicaDied",
     "ReplicaState", "ReplicaUnavailable", "FleetRouter", "RoutedRequest",

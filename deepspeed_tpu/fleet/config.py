@@ -153,6 +153,30 @@ class StealConfig(DeepSpeedConfigModel):
     the move to count as hot→cold; symmetric load never triggers a steal."""
 
 
+class ParkConfig(DeepSpeedConfigModel):
+    """Fleet-parked sessions (``fleet/park_store.py``): a finished-but-
+    continuable session's KV exports as a v2 park frame at the replica and
+    banks at the router under its session key; the session's next turn — a
+    generate whose prompt strictly extends the parked history — rehydrates on
+    ANY replica via an internal resume-with-prompt leg, prefilling only the
+    new suffix. The serving-layer end of the same ladder is
+    ``ServingConfig.kv_tiers`` (device→host→disk); parking is the fleet-global
+    fourth rung. Off by default: parking exports KV at every session finish."""
+
+    enabled: bool = False
+
+    max_sessions: int = Field(256, ge=1)
+    """Parked-session cap; beyond it the coldest (LRU) session drops."""
+
+    max_bytes: int = Field(256 << 20, ge=1)
+    """Byte budget across all parked frames (a park frame is a KV-block dump:
+    kilobytes for a test model, hundreds of megabytes for a real one)."""
+
+    ttl_s: float = Field(600.0, ge=0)
+    """Seconds a parked session survives untouched; 0 = no expiry. A dropped
+    park costs the returning turn a cold prefill, never correctness."""
+
+
 class AutoscaleConfig(DeepSpeedConfigModel):
     """Policy knobs for :class:`deepspeed_tpu.fleet.policy.FleetAutoscaler`."""
 
@@ -336,6 +360,10 @@ class FleetConfig(DeepSpeedConfigModel):
 
     steal: StealConfig = StealConfig()
     """Cross-replica work stealing; see :class:`StealConfig`."""
+
+    park: ParkConfig = ParkConfig()
+    """Fleet-parked sessions that rehydrate on any replica; see
+    :class:`ParkConfig`."""
 
     kv_transport: Literal["binary", "base64"] = "binary"
     """Preferred resume/handoff wire transport toward HTTP replicas:

@@ -48,7 +48,8 @@ from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 # so a typo'd hook cannot silently never fire
 POINTS = ("dispatch_delay", "connect_reset", "http_5xx", "stream_truncate",
           "handoff_corrupt", "replica_kill", "decode_stall", "overload_burst",
-          "peer_fetch_corrupt", "steal_race")
+          "peer_fetch_corrupt", "steal_race", "park_store_corrupt",
+          "demote_race")
 
 _EVENT_LOG_CAP = 512  # per injector, for the recovery report
 
@@ -95,6 +96,19 @@ class FaultConfig(DeepSpeedConfigModel):
     """Per-steal probability that the victim finishes the request while the
     steal decision is in flight: the router must keep the original leg and
     complete exactly once (no duplicate tokens, no lost request)."""
+
+    park_store_corrupt_p: float = Field(0.0, ge=0, le=1)
+    """Per-rehydrate-dispatch probability of corrupting the parked frame
+    sent to the target replica (the store's copy stays pristine): the replica
+    must reject loudly on CRC/framing and the router must fall back to a cold
+    full-prompt run, never continue from half-corrupt KV."""
+
+    demote_race_p: float = Field(0.0, ge=0, le=1)
+    """Per-demotion probability of injecting a concurrent read into the
+    tier writer's spill-to-commit window (``TieredKVStore.race_hook``): the
+    reader must reclaim the entry to host, the writer must discard its
+    orphan file, and the race must be counted — never a read of a
+    half-written spill."""
 
     decode_stall_p: float = Field(0.0, ge=0, le=1)
     """Per-token probability of an injected stall on the leg's token stream

@@ -133,6 +133,8 @@ class ReplicaManager:
                 and self._config.cache_route.enabled
                 and self._config.cache_route.peer_fetch):
             self._install_peer_fetch(replica)
+        if isinstance(replica, LocalReplica):
+            self._install_demote_race(replica)
         with self._lock:
             if replica.id in self._replicas:
                 replica.drain(timeout=0.0)
@@ -204,6 +206,37 @@ class ReplicaManager:
 
         replica.scheduler._peer_fetch = peer_fetch
         replica.scheduler._peer_fetch_notify = notify
+
+    def _install_demote_race(self, replica: LocalReplica) -> None:
+        """Arm the ``demote_race`` chaos point on this replica's tiered KV
+        store: when the schedule fires, a read is injected into the tier
+        writer's spill-to-commit window — the deterministic version of a
+        request touching a sequence mid-demotion. The store must reclaim the
+        entry to host and the writer must discard its orphan spill file
+        (``TieredKVStore`` counts it as a ``demote_race``). The hook closes
+        over ``self.faults`` so it consults whatever injector the router
+        armed, and is a no-op (one None check) when chaos is off."""
+        try:
+            store = replica.engine._state_manager.kv_cache.tiered_store
+        except AttributeError:
+            return  # an engine without the tiered store has nothing to race
+
+        def race_hook(handle):
+            faults = self.faults
+            if faults is None:
+                return
+            if faults.fire("demote_race", replica.id) is None:
+                return
+            if self._metrics is not None:
+                self._metrics.faults_injected.inc()
+            try:
+                # reading inside the window wins the race: the entry reclaims
+                # to host and the writer's commit re-check unlinks its orphan
+                store.read(handle)
+            except KeyError:  # dropped between fire and read: nothing to race
+                pass
+
+        store.race_hook = race_hook
 
     def _make_breaker_observer(self, replica: Replica):
         """Breaker transitions land in the ``fleet_breaker_*`` metrics and the

@@ -137,6 +137,32 @@ class FleetMetrics:
             "fleet_steal_attempts_total",
             "Steal probes sent to victim replicas (includes races the victim "
             "won by finishing first)")
+        # fleet-parked sessions (fleet/park_store.py): the router-side rung
+        # of the tiered KV ladder
+        self.park_sessions = registry.gauge(
+            "fleet_park_sessions",
+            "Sessions currently parked in the router's park store")
+        self.park_bytes = registry.gauge(
+            "fleet_park_bytes",
+            "Bytes of parked KV frames held by the router's park store")
+        self.parks = registry.counter(
+            "fleet_parks_total",
+            "Finished-session KV frames banked in the router's park store")
+        self.park_rehydrates = registry.counter(
+            "fleet_park_rehydrates_total",
+            "Returning turns dispatched as rehydrate legs (parked KV "
+            "imported, only the new suffix prefilled)")
+        self.park_rehydrate_misses = registry.counter(
+            "fleet_park_rehydrate_misses_total",
+            "Known parked sessions that could not rehydrate (expired, or "
+            "the returning prompt diverged from the parked history)")
+        self.park_corrupt_rejects = registry.counter(
+            "fleet_park_corrupt_rejects_total",
+            "Park frames dropped after a loud CRC/framing reject (at park "
+            "validation or by the rehydrating replica; the turn ran cold)")
+        self.park_evictions = registry.counter(
+            "fleet_park_evictions_total",
+            "Parked sessions dropped by the LRU byte/count budget or TTL")
         # fleet observability plane (telemetry/collector.py)
         self.trace_collections = registry.counter(
             "fleet_trace_collections_total",

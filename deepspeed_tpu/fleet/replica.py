@@ -444,11 +444,16 @@ class LocalReplica(Replica):
                       seed=int(doc.get("seed") or 0),
                       trace_id=trace_id, parent_span_id=parent_span_id,
                       handoff=bool(doc.get("handoff")),
+                      park=bool(doc.get("park")),
                       priority=doc.get("priority"))
         try:
             if resume:
                 self.record_kv_bytes("local", len(doc["payload"]))
-                req = self.scheduler.submit_resume(doc["payload"], **kwargs)
+                # a resume doc MAY carry a prompt: the rehydrate form (a
+                # parked session returning with its next turn)
+                req = self.scheduler.submit_resume(doc["payload"],
+                                                   prompt=doc.get("prompt"),
+                                                   **kwargs)
             else:
                 req = self.scheduler.submit(doc["prompt"], **kwargs)
         except AdmissionRejected as e:
@@ -565,6 +570,11 @@ class _HttpLeg(Leg):
                     if "handoff" in event:
                         self._account("base64", len(event["handoff"]))
                         event["handoff"] = base64.b64decode(event["handoff"])
+                    if isinstance(event.get("park"), str):
+                        # a parked-session frame rides the done event base64;
+                        # the router's park store wants the raw bytes
+                        self._account("base64", len(event["park"]))
+                        event["park"] = base64.b64decode(event["park"])
                     elif event.get("handoff_ref") and self._fetch_handoff:
                         # ref'd return transport: the payload never rode the
                         # SSE stream; claim the raw frame out of band

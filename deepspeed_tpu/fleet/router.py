@@ -29,6 +29,14 @@ Dispatch policy per request leg:
   monolithically on the surviving pool — counted in
   ``fleet_degraded_requests_total`` and flagged ``degraded`` in the final
   doc, never silent, never a blanket 502.
+- **parked sessions** (``FleetConfig.park``): a finished-but-continuable
+  session's KV exports as a v2 park frame and banks in the router's
+  :class:`~deepspeed_tpu.fleet.park_store.ParkStore` under its session key;
+  the session's next turn — a generate whose prompt strictly extends the
+  parked history — dispatches as a *rehydrate* resume leg on ANY replica
+  (placement is free to move it), importing the parked turns' KV and
+  prefilling only the new suffix. A refused frame falls back to a cold run;
+  rehydrated legs are excluded from hedging and stealing.
 
 Prefill/decode disaggregation: when both a ``prefill`` and a ``decode`` pool
 exist, a generate request runs as two legs — prefill + first token on a
@@ -65,6 +73,7 @@ from deepspeed_tpu.fleet.global_queue import (GlobalQueue, GlobalQueueFull,
                                               QueueWaitExpired)
 from deepspeed_tpu.fleet.manager import ReplicaManager
 from deepspeed_tpu.fleet.metrics import FleetMetrics
+from deepspeed_tpu.fleet.park_store import ParkStore
 from deepspeed_tpu.fleet.replica import (Leg, Replica, ReplicaDied,
                                          ReplicaUnavailable)
 from deepspeed_tpu.inference.v2.ragged.prefix_cache import (DIGEST_HEX,
@@ -127,6 +136,12 @@ class RoutedRequest:
         self._cancelled = False
         self._degraded = False
         self._hedged = False
+        # fleet-parked sessions: did THIS request dispatch as a rehydrate leg
+        # (parked KV + new-turn prompt)? Rehydrated legs are excluded from
+        # hedging and stealing — their one-shot payload must not race or move
+        self._rehydrated = False
+        self._park_tier: Optional[str] = None
+        self._client_park = bool(doc.get("park"))
         # every leg ever dispatched for this request: cancel() must reach
         # BOTH racers of an undecided hedge, not just _current_leg — an
         # uncancelled loser would stream to completion for a dead client,
@@ -182,9 +197,15 @@ class RoutedRequest:
                                     "the surviving pool")
             self._pool_fn = (lambda: self._dispatchable("decode")
                              or self._dispatchable())
+            extra = {}
+            if doc.get("prompt") is not None:
+                # client-side rehydrate: a parked frame the CLIENT held, plus
+                # the next turn's prompt — forwarded like any resume leg
+                extra["prompt"] = doc["prompt"]
             self._leg1 = self._dispatch(
                 self._leg_doc(payload=doc["payload"],
-                              handoff=self._client_handoff),
+                              handoff=self._client_handoff,
+                              **extra, **self._park_kw()),
                 resume=True, pool_fn=self._pool_fn, what="resume")
         else:
             # whole-request serving: the mixed pool when one exists, else any
@@ -196,10 +217,13 @@ class RoutedRequest:
                     f"unavailable; serving monolithically")
             self._pool_fn = (lambda: self._dispatchable("mixed")
                              or self._dispatchable())
-            self._leg1 = self._dispatch(
-                self._leg_doc(prompt=doc["prompt"],
-                              handoff=self._client_handoff),
-                resume=False, pool_fn=self._pool_fn, what="generate")
+            self._maybe_rehydrate()
+            if not self._rehydrated:
+                self._leg1 = self._dispatch(
+                    self._leg_doc(prompt=doc["prompt"],
+                                  handoff=self._client_handoff,
+                                  **self._park_kw()),
+                    resume=False, pool_fn=self._pool_fn, what="generate")
         self._iter = self._run()
 
     def tokens(self) -> Iterator[int]:
@@ -280,6 +304,82 @@ class RoutedRequest:
         if router._metrics:
             router._metrics.degraded.inc()
         logger.warning(f"fleet: degraded serving: {reason}")
+
+    # ------------------------------------------------------ parked sessions --
+    def _park_kw(self) -> dict:
+        """The ``park`` flag for a leg that may finish this request: set when
+        the client asked for the frame itself, or when the router will bank it
+        (park store armed and a session key rides the request)."""
+        if self._client_park or (self._router._park_store is not None
+                                 and self._session_key):
+            return {"park": True}
+        return {}
+
+    def _maybe_rehydrate(self) -> None:
+        """Try to serve this generate request as a *rehydrate* leg: when the
+        park store holds this session and the new prompt strictly extends the
+        parked token history, dispatch ``/v1/resume`` with the parked frame
+        plus the prompt — the parked turns' KV imports on whichever replica
+        wins placement (ANY replica: the frame is self-describing) and only
+        the new suffix prefills. A replica refusing the frame (ValueError:
+        corruption in transit — the ``park_store_corrupt`` chaos point — or
+        rot at rest) drops the entry, counts a corrupt reject, and this
+        request falls back to the cold full-prompt dispatch; a parked session
+        can cost at most one bounced dispatch, never correctness."""
+        router = self._router
+        store = router._park_store
+        if store is None or not self._session_key:
+            return
+        entry = store.match(self._session_key, self._doc["prompt"])
+        if entry is None:
+            return
+        payload = entry.payload
+        faults = router._faults
+        if faults is not None:
+            n = faults.fire("park_store_corrupt", self._session_key)
+            if n is not None:
+                # corrupt the SENT copy only; the store's stays pristine (the
+                # reject below still drops it — a one-strike policy keeps the
+                # chaos arm deterministic and the fallback path honest)
+                router._count_fault()
+                payload = faults.corrupt(payload, n, self._session_key,
+                                         point="park_store_corrupt")
+        try:
+            self._leg1 = self._dispatch(
+                self._leg_doc(payload=payload, prompt=self._doc["prompt"],
+                              handoff=self._client_handoff,
+                              **self._park_kw()),
+                resume=True, pool_fn=self._pool_fn, what="rehydrate")
+        except (ValueError, TypeError) as e:
+            store.reject(self._session_key)
+            logger.warning(
+                f"fleet: rehydrate frame for session {self._session_key!r} "
+                f"refused ({e}); falling back to a cold run")
+            return
+        self._rehydrated = True
+        self._park_tier = entry.tier_source
+
+    def _maybe_park(self, final: dict) -> None:
+        """Park-at-finish: a final doc carrying a ``park`` frame (the leg was
+        dispatched with ``park=True``) banks in the router's store under the
+        session key. The frame is stripped from the client's doc unless the
+        client asked for it; ``parked: true`` tells the client (and loadgen)
+        the session can return cheaply."""
+        payload = final.get("park")
+        if not self._client_park:
+            final.pop("park", None)
+        if not isinstance(payload, (bytes, bytearray)):
+            return
+        if self._client_park:
+            # the client manages its own copy; the router's base64 encoding
+            # happens at the HTTP layer (same as a raw handoff payload)
+            final["park"] = bytes(payload)
+        store = self._router._park_store
+        if store is None or not self._session_key or self._cancelled:
+            return
+        if store.put(self._session_key, bytes(payload),
+                     replica_id=self._last_replica_id):
+            final["parked"] = True
 
     # ---------------------------------------------------------------- legs --
     def _remaining_deadline_s(self) -> Optional[float]:
@@ -571,7 +671,8 @@ class RoutedRequest:
         disaggregated path has its own decode re-dispatch. Sampled requests
         are fine — both legs run the identical seeded sampler."""
         hcfg = self._router._config.hedge
-        return (hcfg.enabled and not self._resume and not self._cancelled
+        return (hcfg.enabled and not self._resume and not self._rehydrated
+                and not self._cancelled
                 and (not hcfg.interactive_only or self.priority == "interactive"))
 
     def _reader(self, idx: int, leg: Leg, replica_id: str, out) -> None:
@@ -699,7 +800,8 @@ class RoutedRequest:
                         # leg's queue wait is clamped to a token gesture
                         leg2 = self._dispatch(
                             self._leg_doc(prompt=self._doc["prompt"],
-                                          handoff=self._client_handoff),
+                                          handoff=self._client_handoff,
+                                          **self._park_kw()),
                             resume=False, pool_fn=self._pool_fn, what="hedge",
                             exclude={slow_id}, acquire_timeout_s=0.05)
                     except (RoutingError, ValueError, TypeError) as e:
@@ -770,7 +872,8 @@ class RoutedRequest:
         about to miss its deadline is better served by staying put than by
         paying a second dispatch."""
         scfg = self._router._config.steal
-        if not (scfg.enabled and not self._resume and not self._cancelled):
+        if not (scfg.enabled and not self._resume and not self._rehydrated
+                and not self._cancelled):
             return False
         remaining = self._remaining_deadline_s()
         return remaining is None or remaining > scfg.min_deadline_headroom_s
@@ -900,7 +1003,8 @@ class RoutedRequest:
                 leg2 = self._dispatch(
                     self._leg_doc(prompt=self._doc["prompt"],
                                   handoff=self._client_handoff,
-                                  deadline_s=self._remaining_deadline_s()),
+                                  deadline_s=self._remaining_deadline_s(),
+                                  **self._park_kw()),
                     resume=False, pool_fn=self._pool_fn, what="steal",
                     exclude={victim_id})
             else:
@@ -909,7 +1013,8 @@ class RoutedRequest:
                     self._leg_doc(payload=outcome["payload"],
                                   max_new_tokens=self._n - sent,
                                   handoff=self._client_handoff,
-                                  deadline_s=self._remaining_deadline_s()),
+                                  deadline_s=self._remaining_deadline_s(),
+                                  **self._park_kw()),
                     resume=True, pool_fn=self._pool_fn, what="steal-resume",
                     exclude={victim_id}, internal_payload=True)
             stolen_prefix = list(yielded)
@@ -977,7 +1082,9 @@ class RoutedRequest:
                     raise
                 finally:
                     self._finish_leg(self._leg1)
-                self._leg_meta("resume" if self._resume else "serve", final)
+                self._leg_meta("rehydrate" if self._rehydrated
+                               else "resume" if self._resume else "serve",
+                               final)
             if not self._client_handoff:
                 final.pop("handoff", None)
         else:
@@ -1066,7 +1173,10 @@ class RoutedRequest:
                 }
                 if "handoff" in final2:  # the CLIENT asked for a payload
                     final["handoff"] = final2["handoff"]
+                if "park" in final2:  # the decode leg exported a park frame
+                    final["park"] = final2["park"]
 
+        self._maybe_park(final)
         final["trace_id"] = self.trace_id
         final["legs"] = self._legs_meta
         if self._degraded:
@@ -1093,7 +1203,8 @@ class RoutedRequest:
             remaining = max(0.001, float(self._doc["deadline_s"])
                             - (time.monotonic() - self._t0_s))
         doc = self._leg_doc(payload=payload, max_new_tokens=self._n - 1,
-                            handoff=self._client_handoff, deadline_s=remaining)
+                            handoff=self._client_handoff, deadline_s=remaining,
+                            **self._park_kw())
         try:
             return self._dispatch(doc, resume=True,
                                   pool_fn=lambda: self._dispatchable("decode"),
@@ -1138,6 +1249,12 @@ class FleetRouter:
                 retry_after_floor_s=gq_cfg.retry_after_floor_s,
                 retry_after_cap_s=gq_cfg.retry_after_cap_s,
                 metrics=self._metrics)
+        # fleet-parked sessions: finished-but-continuable sessions bank their
+        # KV frame here and rehydrate on ANY replica next turn
+        self._park_store: Optional[ParkStore] = None
+        if self._config.park.enabled:
+            self._park_store = ParkStore(self._config.park,
+                                         metrics=self._metrics)
         # router-observed TTFT samples: the hedge budget's p95 source
         self._ttft_samples = collections.deque(maxlen=128)
         self._ttft_lock = threading.Lock()
@@ -1456,6 +1573,8 @@ class FleetRouter:
             "budget_s": round(hedge_budget, 4) if hedge_budget else None,
             "ttft_samples": n_samples,
         }
+        if self._park_store is not None:
+            doc["router"]["park"] = self._park_store.stats()
         faults = self._faults
         if faults is not None:
             doc["faults"] = faults.report()
@@ -1664,8 +1783,11 @@ class FleetRouter:
 
             @staticmethod
             def _encode_handoff(doc):
-                if isinstance(doc.get("handoff"), (bytes, bytearray)):
-                    doc["handoff"] = base64.b64encode(doc["handoff"]).decode()
+                # raw payload bytes -> base64 for the JSON/SSE wire: handoff
+                # frames and client-requested park frames alike
+                for key in ("handoff", "park"):
+                    if isinstance(doc.get(key), (bytes, bytearray)):
+                        doc[key] = base64.b64encode(doc[key]).decode()
 
             def _stream_sse(self, routed):
                 self.send_response(200)

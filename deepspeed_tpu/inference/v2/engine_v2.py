@@ -507,7 +507,8 @@ class InferenceEngineV2:
 
     # ------------------------------------------------------------- kv handoff --
     def export_sequence(self, uid: int, tokens=(), extra: Optional[dict] = None,
-                        seen_tokens: Optional[int] = None) -> bytes:
+                        seen_tokens: Optional[int] = None,
+                        version: Optional[int] = None) -> bytes:
         """Snapshot ``uid`` as a portable bytes payload — token history, KV-block
         contents and caller ``extra`` state — for :meth:`import_sequence` on
         ANOTHER engine: the fleet prefill→decode KV-block handoff transport,
@@ -517,10 +518,13 @@ class InferenceEngineV2:
         the recipient adopts (chunked decode feeds ahead of the kept history;
         the recipient deterministically recomputes the trimmed tail). The
         sequence stays tracked here; ``flush(uid)`` once the recipient has
-        taken over."""
-        from deepspeed_tpu.inference.v2.ragged.handoff import pack_sequence
+        taken over. ``version`` selects the frame version (None = the live
+        handoff default; ``handoff.PARK_VERSION`` for parked-session frames,
+        which carry a versioned tier record)."""
+        from deepspeed_tpu.inference.v2.ragged.handoff import VERSION, pack_sequence
         return pack_sequence(self._state_manager, uid, tokens, extra=extra,
-                             seen_tokens=seen_tokens)
+                             seen_tokens=seen_tokens,
+                             version=VERSION if version is None else version)
 
     def import_sequence(self, payload: bytes, uid: Optional[int] = None) -> Tuple[int, dict]:
         """Recreate an exported sequence from a :meth:`export_sequence` payload

@@ -42,7 +42,14 @@ import numpy as np
 
 MAGIC = b"DSTPUKV1"
 VERSION = 1
-SUPPORTED_VERSIONS = frozenset({1})
+PARK_VERSION = 2
+"""Payload version for *parked-session* frames (``fleet/park_store.py``): a
+park frame carries a versioned ``extra["tier"]`` record that older builds
+(``SUPPORTED_VERSIONS == {1}``) must reject loudly rather than silently
+ignore — bumping the frame version is what makes the reject loud."""
+SUPPORTED_VERSIONS = frozenset({1, 2})
+TIER_FIELD_VERSION = 1
+"""Schema version of the ``extra["tier"]`` record this build understands."""
 
 CONTENT_TYPE = "application/x-dstpu-handoff"
 """HTTP content type for a raw (un-base64d) frame on the wire — the binary
@@ -72,18 +79,21 @@ def _cache_signature(kv_config) -> dict:
 
 
 def pack_sequence(state_manager, uid: int, tokens, extra: Optional[dict] = None,
-                  seen_tokens: Optional[int] = None) -> bytes:
+                  seen_tokens: Optional[int] = None,
+                  version: int = VERSION) -> bytes:
     """Snapshot ``uid`` from ``state_manager`` into a portable payload.
     ``tokens`` is the full token-id history (the manager tracks counts, not
     ids — the serving layer owns the ids); ``extra`` must be JSON-serializable.
     ``seen_tokens`` overrides the manager's committed count downward when the
     caller knows some trailing KV must be recomputed by the recipient (the
     chunked-decode case: the device loop feeds ahead of the kept history).
+    ``version`` selects the frame version — :data:`PARK_VERSION` for parked
+    sessions (requires a versioned ``extra["tier"]``); live handoffs stay v1.
     The sequence stays tracked on the donor (flush after the recipient has it)."""
     snap = state_manager.export_sequence(uid)
     kv = snap["kv"]
     header = {
-        "version": VERSION,
+        "version": int(version),
         "uid": int(snap["uid"]),
         "seen_tokens": int(snap["seen_tokens"] if seen_tokens is None
                            else min(seen_tokens, snap["seen_tokens"])),
@@ -158,6 +168,28 @@ def _validate_header(header) -> None:
         raise ValueError("handoff header: missing or malformed cache signature")
     if not isinstance(header.get("extra", {}), dict):
         raise ValueError("handoff header: extra must be an object")
+    # the parked-session tier record: v2 frames carry it, v1 frames must NOT
+    # (a v1-with-tier frame would be silently misread by an older build whose
+    # SUPPORTED_VERSIONS is {1} minus this check — the whole point of the
+    # version bump is that old unpacks reject park frames loudly)
+    tier = header.get("extra", {}).get("tier")
+    if header["version"] >= PARK_VERSION:
+        if not isinstance(tier, dict):
+            raise ValueError(
+                "handoff header: a v2 (parked) frame requires a versioned "
+                "extra.tier record")
+        if not isinstance(tier.get("v"), int) or tier["v"] < 1:
+            raise ValueError("handoff header: extra.tier.v must be a positive int")
+        if tier["v"] > TIER_FIELD_VERSION:
+            raise ValueError(
+                f"handoff header: tier record version {tier['v']} is newer "
+                f"than this build speaks (v{TIER_FIELD_VERSION})")
+        if not isinstance(tier.get("source"), str):
+            raise ValueError("handoff header: extra.tier.source must be a "
+                             "tier name string")
+    elif tier is not None:
+        raise ValueError(
+            "handoff header: extra.tier requires payload version >= 2")
     kv_meta = header.get("kv")
     if kv_meta is not None:
         if not isinstance(kv_meta, dict) or not isinstance(kv_meta.get("dtype"), str):

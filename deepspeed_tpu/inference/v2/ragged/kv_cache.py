@@ -13,18 +13,33 @@ so per-block copies are layout-native.
 """
 
 import os
-import uuid
 from typing import Optional, Tuple
 
 import numpy as np
 
 from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
 from deepspeed_tpu.inference.v2.ragged.manager_configs import AllocationMode, KVCacheConfig, MemoryConfig
+from deepspeed_tpu.inference.v2.ragged.tiering import TieredKVStore
 from deepspeed_tpu.utils.logging import logger
 
 
 def _dtype_size(name):
     return {"bfloat16": 2, "float16": 2, "float32": 4, "int8": 1}[name]
+
+
+class _LazyAIO:
+    """Spill-file I/O for the tiered store that defers to the cache's AIO
+    engine — built lazily so a cache that never spills never imports
+    ``ops.aio`` or touches the spill directory."""
+
+    def __init__(self, cache: "BlockedKVCache"):
+        self._cache = cache
+
+    def sync_pwrite(self, buf, path):
+        self._cache._aio_handle().sync_pwrite(buf, path)
+
+    def sync_pread(self, buf, path):
+        self._cache._aio_handle().sync_pread(buf, path)
 
 
 class BlockedKVCache:
@@ -50,17 +65,18 @@ class BlockedKVCache:
         logger.info(f"BlockedKVCache: {num_blocks} blocks x {config.block_size} tokens "
                     f"({num_blocks * block_bytes / 1e9:.2f} GB)")
 
-        # host offload tier (reference BlockedKVCache:40 declares
-        # offload/restore and raises NotImplementedError — implemented here):
-        # handle -> host payload (numpy) or an NVMe file written via the
-        # native AIO engine when offload_path is set
+        # off-device tiers (reference BlockedKVCache:40 declares
+        # offload/restore and raises NotImplementedError — implemented here
+        # as the host→disk ladder in ragged/tiering.py): offloaded payloads
+        # land in host memory and demote to spill files under offload_path
+        # when the host tier runs past its budget
         self._offload_path = offload_path
-        self._host_pool = {}
-        self._next_handle = 0
-        # spill files must be unique per cache instance AND process: two
-        # engines sharing an offload_path must never overwrite each other
-        self._spill_tag = f"{os.getpid()}_{uuid.uuid4().hex[:8]}"
         self._aio = None
+        self._tiers = TieredKVStore(spill_dir=offload_path, io=_LazyAIO(self))
+        # pre-tiering NVMe semantics: offload_path with no host budget means
+        # every offload spills to disk, synchronously (configure_tiering
+        # replaces this with the budgeted async ladder)
+        self._sync_spill = offload_path is not None
         self._restore_fn = None
         self._fork_fn = None
 
@@ -186,53 +202,59 @@ class BlockedKVCache:
         """
         blocks = np.atleast_1d(np.asarray(blocks)).astype(np.int64)
         data = self.gather_blocks(blocks)
-        handle = self._next_handle
-        self._next_handle += 1
-        if self._offload_path is not None:
-            path = os.path.join(self._offload_path,
-                                f"kv_offload_{self._spill_tag}_{handle}.bin")
-            buf = np.ascontiguousarray(data.view(np.uint8).reshape(-1))
-            self._aio_handle().sync_pwrite(buf, path)
-            self._host_pool[handle] = ("nvme", path, data.shape, data.dtype)
-        else:
-            self._host_pool[handle] = ("host", data)
+        handle = self._tiers.put(data)
         self._allocator.free(blocks)
+        if self._sync_spill:
+            self._tiers.demote(handle, wait=True)
         return handle
 
     def restore(self, handle: int) -> np.ndarray:
         """Allocate fresh device blocks, write the offloaded contents back,
         and return the new block ids (see :meth:`offload` on id stability)."""
-        entry = self._host_pool[handle]
-        needed = entry[2][2] if entry[0] == "nvme" else entry[1].shape[2]
+        needed = self._tiers.n_blocks(handle)
         if needed > self._allocator.free_blocks:
             # fail before touching disk: the caller's evict-and-retry loop
             # must not pay a full payload read per failed attempt
             raise ValueError(
                 f"Allocator has {self._allocator.free_blocks} free blocks, "
                 f"but {needed} were requested")
-        if entry[0] == "nvme":
-            _, path, shape, dtype = entry
-            buf = np.empty(int(np.prod(shape)) * dtype.itemsize, np.uint8)
-            self._aio_handle().sync_pread(buf, path)
-            data = buf.view(dtype).reshape(shape)
-        else:
-            data = entry[1]
-        # on failure the payload stays in the pool (and on disk): the caller's
-        # evict-and-retry contract depends on it surviving a failed restore
+        data, _tier = self._tiers.read(handle)
+        # on failure the payload stays in the store (and on disk): the
+        # caller's evict-and-retry contract depends on it surviving a failed
+        # restore
         new_blocks = self.scatter_blocks(data)
-        del self._host_pool[handle]
-        if entry[0] == "nvme":
-            os.unlink(entry[1])
+        self._tiers.drop(handle)
         return new_blocks
 
     def drop_offloaded(self, handle: int) -> None:
         """Discard an offloaded payload without restoring (sequence flushed)."""
-        entry = self._host_pool.pop(handle, None)
-        if entry is not None and entry[0] == "nvme":
-            try:
-                os.unlink(entry[1])
-            except OSError:
-                pass
+        self._tiers.drop(handle)
+
+    def configure_tiering(self, spill_dir: Optional[str] = None,
+                          host_bytes: Optional[int] = None) -> None:
+        """Enable the budgeted host→disk ladder (serving ``kv_tiers`` config
+        arrives after the engine — and this cache — are built). Replaces the
+        legacy spill-everything-synchronously NVMe mode: offloads land in host
+        memory and demote asynchronously when over ``host_bytes``."""
+        if spill_dir is not None:
+            self._offload_path = spill_dir
+        self._sync_spill = False
+        self._tiers.configure(spill_dir=spill_dir, host_bytes=host_bytes)
+
+    def offload_tier(self, handle: int) -> str:
+        """Which tier currently holds an offloaded payload (host | disk)."""
+        return self._tiers.tier_of(handle)
+
+    def demote_offloaded(self, handle: int, wait: bool = False) -> bool:
+        """Push one offloaded payload host→disk (brownout's demote stage)."""
+        return self._tiers.demote(handle, wait=wait)
+
+    def tier_stats(self) -> dict:
+        return self._tiers.stats()
+
+    @property
+    def tiered_store(self) -> TieredKVStore:
+        return self._tiers
 
     def _aio_handle(self):
         if self._aio is None:

@@ -74,7 +74,8 @@ def digest_chain(tokens, block_size: int,
 
 
 class _Node:
-    __slots__ = ("digest", "block", "parent", "children", "last_touch", "tokens")
+    __slots__ = ("digest", "block", "parent", "children", "last_touch",
+                 "tokens", "tier", "handle")
 
     def __init__(self, digest: bytes, block: int, parent: Optional["_Node"],
                  tokens: Optional[np.ndarray] = None):
@@ -86,6 +87,12 @@ class _Node:
         # the block's token ids (host copy): what a prompt-lookup drafter
         # mines — the trie holds exactly the token histories it wants
         self.tokens = tokens
+        # tier ladder state (ragged.tiering.TIERS): a "device" node owns a
+        # trie reference on `block`; a demoted node owns `handle` in the
+        # tiered store instead (block is -1) and promotes back on the next
+        # acquire that walks through it
+        self.tier = "device"
+        self.handle: Optional[int] = None
 
 
 class PrefixHit:
@@ -122,6 +129,7 @@ class PrefixCache:
         # thread, but digest_catalog() snapshots from probe threads
         self._index_lock = threading.Lock()
         self._clock = 0  # monotonic LRU counter (no wall clock: deterministic)
+        self._device_nodes = 0  # nodes whose tier is "device" (pinned blocks)
         # stats (read lock-free from stats threads; written on scheduler thread)
         self.lookups = 0
         self.hits = 0
@@ -129,6 +137,9 @@ class PrefixCache:
         self.tokens_served = 0   # prompt tokens served from cache
         self.evictions = 0       # trie leaves evicted (blocks unpinned)
         self.published_blocks = 0
+        self.tier_demotions = 0   # nodes moved device→store (host tier)
+        self.tier_promotions = 0  # nodes moved store→device on acquire
+        self.promote_failures = 0  # promotions lost to device pressure
 
     # ------------------------------------------------------------- hashing --
     def chain(self, tokens, base: Optional[List[bytes]] = None) -> List[bytes]:
@@ -154,6 +165,11 @@ class PrefixCache:
             child = node.children.get(digest)
             if child is None:
                 break
+            if child.tier != "device" and not self._promote(child):
+                # demoted node and no room to bring it back: the hit ends at
+                # the deepest device-resident (or promotable) depth — a miss
+                # at this depth, never a stall
+                break
             matched.append(child)
             node = child
         if len(matched) < self._min_prefix_blocks:
@@ -164,6 +180,28 @@ class PrefixCache:
         blocks = [n.block for n in matched]
         self._kv.incref(blocks)
         return PrefixHit(blocks, len(blocks) * self._block_size)
+
+    def _promote(self, node: _Node) -> bool:
+        """Bring a demoted node's block back onto the device (store read →
+        ``scatter_blocks``). Failure (device pool full, store entry gone)
+        leaves the node demoted and its payload intact — the caller treats
+        that depth as a miss."""
+        store = getattr(self._kv, "tiered_store", None)
+        if store is None or node.handle is None:
+            return False
+        try:
+            data, _tier = store.read(node.handle)
+            new_blocks = self._kv.scatter_blocks(data)
+        except Exception:
+            self.promote_failures += 1
+            return False
+        store.drop(node.handle)
+        node.block = int(new_blocks[0])
+        node.handle = None
+        node.tier = "device"
+        self._device_nodes += 1
+        self.tier_promotions += 1
+        return True
 
     def record_hit(self, n_blocks: int, tokens: int) -> None:
         """Account a hit the scheduler actually *applied* (a degraded or
@@ -247,7 +285,10 @@ class PrefixCache:
         self._clock += 1
         for digest in digests:
             child = node.children.get(digest)
-            if child is None:
+            if child is None or child.tier != "device":
+                # a donor serves only device-resident KV — promoting on a
+                # peer's behalf would charge this replica's pool for another
+                # replica's miss
                 break
             child.last_touch = self._clock  # a fetched path is a hot path
             blocks.append(child.block)
@@ -292,6 +333,7 @@ class PrefixCache:
                 node.children[digest] = child
                 with self._index_lock:
                     self._by_digest[digest] = child
+                self._device_nodes += 1
                 added += 1
             child.last_touch = self._clock
             node = child
@@ -302,13 +344,51 @@ class PrefixCache:
     # ------------------------------------------------------------- evict --
     @property
     def n_blocks(self) -> int:
-        """Device blocks currently pinned by the trie."""
-        return len(self._by_digest)
+        """Device blocks currently pinned by the trie (demoted nodes pin
+        none — their payloads live in the tiered store)."""
+        return self._device_nodes
+
+    @property
+    def offloaded_nodes(self) -> int:
+        return len(self._by_digest) - self._device_nodes
 
     def _evictable_leaves(self, protect) -> List[_Node]:
         return [n for n in self._by_digest.values()
                 if not n.children and id(n) not in protect
+                and n.tier == "device"
                 and self._kv.ref_count(n.block) == 1]
+
+    def _demotable_nodes(self, protect) -> List[_Node]:
+        """Nodes whose device block can be demoted: device-resident with only
+        the trie's reference (freeing a block a live sequence still maps
+        reclaims nothing). Interior nodes qualify — a demoted mid-path node
+        promotes back when an acquire walks through it."""
+        return [n for n in self._by_digest.values()
+                if n.tier == "device" and id(n) not in protect
+                and self._kv.ref_count(n.block) == 1]
+
+    def demote(self, n_blocks: int = 1, protect=frozenset()) -> int:
+        """Move up to ``n_blocks`` trie blocks off the device into the tiered
+        store (coldest first), freeing their device blocks WITHOUT forgetting
+        the cached KV — the scheduler's ``_evict_one`` and the brownout
+        demote-before-shed stage prefer this over :meth:`evict`, which
+        discards. Returns how many device blocks were freed."""
+        store = getattr(self._kv, "tiered_store", None)
+        if store is None:
+            return 0
+        nodes = self._demotable_nodes(protect)
+        nodes.sort(key=lambda n: n.last_touch)
+        demoted = 0
+        for node in nodes[:max(0, n_blocks)]:
+            data = self._kv.gather_blocks([node.block])
+            node.handle = store.put(data)
+            self._kv.free([node.block])
+            node.block = -1
+            node.tier = "host"
+            self._device_nodes -= 1
+            demoted += 1
+        self.tier_demotions += demoted
+        return demoted
 
     def evict(self, n_blocks: int = 1, protect=frozenset()) -> int:
         """Unpin up to ``n_blocks`` device blocks, LRU-first, restricted to
@@ -342,7 +422,13 @@ class PrefixCache:
         del node.parent.children[node.digest]
         with self._index_lock:
             del self._by_digest[node.digest]
-        self._kv.free([node.block])
+        if node.tier == "device":
+            self._kv.free([node.block])
+            self._device_nodes -= 1
+        elif node.handle is not None:
+            store = getattr(self._kv, "tiered_store", None)
+            if store is not None:
+                store.drop(node.handle)
 
     def _make_room(self, n: int, protect=frozenset()) -> bool:
         """Ensure the trie can pin ``n`` more blocks under ``max_blocks``."""
@@ -356,12 +442,17 @@ class PrefixCache:
     def clear(self) -> None:
         """Release every trie reference (scheduler shutdown): blocks shared
         with still-live sequences survive until those sequences flush."""
+        store = getattr(self._kv, "tiered_store", None)
         for node in list(self._by_digest.values()):
             node.children.clear()
         for node in list(self._by_digest.values()):
             with self._index_lock:
                 del self._by_digest[node.digest]
-            self._kv.free([node.block])
+            if node.tier == "device":
+                self._kv.free([node.block])
+                self._device_nodes -= 1
+            elif node.handle is not None and store is not None:
+                store.drop(node.handle)
         self._root.children.clear()
 
     # --------------------------------------------------------------- stats --
@@ -377,4 +468,8 @@ class PrefixCache:
             "evictions": self.evictions,
             "published_blocks": self.published_blocks,
             "max_blocks": self._max_blocks,
+            "offloaded_nodes": self.offloaded_nodes,
+            "tier_demotions": self.tier_demotions,
+            "tier_promotions": self.tier_promotions,
+            "promote_failures": self.promote_failures,
         }

@@ -85,6 +85,15 @@ class DSStateManager:
     def is_offloaded(self, uid: int) -> bool:
         return uid in self._offloaded
 
+    def sequence_tier(self, uid: int) -> str:
+        """Which tier of the KV ladder currently holds ``uid``'s cache:
+        ``device`` for a resident block table, else the tiered store's answer
+        (``host`` | ``disk``) for the offloaded payload."""
+        handle = self._offloaded.get(uid)
+        if handle is None:
+            return "device"
+        return self._kv_cache.offload_tier(handle)
+
     def offload_sequence(self, uid: int) -> None:
         """Evict a (cold) sequence's KV blocks to the host tier, freeing its
         device blocks for other sequences. The sequence stays tracked; the
@@ -99,6 +108,22 @@ class DSStateManager:
         if seq.cur_allocated_blocks == 0:
             return
         self._offloaded[uid] = self._kv_cache.offload(seq.kv_blocks)
+        seq.kv_tier = self.sequence_tier(uid)
+
+    def demote_sequence(self, uid: int, wait: bool = False) -> bool:
+        """Push an already-offloaded sequence one tier colder (host→disk);
+        returns whether a demotion was scheduled. The brownout controller's
+        demote-before-shed stage calls this for the coldest offloaded
+        sessions before any queued work is shed."""
+        handle = self._offloaded.get(uid)
+        if handle is None:
+            return False
+        demoted = self._kv_cache.demote_offloaded(handle, wait=wait)
+        if demoted:
+            seq = self._seqs.get(uid)
+            if seq is not None:
+                seq.kv_tier = "disk" if wait else self.sequence_tier(uid)
+        return demoted
 
     def restore_sequence(self, uid: int) -> None:
         """Bring an offloaded sequence's KV back into fresh device blocks and
@@ -112,7 +137,9 @@ class DSStateManager:
         except Exception:
             self._offloaded[uid] = handle  # payload intact; caller may evict + retry
             raise
-        self._seqs[uid].replace_kv_blocks(new_blocks)
+        seq = self._seqs[uid]
+        seq.replace_kv_blocks(new_blocks)
+        seq.kv_tier = "device"
 
     # ------------------------------------------------------------ kv handoff --
     def export_sequence(self, uid: int) -> dict:

@@ -17,6 +17,11 @@ class DSSequenceDescriptor:
         self._in_flight_tokens = 0
         self._max_blocks = max_blocks_per_seq
         self._kv_blocks: List[int] = []
+        # which tier of the KV ladder holds this sequence's cache — one of
+        # ragged.tiering.TIERS. "device" while the block table is live; the
+        # state manager flips it to the store-reported tier across an
+        # offload (ragged_manager.offload_sequence / restore_sequence)
+        self.kv_tier: str = "device"
 
     @property
     def seen_tokens(self) -> int:

@@ -17,8 +17,9 @@ Usage::
     server.stop()                               # graceful drain
 """
 
-from deepspeed_tpu.serving.config import (OverloadConfig, PrefixCacheConfig,
-                                          ServingConfig, SpeculativeConfig)
+from deepspeed_tpu.serving.config import (KVTierConfig, OverloadConfig,
+                                          PrefixCacheConfig, ServingConfig,
+                                          SpeculativeConfig)
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.overload import (PRIORITIES, BrownoutController,
                                             RateEstimator)
@@ -29,7 +30,7 @@ from deepspeed_tpu.serving.scheduler import (AdmissionRejected, QueueFullError,
 from deepspeed_tpu.serving.server import ServingServer
 
 __all__ = [
-    "OverloadConfig", "PrefixCacheConfig", "SpeculativeConfig", "PRIORITIES",
+    "KVTierConfig", "OverloadConfig", "PrefixCacheConfig", "SpeculativeConfig", "PRIORITIES",
     "BrownoutController", "RateEstimator",
     "ServingConfig", "ServingMetrics", "Request", "RequestState", "TERMINAL_STATES",
     "TokenStream", "ServingScheduler", "AdmissionRejected", "QueueFullError",

@@ -89,6 +89,32 @@ class SpeculativeConfig(DeepSpeedConfigModel):
         return self
 
 
+class KVTierConfig(DeepSpeedConfigModel):
+    """Tiered KV memory (``inference/v2/ragged/tiering.py`` +
+    ``serving/kv_tiers.py``): device blocks → host memory → disk spill files.
+    Off by default — when enabled, KV pressure *demotes* cached-but-idle
+    state down the ladder (prefix-trie nodes first, then offloaded sessions
+    host→disk) before anything is evicted or shed, and brownout gains a
+    demote stage ahead of shedding."""
+
+    enabled: bool = False
+    """Run the tiered ladder: configure the engine's tiered store with the
+    budget/spill policy below and demote under pressure."""
+
+    host_bytes: Optional[int] = Field(None, ge=0)
+    """Host-tier budget in bytes: when host-resident offloaded KV exceeds it
+    (and ``spill_dir`` is set), the coldest entries demote to disk on the
+    async writer. None = unbounded host tier."""
+
+    spill_dir: Optional[str] = None
+    """Disk-tier directory for spill files; None = the host tier is the
+    floor (nothing demotes to disk)."""
+
+    demote_batch: int = Field(4, ge=1)
+    """Device blocks demoted per pressure tick (brownout's demote-before-shed
+    stage and the scheduler's demote-first eviction)."""
+
+
 class OverloadConfig(DeepSpeedConfigModel):
     """Overload control (``serving/overload.py``): priority admission,
     deadline-aware shedding and staged brownout degradation. Enabled by
@@ -230,6 +256,10 @@ class ServingConfig(DeepSpeedConfigModel):
     overload: OverloadConfig = OverloadConfig()
     """Overload control: priority admission, deadline-aware shedding, staged
     brownout degradation; see :class:`OverloadConfig`."""
+
+    kv_tiers: KVTierConfig = KVTierConfig()
+    """Tiered KV memory (device→host→disk demotion under pressure); see
+    :class:`KVTierConfig`."""
 
     max_resume_body_bytes: int = Field(DEFAULT_MAX_RESUME_BODY_BYTES, gt=0)
     """Upper bound on a ``POST /v1/resume`` body (the base64 KV-handoff

@@ -100,6 +100,22 @@ class ServingMetrics:
         self.brownout_rejections = registry.counter(
             "serving_brownout_rejections_total",
             "Batch-class requests rejected outright at brownout stage 3")
+        # tiered KV memory (inference/v2/ragged/tiering.py + serving/kv_tiers.py)
+        self.kv_tier_demotions = registry.counter(
+            "serving_kv_tier_demotions_total",
+            "KV blocks demoted device->host under pressure (trie + eviction path)")
+        self.kv_tier_disk_demotions = registry.counter(
+            "serving_kv_tier_disk_demotions_total",
+            "Offloaded sessions demoted host->disk (coldest first)")
+        self.kv_tier_promotions = registry.counter(
+            "serving_kv_tier_promotions_total",
+            "Demoted trie nodes promoted back to device on a prefix hit")
+        self.kv_tier_device_blocks = registry.gauge(
+            "serving_kv_tier_device_blocks", "KV blocks resident on device")
+        self.kv_tier_host_blocks = registry.gauge(
+            "serving_kv_tier_host_blocks", "KV blocks resident in the host tier")
+        self.kv_tier_disk_blocks = registry.gauge(
+            "serving_kv_tier_disk_blocks", "KV blocks resident in spill files on disk")
 
     @classmethod
     def maybe_create(cls) -> Optional["ServingMetrics"]:

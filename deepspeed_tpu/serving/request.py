@@ -144,6 +144,15 @@ class Request:
         self._resume_payload: Optional[bytes] = None
         self._resume_header: Optional[dict] = None
         self._resume_kv = None  # parsed KV view into _resume_payload
+        # tiered KV parking: a park-requested request exports a v2 park frame
+        # at finish (length OR eos — a new turn can continue either) for the
+        # router's park store; a rehydrate request carries a parked frame in
+        # PLUS the new turn's full prompt and enters PREFILL for the suffix
+        # only (the parked turns' KV imports, zero prefill for cached turns)
+        self.park_requested = False
+        self.park_payload: Optional[bytes] = None
+        self._rehydrate = False
+        self.kv_tier_source: Optional[str] = None  # tier the KV was served from
         self.tokens: List[int] = []
         # prompt tokens served from the prefix cache at admission (0 = cold);
         # surfaced in /v1/stats rows and the final response doc so clients and

@@ -118,7 +118,9 @@ class ServingScheduler:
                            "brownout_clamped", "spec_drafted", "spec_accepted",
                            "spec_steps", "spec_rollback",
                            "peer_fetch_hits", "peer_fetch_rejects",
-                           "peer_fetch_blocks", "steals")}
+                           "peer_fetch_blocks", "steals",
+                           "tier_demotions", "brownout_demotions",
+                           "parks", "rehydrates")}
         self._stopping = False   # no new submits
         self._shutdown = False   # thread exit
         self._stopped = False
@@ -188,6 +190,14 @@ class ServingScheduler:
                                                 max_ngram=scfg.max_ngram,
                                                 prefix_cache=self._prefix_cache)
 
+        # tiered KV memory (serving/kv_tiers.py over ragged/tiering.py):
+        # retrofits the engine's host→disk ladder with the operator's budget
+        # and drives demote-under-pressure — idle cached state moves down a
+        # tier before anything is evicted or shed
+        from deepspeed_tpu.serving import kv_tiers as _kv_tiers_mod
+        self._kv_tiers = _kv_tiers_mod.maybe_create(
+            engine, self._config.kv_tiers, metrics=self._metrics)
+
         engine._serving_scheduler = self
         # armed last: flight_state() must never observe a half-built
         # scheduler, and an __init__ that raises must not leak a provider or
@@ -236,7 +246,8 @@ class ServingScheduler:
                trace_id: Optional[str] = None,
                parent_span_id: Optional[int] = None,
                handoff: bool = False,
-               priority: Optional[str] = None) -> Request:
+               priority: Optional[str] = None,
+               park: bool = False) -> Request:
         """Enqueue a generation request (any thread). Returns the live
         :class:`Request`; stream tokens from ``request.stream`` or block on
         ``request.result()``. Backpressure per ``config.backpressure``:
@@ -251,7 +262,11 @@ class ServingScheduler:
         one parented Perfetto track. ``handoff`` marks a prefill-role request:
         when it finishes DONE its engine state is exported as a portable
         KV-handoff payload (``request.handoff_payload``) for
-        :meth:`submit_resume` on a decode-role peer."""
+        :meth:`submit_resume` on a decode-role peer. ``park`` marks a
+        continuable multi-turn session: at finish (length OR eos) the engine
+        state exports as a v2 *park frame* (``request.park_payload``) the
+        fleet park store holds until the session returns — rehydrated via
+        :meth:`submit_resume` with the next turn's full prompt."""
         req = Request(prompt,
                       max_new_tokens=max_new_tokens if max_new_tokens is not None
                       else self._config.default_max_new_tokens,
@@ -261,6 +276,7 @@ class ServingScheduler:
                       else self._config.default_deadline_s,
                       seed=seed,
                       priority=validate_priority(priority))
+        req.park_requested = bool(park)
         self._admission_gate(req)
         return self._enqueue(req, trace_id, parent_span_id, handoff)
 
@@ -274,7 +290,9 @@ class ServingScheduler:
                       trace_id: Optional[str] = None,
                       parent_span_id: Optional[int] = None,
                       handoff: bool = False,
-                      priority: Optional[str] = None) -> Request:
+                      priority: Optional[str] = None,
+                      prompt=None,
+                      park: bool = False) -> Request:
         """Admit a handed-off sequence for decode continuation: ``payload`` is
         an ``engine.export_sequence`` product from a prefill-role peer. The
         scheduler imports it into its engine at admission (on the scheduler
@@ -285,7 +303,17 @@ class ServingScheduler:
         payload's ``extra`` block, so greedy AND sampled continuations are
         token-identical to the single-engine run. ``request.tokens`` holds
         only the tokens generated HERE; the caller merges with the prefill
-        leg's."""
+        leg's.
+
+        ``prompt`` switches to the *rehydrate* formulation (a parked
+        multi-turn session returning with its next turn): it is the new
+        turn's FULL token history, of which the payload's parked tokens must
+        be a strict prefix. The parked KV imports as-is and the request
+        enters PREFILL for the un-parked suffix only — the cached turns
+        schedule zero prefill chunks. The new turn samples on its own
+        ``seed`` (the parked ``rng_state`` is NOT adopted), so the result is
+        bitwise-identical to an uninterrupted request over the same full
+        prompt at the same seed."""
         from deepspeed_tpu.inference.v2.ragged.handoff import unpack
         if not isinstance(payload, (bytes, bytearray)):
             # materialize views; a bytearray from the streaming body decoder
@@ -293,11 +321,21 @@ class ServingScheduler:
             payload = bytes(payload)
         header, kv = unpack(payload)  # validate framing before queueing
         extra = header.get("extra") or {}
-        if "next_token" not in extra:
+        if prompt is None and "next_token" not in extra:
             raise ValueError(
                 "handoff payload carries no next_token (the donor request must "
-                "finish with finish_reason='length' to be continuable)")
-        req = Request(header["tokens"],
+                "finish with finish_reason='length' to be continuable, or the "
+                "caller must rehydrate with the next turn's prompt)")
+        if prompt is not None:
+            new_prompt = np.asarray(prompt, np.int32).reshape(-1)
+            parked = [int(t) for t in header["tokens"]]
+            if (new_prompt.size <= len(parked)
+                    or [int(t) for t in new_prompt[:len(parked)]] != parked):
+                raise ValueError(
+                    "rehydrate prompt must strictly extend the parked token "
+                    "history (the parked turns are a proper prefix of the "
+                    "returning turn's prompt)")
+        req = Request(new_prompt if prompt is not None else header["tokens"],
                       max_new_tokens=max_new_tokens if max_new_tokens is not None
                       else self._config.default_max_new_tokens,
                       temperature=temperature,
@@ -308,9 +346,15 @@ class ServingScheduler:
                       priority=validate_priority(priority))
         req._resume_payload = payload
         req._resume_header = header
+        req._rehydrate = prompt is not None
+        req.park_requested = bool(park)
         self._admission_gate(req)  # after the header lands: resume work is
-        # its generation budget only, the donor already paid the prefill
+        # its generation budget (plus a rehydrate's un-parked suffix) only,
+        # the donor already paid the parked turns' prefill
         req._resume_kv = kv  # zero-copy view into payload; parsed exactly once
+        if req._rehydrate:
+            req.kv_tier_source = (extra.get("tier") or {}).get("source")
+            return self._enqueue(req, trace_id, parent_span_id, handoff)
         req._next = int(extra["next_token"])
         rng_state = extra.get("rng_state")
         if rng_state is not None:
@@ -369,10 +413,14 @@ class ServingScheduler:
     def _request_work(req: Request) -> int:
         """Engine-token work this request still needs: unfed prompt tokens
         plus its remaining generation budget (a resume request's prompt was
-        prefilled by the donor)."""
-        if req._resume_header is not None:
+        prefilled by the donor; a rehydrate owes only the un-parked suffix)."""
+        if req._resume_header is not None and not req._rehydrate:
             return max(0, req.max_new_tokens - len(req.tokens))
-        return (max(0, int(req.prompt.size) - req._fed)
+        fed = req._fed
+        if req._rehydrate and fed == 0:
+            # not yet imported: the parked turns count as already-fed
+            fed = int(req._resume_header["seen_tokens"])
+        return (max(0, int(req.prompt.size) - fed)
                 + max(0, req.max_new_tokens - len(req.tokens)))
 
     def _active_work_tokens(self) -> int:
@@ -499,8 +547,29 @@ class ServingScheduler:
             if self._metrics:
                 self._metrics.brownout_transitions.inc(delta)
                 self._metrics.brownout_stage.set(stage)
-        if stage >= 1 and self._config.overload.shed_enabled:
-            self._shed_queued(now)
+        if stage >= 1:
+            # demote-before-shed: with the tier ladder on, pressure first
+            # pushes idle cached KV down a tier (nothing is lost — it
+            # promotes back on the next hit). Shedding only runs on ticks
+            # where demotion freed nothing.
+            demoted = self._demote_for_pressure()
+            if demoted == 0 and self._config.overload.shed_enabled:
+                self._shed_queued(now)
+        if self._kv_tiers is not None:
+            self._kv_tiers.update_gauges(self._prefix_cache)
+
+    def _demote_for_pressure(self) -> int:
+        """Brownout's demote stage: one controller pass down the tier ladder
+        (trie nodes device→host, then coldest offloaded sessions host→disk).
+        Returns demotions performed; 0 when tiering is off or nothing is
+        demotable (shedding then proceeds as before)."""
+        if self._kv_tiers is None:
+            return 0
+        demoted = self._kv_tiers.demote_for_pressure(
+            self._prefix_cache, list(self._active.values()))
+        if demoted:
+            self._counters["brownout_demotions"] += demoted
+        return demoted
 
     def _shed_queued(self, now: float) -> None:
         """Under sustained pressure, shed queued requests whose deadline is
@@ -641,7 +710,12 @@ class ServingScheduler:
                     if outcome != "ok":
                         self._finalize(req, RequestState.FAILED, error=outcome)
                         continue
-                req._set_state(RequestState.DECODE if req._resume_header is not None
+                # a rehydrate enters PREFILL: its parked KV imported, the
+                # un-parked suffix still needs feeding (a handoff enters
+                # DECODE — its donor fed everything)
+                req._set_state(RequestState.DECODE
+                               if (req._resume_header is not None
+                                   and not req._rehydrate)
                                else RequestState.PREFILL)
                 with self._not_full:
                     self._active[req.uid] = req
@@ -689,7 +763,17 @@ class ServingScheduler:
                 return None
             req._resume_payload = None  # imported; the engine owns the KV now
             req._resume_kv = None
-            req._fed = req.prompt.size  # the whole history is already prefilled
+            if req._rehydrate:
+                # the parked turns are prefilled; the new turn's suffix is
+                # not — feed resumes exactly at the import's seen_tokens (the
+                # boundary token re-feeds, same KV slot, like a full prefix
+                # hit) so the cached turns schedule zero prefill chunks
+                seen = int(snapshot["seen_tokens"])
+                req._fed = seen
+                req.cached_tokens = seen
+                self._counters["rehydrates"] += 1
+            else:
+                req._fed = req.prompt.size  # whole history already prefilled
             return "ok"
 
     # -------------------------------------------------- fleet data motion --
@@ -1130,6 +1214,10 @@ class ServingScheduler:
                 return (f"handed-off sequence has "
                         f"{req._resume_header['seen_tokens']} committed tokens; "
                         f"max_context={sm.max_context} leaves no room to decode")
+            if req._rehydrate and req.prompt.size + 1 > sm.max_context:
+                return (f"rehydrate prompt of {req.prompt.size} tokens exceeds "
+                        f"max_context={sm.max_context} (room for at least one "
+                        f"generated token is required)")
             return None
         if req.prompt.size + 1 > sm.max_context:
             return (f"prompt of {req.prompt.size} tokens exceeds max_context="
@@ -1245,7 +1333,18 @@ class ServingScheduler:
         trie leaf (LRU) first — reclaiming cached-but-idle state costs nothing
         live — then fall back to offloading the coldest idle engine-resident
         sequence (not in the batch being built), which restores transparently
-        when next touched. Returns False when nothing is evictable."""
+        when next touched. Returns False when nothing is evictable.
+
+        With the tier ladder on, *demotion* runs ahead of the eviction
+        ladder: a demoted trie node keeps its KV (host tier, promotes back on
+        the next hit) where an evicted leaf recomputes from scratch."""
+        if self._kv_tiers is not None and self._prefix_cache is not None:
+            freed = self._prefix_cache.demote(1)
+            if freed:
+                self._counters["tier_demotions"] += freed
+                if self._metrics:
+                    self._metrics.kv_tier_demotions.inc(freed)
+                return True
         if self._prefix_cache is not None:
             freed = self._prefix_cache.evict(1)
             if freed:
@@ -1554,6 +1653,30 @@ class ServingScheduler:
         return self._engine.export_sequence(req.uid, tokens=tokens, extra=extra,
                                             seen_tokens=len(tokens) - 1)
 
+    def _export_park(self, req: Request) -> bytes:
+        """Version-2 park frame for a finished park-requested request: the
+        handoff export plus a versioned ``tier`` record (which tier the KV
+        was resident on at finish — what the rehydrate response reports).
+        Unlike a handoff, an eos finish IS parkable: the next turn continues
+        from the full history via a rehydrate prompt, not from ``next_token``.
+        The parked ``rng_state`` is informational — a rehydrate samples on
+        its own seed so the returning turn matches a cold run bitwise."""
+        from deepspeed_tpu.inference.v2.ragged.handoff import (PARK_VERSION,
+                                                               TIER_FIELD_VERSION)
+        sm = self._engine._state_manager
+        source = sm.sequence_tier(req.uid)  # capture BEFORE export restores
+        extra = {"generated": len(req.tokens),
+                 "decode_steps": req.decode_steps,
+                 "tier": {"v": TIER_FIELD_VERSION, "source": source}}
+        if req.finish_reason == "length" and req.tokens:
+            extra["next_token"] = int(req.tokens[-1])
+        if req._rng is not None:
+            extra["rng_state"] = req._rng.bit_generator.state
+        tokens = [int(t) for t in req.prompt.tolist()] + [int(t) for t in req.tokens]
+        return self._engine.export_sequence(req.uid, tokens=tokens, extra=extra,
+                                            seen_tokens=len(tokens) - 1,
+                                            version=PARK_VERSION)
+
     def _finalize(self, req: Request, state: RequestState, error: Optional[str] = None) -> None:
         """Terminal transition on the scheduler thread: free engine state
         (tracked OR offloaded KV), close the stream, account."""
@@ -1575,6 +1698,19 @@ class ServingScheduler:
                     except Exception:  # pragma: no cover - defensive: a failed
                         # export degrades to a non-continuable response
                         logger.exception(f"serving: handoff export failed for "
+                                         f"uid {req.uid}")
+                if (state is RequestState.DONE and req.park_requested
+                        and req.finish_reason in ("length", "eos")
+                        and req.tokens):
+                    # park BEFORE flushing, same reason as the handoff export;
+                    # eos finishes park too (a multi-turn session's next turn
+                    # rehydrates with a longer prompt, no next_token needed)
+                    try:
+                        req.park_payload = self._export_park(req)
+                        self._counters["parks"] += 1
+                    except Exception:  # pragma: no cover - defensive: a failed
+                        # park degrades to a cold next turn
+                        logger.exception(f"serving: park export failed for "
                                          f"uid {req.uid}")
                 if (self._prefix_cache is not None and state is RequestState.DONE
                         and not self._engine.is_offloaded(req.uid)):
@@ -1846,6 +1982,8 @@ class ServingScheduler:
             },
             "prefix_cache": prefix_stats,
             "speculative": self._spec_stats(),
+            "kv_tiers": (self._kv_tiers.stats(self._prefix_cache)
+                         if self._kv_tiers is not None else None),
             "timeseries": (ts.snapshot(max_points=64)
                            if (ts := telemetry.get_timeseries()) is not None
                            else None),

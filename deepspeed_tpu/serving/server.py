@@ -28,9 +28,13 @@ ephemeral-port support), serving the request lifecycle instead of metrics:
 - ``POST /v1/resume`` — fleet decode-role continuation: the body carries a
   base64 ``payload`` (a peer engine's ``export_sequence`` product) instead of
   a prompt; the sequence enters DECODE directly and streams/returns exactly
-  like ``/v1/generate``. Both POST routes accept a ``handoff`` flag (export
-  this request's state at DONE; the base64 payload is returned in the final
-  JSON / SSE ``done`` event) and adopt an upstream trace from the
+  like ``/v1/generate``. A resume body carrying BOTH a payload and a
+  ``prompt`` is the *rehydrate* form: the payload is a parked v2 frame whose
+  token history the prompt strictly extends — the parked turns' KV imports
+  and only the new suffix prefills. Both POST routes accept ``handoff`` and
+  ``park`` flags (export this request's state at DONE; the base64 payload is
+  returned in the final JSON / SSE ``done`` event as ``handoff`` / ``park``)
+  and adopt an upstream trace from the
   ``X-DSTPU-Trace-Id`` / ``X-DSTPU-Parent-Span`` request headers, so the
   fleet router's hop parents the replica's request track.
 - ``GET /v1/stats`` — scheduler + engine occupancy JSON: per-request rows
@@ -258,6 +262,17 @@ def _request_doc(req: Request, raw_handoff: bool = False,
         else:
             doc["handoff"] = (req.handoff_payload if raw_handoff else
                               base64.b64encode(req.handoff_payload).decode())
+    if req.park_payload is not None:
+        # tiered KV parking: the v2 park frame, for the router's park store
+        # (an in-process fleet leg keeps the bytes raw). A direct client can
+        # hold it and rehydrate the next turn via /v1/resume with a prompt.
+        doc["park"] = (req.park_payload if raw_handoff else
+                       base64.b64encode(req.park_payload).decode())
+    if req._rehydrate:
+        # the returning-turn receipt: the cached turns' KV was imported (zero
+        # prefill for them) from this tier
+        doc["rehydrated"] = True
+        doc["park_tier"] = req.kv_tier_source
     return doc
 
 
@@ -494,9 +509,14 @@ class ServingServer:
                                   trace_id=trace_id,
                                   parent_span_id=parent_span_id,
                                   handoff=bool(doc.get("handoff")),
+                                  park=bool(doc.get("park")),
                                   priority=request_priority(self, doc))
                     if path == "/v1/resume":
-                        req = scheduler.submit_resume(doc["payload"], **common)
+                        # a resume body MAY carry a prompt: the rehydrate form
+                        # (parked session returning with its next turn)
+                        req = scheduler.submit_resume(doc["payload"],
+                                                      prompt=doc.get("prompt"),
+                                                      **common)
                     else:
                         req = scheduler.submit(doc["prompt"], **common)
                 except AdmissionRejected as e:

@@ -82,6 +82,14 @@ METRIC_FAMILIES = {
     "serving_spec_rollback_tokens_total": "rejected draft positions truncated from committed KV",
     "serving_spec_accept_rate": "EWMA of the speculative acceptance rate across verify steps",
     "serving_spec_tokens_per_step": "tokens emitted per speculative verify step (1 = nothing accepted)",
+    # tiered KV memory (serving/metrics.py over inference/v2/ragged/tiering.py
+    # and serving/kv_tiers.py)
+    "serving_kv_tier_demotions_total": "KV payloads demoted down the tier ladder (device pressure and host-to-disk writeback)",
+    "serving_kv_tier_disk_demotions_total": "host-tier payloads committed to disk spill files by the async writer",
+    "serving_kv_tier_promotions_total": "demoted payloads promoted back up the ladder on access",
+    "serving_kv_tier_device_blocks": "KV blocks resident on device",
+    "serving_kv_tier_host_blocks": "KV blocks resident in the host tier",
+    "serving_kv_tier_disk_blocks": "KV blocks resident in disk spill files",
     # overload control (serving/metrics.py over serving/overload.py)
     "serving_shed_admission_total": "requests rejected at admission: deadline provably unmeetable",
     "serving_shed_queue_total": "queued requests shed under sustained overload pressure",
@@ -150,6 +158,14 @@ METRIC_FAMILIES = {
     "fleet_kv_transport_base64_bytes_total": "KV payload bytes moved as base64 text (compatibility transport, encoded size)",
     "fleet_steals_total": "requests moved off a hot replica by work stealing (re-granted or exported mid-decode)",
     "fleet_steal_attempts_total": "steal probes sent to victim replicas (includes races the victim won)",
+    # fleet-parked sessions (fleet/park_store.py)
+    "fleet_park_sessions": "sessions currently parked in the router's park store",
+    "fleet_park_bytes": "bytes of parked KV frames held by the router's park store",
+    "fleet_parks_total": "finished-session KV frames banked in the router's park store",
+    "fleet_park_rehydrates_total": "returning turns dispatched as rehydrate legs (parked KV imported, only the new suffix prefilled)",
+    "fleet_park_rehydrate_misses_total": "known parked sessions that could not rehydrate (expired or diverged prompt)",
+    "fleet_park_corrupt_rejects_total": "park frames dropped after a loud CRC/framing reject (the turn ran cold)",
+    "fleet_park_evictions_total": "parked sessions dropped by the LRU byte/count budget or TTL",
     # fleet observability plane (telemetry/spans.py, telemetry/collector.py,
     # telemetry/slo.py, fleet/metrics.py)
     "spans_dropped_total": "spans dropped from the ring buffer past max_spans",

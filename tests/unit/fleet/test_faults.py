@@ -45,10 +45,12 @@ def _snapshot(name):
 # ---------------------------------------------------------------------------
 def test_schedule_is_deterministic_and_matches_live_fires():
     cfg = FaultConfig(enabled=True, seed=42, connect_reset_p=0.25,
-                      http_5xx_p=0.2, http_5xx_burst=3)
+                      http_5xx_p=0.2, http_5xx_burst=3,
+                      park_store_corrupt_p=0.3, demote_race_p=0.3)
     a, b = FaultInjector(cfg), FaultInjector(cfg)
     for point, scope in (("connect_reset", "r0"), ("http_5xx", "r1"),
-                         ("http_5xx", None)):
+                         ("http_5xx", None), ("park_store_corrupt", "sess-0"),
+                         ("demote_race", "r0")):
         live = [n for n in (a.fire(point, scope) for _ in range(200))
                 if n is not None]
         assert live == a.schedule(point, 200, scope)      # live == pure oracle

@@ -1,0 +1,433 @@
+"""Fleet-parked sessions (ISSUE 18): the router-side rung of the tiered KV
+ladder. A finished-but-continuable session banks its park frame in the
+router's ParkStore; when the next turn arrives, the router dispatches a
+rehydrate leg on whichever replica wins placement — including one that never
+saw the session — and the continuation is bitwise-identical to a cold run at
+the same seed. Chaos arms: park_store_corrupt (loud reject + cold fallback)
+and demote_race (read injected into the tier writer's spill window)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.fleet import (FaultConfig, FleetConfig, FleetRouter,
+                                 ParkConfig, ParkStore)
+from deepspeed_tpu.inference.v2.ragged import handoff
+from deepspeed_tpu.serving import ServingConfig, ServingScheduler
+
+
+def _prompt(n=9, vocab=64, base=0):
+    return [(base + i) % vocab for i in range(n)]
+
+
+def _fleet_config(**kw):
+    kw.setdefault("probe_ttl_s", 0.0)
+    kw.setdefault("drain_timeout_s", 10.0)
+    kw.setdefault("park", ParkConfig(enabled=True))
+    return FleetConfig(**kw)
+
+
+@pytest.fixture
+def park_frame(make_engine):
+    """One real v2 park frame plus its full token history — the ParkStore
+    unit tests validate against the same frames the fleet banks."""
+    sched = ServingScheduler(make_engine(), ServingConfig(), start=False)
+    p1 = _prompt(9)
+    req = sched.submit(p1, max_new_tokens=4, park=True)
+    for _ in range(400):
+        if req.finished:
+            break
+        sched.step()
+    assert req.park_payload is not None
+    tokens = p1 + [int(t) for t in req.tokens]
+    sched.stop(drain=False)
+    return req.park_payload, tokens
+
+
+# ---------------------------------------------------------------------------
+# ParkStore unit surface
+# ---------------------------------------------------------------------------
+def test_store_put_match_and_lru_touch(park_frame):
+    payload, tokens = park_frame
+    store = ParkStore(ParkConfig(enabled=True))
+    assert store.put("sess-a", payload, replica_id="r0")
+    assert len(store) == 1
+    # a key the store never saw counts nothing — a first turn is not a miss
+    assert store.match("sess-unknown", tokens + [1]) is None
+    entry = store.match("sess-a", tokens + _prompt(3, base=40))
+    assert entry is not None
+    assert entry.payload == bytes(payload)
+    assert entry.tokens == tokens
+    assert entry.seen_tokens == len(tokens) - 1
+    assert entry.tier_source == "device"
+    assert entry.replica_id == "r0"
+    s = store.stats()
+    assert s["parks"] == 1 and s["rehydrate_hits"] == 1
+    assert s["rehydrate_misses"] == 0 and s["corrupt_rejects"] == 0
+    assert s["bytes"] == len(payload)
+    assert s["inventory"][0]["session"] == "sess-a"
+
+
+def test_store_rejects_garbage_and_v1_frames(park_frame):
+    payload, _ = park_frame
+    store = ParkStore(ParkConfig(enabled=True))
+    assert not store.put("sess-junk", b"not a frame at all")
+    # a v1 (live-handoff) frame must be refused: parking it would lose the
+    # versioned tier record the rehydrate response reports
+    v1 = payload.replace(b'"version": 2', b'"version": 1').replace(
+        b'"version":2', b'"version":1')
+    assert v1 != payload, "frame header serialization changed — fix the probe"
+    assert not store.put("sess-v1", v1)
+    assert len(store) == 0
+    assert store.stats()["corrupt_rejects"] == 2
+
+
+def test_store_session_and_byte_budgets_evict_lru(park_frame):
+    payload, _ = park_frame
+    store = ParkStore(ParkConfig(enabled=True, max_sessions=2))
+    for key in ("a", "b", "c"):
+        assert store.put(key, payload)
+    assert len(store) == 2
+    s = store.stats()
+    assert s["evictions"] == 1
+    assert [row["session"] for row in s["inventory"]] == ["b", "c"]
+
+    tight = ParkStore(ParkConfig(enabled=True, max_bytes=len(payload)))
+    assert tight.put("a", payload)
+    assert tight.put("b", payload)  # over the byte budget: a evicts
+    assert len(tight) == 1
+    assert tight.stats()["inventory"][0]["session"] == "b"
+
+
+def test_store_ttl_expires_parked_sessions(park_frame):
+    payload, tokens = park_frame
+    store = ParkStore(ParkConfig(enabled=True, ttl_s=0.01))
+    assert store.put("sess-old", payload)
+    time.sleep(0.05)
+    assert store.match("sess-old", tokens + [1, 2]) is None
+    s = store.stats()
+    assert s["sessions"] == 0
+    assert s["evictions"] == 1 and s["rehydrate_misses"] == 1
+
+
+def test_store_diverged_prompt_drops_entry_once(park_frame):
+    payload, tokens = park_frame
+    store = ParkStore(ParkConfig(enabled=True))
+    assert store.put("sess-d", payload)
+    # same length, shorter, and a diverged prefix are all unusable — and the
+    # entry drops on the first miss (histories never re-converge)
+    assert store.match("sess-d", tokens) is None
+    assert len(store) == 0
+    assert store.stats()["rehydrate_misses"] == 1
+    # the key is now unknown: further probes count nothing
+    assert store.match("sess-d", tokens + [1]) is None
+    assert store.stats()["rehydrate_misses"] == 1
+
+
+def test_store_reject_drops_and_counts(park_frame):
+    payload, _ = park_frame
+    store = ParkStore(ParkConfig(enabled=True))
+    assert store.put("sess-r", payload)
+    store.reject("sess-r")
+    assert len(store) == 0
+    assert store.stats()["corrupt_rejects"] == 1
+
+
+def test_store_newer_turn_replaces_parked_frame(park_frame):
+    payload, tokens = park_frame
+    store = ParkStore(ParkConfig(enabled=True))
+    assert store.put("sess", payload)
+    assert store.put("sess", payload)  # the next turn's frame subsumes it
+    assert len(store) == 1
+    s = store.stats()
+    assert s["parks"] == 2 and s["evictions"] == 0
+    assert s["bytes"] == len(payload)
+
+
+# ---------------------------------------------------------------------------
+# router integration: park at finish, rehydrate on ANY replica
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("temperature", [0.0, 0.8], ids=["greedy", "sampled"])
+def test_fleet_park_rehydrates_on_surviving_replica_bitwise(make_fleet,
+                                                            temperature):
+    """The fleet half of the flagship gate: turn 1 parks in the router store,
+    the parking replica LEAVES the fleet, and turn 2 rehydrates on the
+    survivor — served as a rehydrate leg with the parked turns cached, and
+    bitwise-identical to a cold full-prompt run at the same seed."""
+    manager = make_fleet(roles=("mixed", "mixed"), config=_fleet_config())
+    router = FleetRouter(manager)
+    p1 = _prompt(11)
+    r1 = router.route({"prompt": p1, "max_new_tokens": 5, "seed": 3,
+                       "temperature": temperature}, session_key="chat-7")
+    f1 = r1.result()
+    assert f1["state"] == "DONE"
+    assert f1.get("parked") is True
+    assert "park" not in f1  # the frame stays router-side
+    parker = r1._legs_meta[0]["replica"]
+    parked = p1 + [int(t) for t in f1["tokens"]]
+    park = router.fleet_stats()["router"]["park"]
+    assert park["sessions"] == 1 and park["parks"] == 1
+    assert park["inventory"][0]["session"] == "chat-7"
+    assert park["inventory"][0]["parked_by"] == parker
+    assert park["inventory"][0]["tokens"] == len(parked)
+
+    # the parker drains away: the session must rehydrate on a replica that
+    # never saw it (the frame is self-describing — any geometry match works)
+    manager.drain(parker)
+    p2 = parked + _prompt(4, base=40)
+    r2 = router.route({"prompt": p2, "max_new_tokens": 5, "seed": 9,
+                       "temperature": temperature}, session_key="chat-7")
+    f2 = r2.result()
+    assert f2["state"] == "DONE"
+    assert f2.get("rehydrated") is True
+    assert f2["park_tier"] == "device"
+    assert r2._legs_meta[0]["kind"] == "rehydrate"
+    assert r2._legs_meta[0]["replica"] != parker
+    # the parked turns came from the frame, not a re-prefill
+    assert f2["cached_tokens"] == len(parked) - 1
+    assert f2.get("parked") is True  # the returning turn re-parks
+
+    # bitwise control: the uninterrupted cold run at the same seed
+    fc = router.route({"prompt": p2, "max_new_tokens": 5, "seed": 9,
+                       "temperature": temperature}).result()
+    assert [int(t) for t in f2["tokens"]] == [int(t) for t in fc["tokens"]]
+    park = router.fleet_stats()["router"]["park"]
+    assert park["rehydrate_hits"] == 1 and park["corrupt_rejects"] == 0
+
+
+def test_client_park_flag_returns_frame_without_store(make_fleet):
+    """A client asking ``park: true`` manages its own copy: the final doc
+    carries the raw v2 frame even with the router store disabled."""
+    manager = make_fleet(roles=("mixed",))
+    router = FleetRouter(manager)
+    assert router._park_store is None  # off by default
+    p1 = _prompt(10)
+    f1 = router.route({"prompt": p1, "max_new_tokens": 4,
+                       "park": True}).result()
+    assert f1["state"] == "DONE"
+    assert "parked" not in f1  # nothing banked router-side
+    header, _ = handoff.unpack(f1["park"])
+    assert header["version"] == handoff.PARK_VERSION
+    assert header["tokens"] == p1 + [int(t) for t in f1["tokens"]]
+
+
+def test_park_without_session_key_banks_nothing(make_fleet):
+    manager = make_fleet(roles=("mixed",), config=_fleet_config())
+    router = FleetRouter(manager)
+    f = router.route({"prompt": _prompt(10), "max_new_tokens": 3}).result()
+    assert f["state"] == "DONE"
+    assert "parked" not in f and "park" not in f
+    assert router.fleet_stats()["router"]["park"]["sessions"] == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos arms
+# ---------------------------------------------------------------------------
+def test_park_store_corrupt_falls_back_cold_and_stays_correct(make_fleet):
+    """The ``park_store_corrupt`` point corrupts the frame sent to the
+    rehydrating replica: the replica rejects loudly (CRC/framing), the store
+    drops the entry, and the turn runs cold — same tokens, one bounced
+    dispatch, never a continuation from half-corrupt KV."""
+    manager = make_fleet(
+        roles=("mixed",),
+        config=_fleet_config(faults=FaultConfig(enabled=True, seed=7,
+                                                park_store_corrupt_p=1.0)))
+    router = FleetRouter(manager)
+    p1 = _prompt(11)
+    f1 = router.route({"prompt": p1, "max_new_tokens": 4, "seed": 3},
+                      session_key="sess-x").result()
+    assert f1.get("parked") is True
+    parked = p1 + [int(t) for t in f1["tokens"]]
+
+    p2 = parked + _prompt(3, base=40)
+    r2 = router.route({"prompt": p2, "max_new_tokens": 4, "seed": 5},
+                      session_key="sess-x")
+    f2 = r2.result()
+    assert f2["state"] == "DONE"
+    assert "rehydrated" not in f2  # the corrupt frame never served
+    assert r2._legs_meta[0]["kind"] == "serve"
+    park = router.fleet_stats()["router"]["park"]
+    assert park["rehydrate_hits"] == 1  # the match happened...
+    assert park["corrupt_rejects"] >= 1  # ...the frame bounced, loudly
+    assert f2.get("parked") is True  # the cold run re-parked the session
+
+    # correctness is untouched: the cold fallback matches a sessionless run
+    fc = router.route({"prompt": p2, "max_new_tokens": 4,
+                       "seed": 5}).result()
+    assert [int(t) for t in f2["tokens"]] == [int(t) for t in fc["tokens"]]
+    assert any(k.startswith("park_store_corrupt")
+               for k in router._faults.report()["fired"])
+
+
+def test_demote_race_point_reclaims_to_host(make_fleet, tmp_path):
+    """The ``demote_race`` point injects a read into the tier writer's
+    spill-to-commit window on a live replica's store: the entry must reclaim
+    to host, the orphan spill file must unlink, and the race is counted."""
+    manager = make_fleet(
+        roles=("mixed",),
+        config=_fleet_config(faults=FaultConfig(enabled=True, seed=1,
+                                                demote_race_p=1.0)))
+    router = FleetRouter(manager)  # arming the router arms manager.faults
+    replica = manager.replicas()[0]
+    kv_cache = replica.engine._state_manager.kv_cache
+    kv_cache.configure_tiering(spill_dir=str(tmp_path))
+    store = kv_cache.tiered_store
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=(2, 2, 2, 2, 16, 8)).astype(np.float32)
+    h = store.put(data)
+    store.demote(h, wait=True)
+    assert store.tier_of(h) == "host"  # the injected reader won
+    assert store.stats()["demote_races"] == 1
+    assert not list(tmp_path.glob("kv_offload_*.bin"))
+    got, tier = store.read(h)
+    assert tier == "host"
+    np.testing.assert_array_equal(got, data)
+    assert any(k.startswith("demote_race")
+               for k in router._faults.report()["fired"])
+    # disarmed, the hook is a no-op: demotion commits normally
+    router.set_faults(None)
+    assert store.demote(h, wait=True)
+    assert store.tier_of(h) == "disk"
+
+
+# ---------------------------------------------------------------------------
+# CLI satellites: dstpu_loadgen --multi-turn and dstpu_report --kv
+# ---------------------------------------------------------------------------
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def _loadgen_module():
+    """Load bin/dstpu_loadgen as a module (top-level imports are stdlib-only;
+    main() is __main__-guarded) so its multi-turn helpers are unit-testable."""
+    import importlib.util
+    from importlib.machinery import SourceFileLoader
+    loader = SourceFileLoader("_dstpu_loadgen_park_test",
+                              os.path.join(_REPO, "bin", "dstpu_loadgen"))
+    spec = importlib.util.spec_from_loader(loader.name, loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod
+
+
+def test_loadgen_multi_turn_parse_and_report_math(capsys):
+    """``--multi-turn TURNS[:SESSIONS]`` parsing and the park-effectiveness
+    report: hit rate over RETURNING turns only, recompute-tokens-saved, and
+    TTFT split by the tier the parked KV was resident on."""
+    lg = _loadgen_module()
+    for bad in (["--multi-turn", "0"], ["--multi-turn", "2:0"],
+                ["--multi-turn", "2:3:4"], ["--multi-turn", "x"],
+                ["--multi-turn", "2", "--think-time", "-1"]):
+        with pytest.raises(SystemExit):
+            lg.main(["--url", "http://x"] + bad)
+    capsys.readouterr()  # drop the argparse usage noise
+
+    mk = lg.Result
+    ok = [
+        mk(True, 200, ttft_s=0.05, prompt_tokens=10, turn=0, parked=True),
+        mk(True, 200, ttft_s=0.01, prompt_tokens=20, cached_tokens=15,
+           turn=1, rehydrated=True, park_tier="device", parked=True),
+        mk(True, 200, ttft_s=0.02, prompt_tokens=30, cached_tokens=25,
+           turn=1, rehydrated=True, park_tier="disk", parked=True),
+        mk(True, 200, ttft_s=0.08, prompt_tokens=40, turn=2),  # cold miss
+    ]
+    lg._multi_turn_report(ok)
+    out = capsys.readouterr().out
+    assert "rehydrated=2/3 returning turns" in out
+    assert "hit_rate=0.67" in out
+    assert "recompute_tokens_saved=40/90" in out
+    assert "parked_finishes=3" in out
+    assert "ttft (device)" in out
+    assert "ttft (  disk)" in out
+    assert "ttft (  cold)" in out
+
+    lg._multi_turn_report([mk(True, 200, turn=0, parked=True)])
+    assert "no returning turns (parked_finishes=1)" in capsys.readouterr().out
+
+
+def test_loadgen_multi_turn_end_to_end(make_fleet):
+    """The CLI satellite end-to-end: concurrent sessions over HTTP against a
+    park-enabled router; every returning turn must rehydrate from the store
+    and the report must show the hit rate and the device-tier TTFT split."""
+    manager = make_fleet(roles=("mixed", "mixed"), config=_fleet_config())
+    router = FleetRouter(manager).start()
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "bin", "dstpu_loadgen"),
+             "--target", router.url, "--multi-turn", "3:2",
+             "--prompt-len", "8", "--max-new-tokens", "3",
+             "--vocab-size", "64", "--seed", "0"],
+            capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr[-800:]
+        assert "requests=6 ok=6 err=0" in r.stdout
+        assert "rehydrated=4/4 returning turns (hit_rate=1.00)" in r.stdout
+        assert "parked_finishes=6" in r.stdout
+        assert "ttft (device)" in r.stdout
+        # the store-side view agrees with the client-side report
+        park = router.fleet_stats()["router"]["park"]
+        assert park["parks"] == 6 and park["rehydrate_hits"] == 4
+        assert park["corrupt_rejects"] == 0
+    finally:
+        router.stop(drain=False)
+
+
+def test_report_kv_renders_tiers_and_park(tmp_path, capsys):
+    """``dstpu_report --kv`` over saved stats docs: the serving form renders
+    the tier-occupancy ladder, the fleet form renders the parked-session
+    inventory, disabled blocks say so, and garbage is a loud rc 2."""
+    from deepspeed_tpu.env_report import kv_report, main
+
+    serving = tmp_path / "stats.json"
+    serving.write_text(json.dumps({"kv_tiers": {
+        "enabled": True, "device_blocks_used": 5, "device_blocks_total": 64,
+        "host_entries": 2, "host_blocks": 6, "host_bytes": 4096,
+        "host_bytes_budget": 1 << 20, "disk_entries": 1, "disk_blocks": 3,
+        "disk_bytes": 2048, "pressure_demotions": 4, "demotions": 3,
+        "demote_races": 1, "writeback_pending": 0, "writeback_joins": 2,
+        "reads_host": 7, "reads_disk": 1, "trie_offloaded_nodes": 2,
+        "trie_demotions": 2, "trie_promotions": 1}}))
+    assert kv_report(str(serving)) == 0
+    out = capsys.readouterr().out
+    assert "device ............... 5/64 blocks" in out
+    assert "host ................. 2 entries, 6 blocks, 4096 bytes" in out
+    assert "pressure demotions ... 4" in out
+    assert "demote races ......... 1" in out
+    assert "prefix trie .......... 2 offloaded nodes" in out
+
+    fleet = tmp_path / "fleet.json"
+    fleet.write_text(json.dumps({"router": {"park": {
+        "sessions": 1, "bytes": 9000, "max_sessions": 256,
+        "max_bytes": 1 << 30, "ttl_s": 600.0, "parks": 3,
+        "rehydrate_hits": 2, "rehydrate_misses": 1, "corrupt_rejects": 0,
+        "evictions": 0, "inventory": [
+            {"session": "chat-7", "tokens": 21, "bytes": 9000,
+             "tier_source": "device", "parked_by": "replica-0",
+             "age_s": 4.2}]}}}))
+    assert kv_report(str(fleet)) == 0
+    out = capsys.readouterr().out
+    assert "park store ............. 1 sessions, 9000 bytes" in out
+    assert "rehydrate hits ....... 2" in out
+    assert "chat-7" in out and "replica-0" in out
+
+    # disabled blocks render as such (rc 0 — the doc IS a stats doc)
+    serving.write_text(json.dumps({"kv_tiers": None}))
+    assert kv_report(str(serving)) == 0
+    assert "KVTierConfig.enabled=false" in capsys.readouterr().out
+    fleet.write_text(json.dumps({"router": {"requests": 3}}))
+    assert kv_report(str(fleet)) == 0
+    assert "ParkConfig.enabled=false" in capsys.readouterr().out
+
+    # garbage: loud rc 2, not a traceback — and main() dispatches the flag
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"foo": 1}))
+    assert kv_report(str(bad)) == 2
+    assert kv_report(str(tmp_path / "missing.json")) == 2
+    capsys.readouterr()
+    assert main(["--kv", str(bad)]) == 2
+    assert main(["--kv"]) == 2
+    assert "usage: dstpu_report --kv" in capsys.readouterr().out

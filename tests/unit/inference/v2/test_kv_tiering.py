@@ -1,0 +1,150 @@
+"""TieredKVStore (inference/v2/ragged/tiering.py): the host→disk half of the
+tiered KV ladder — budgeted LRU demotion on the async writer, non-destructive
+reads from either tier, the read-vs-demote race reclaiming to host, and the
+stats/counter surface the serving controller renders."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.ragged.tiering import TIERS, TieredKVStore
+
+
+def _payload(n=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(2, 2, n, 2, 16, 8)).astype(np.float32)
+
+
+def test_tier_names_are_the_public_ladder():
+    assert TIERS == ("device", "host", "disk")
+
+
+def test_put_read_drop_host_tier():
+    store = TieredKVStore()
+    data = _payload()
+    h = store.put(data)
+    assert h in store and store.tier_of(h) == "host"
+    assert store.n_blocks(h) == 2
+    got, tier = store.read(h)
+    assert tier == "host"
+    np.testing.assert_array_equal(got, data)
+    # read is non-destructive: a second read sees the same payload
+    got2, _ = store.read(h)
+    np.testing.assert_array_equal(got2, data)
+    store.drop(h)
+    assert h not in store
+    with pytest.raises(KeyError):
+        store.read(h)
+    store.close()
+
+
+def test_explicit_demote_spills_and_reads_back(tmp_path):
+    store = TieredKVStore(spill_dir=str(tmp_path))
+    data = _payload(seed=1)
+    h = store.put(data)
+    assert store.demote(h, wait=True)
+    assert store.tier_of(h) == "disk"
+    assert list(tmp_path.glob("kv_offload_*.bin"))
+    got, tier = store.read(h)
+    assert tier == "disk"
+    np.testing.assert_array_equal(got, data)
+    s = store.stats()
+    assert s["demotions"] == 1 and s["reads_disk"] == 1
+    store.drop(h)
+    assert not list(tmp_path.glob("kv_offload_*.bin"))
+    store.close()
+
+
+def test_demote_without_spill_dir_is_refused():
+    store = TieredKVStore()
+    h = store.put(_payload())
+    assert not store.demote(h, wait=True)
+    assert store.tier_of(h) == "host"
+    store.close()
+
+
+def test_host_budget_demotes_lru_first(tmp_path):
+    one = _payload(n=1).nbytes
+    store = TieredKVStore(spill_dir=str(tmp_path), host_bytes=2 * one)
+    a = store.put(_payload(n=1, seed=1))
+    b = store.put(_payload(n=1, seed=2))
+    store.read(b)  # touch: a is now the LRU entry
+    c = store.put(_payload(n=1, seed=3))  # over budget: the coldest demotes
+    for _ in range(500):  # async writer: poll the commit
+        if store.tier_of(a) == "disk":
+            break
+        time.sleep(0.01)
+    assert store.tier_of(a) == "disk"
+    assert store.tier_of(b) == "host" and store.tier_of(c) == "host"
+    store.close()
+
+
+def test_pinned_entries_never_demote(tmp_path):
+    one = _payload(n=1).nbytes
+    store = TieredKVStore(spill_dir=str(tmp_path), host_bytes=one)
+    a = store.put(_payload(n=1, seed=1), pin_host=True)
+    store.put(_payload(n=1, seed=2))
+    assert not store.demote(a, wait=True)
+    assert store.tier_of(a) == "host"
+    store.pin(a, False)
+    assert store.demote(a, wait=True)
+    assert store.tier_of(a) == "disk"
+    store.close()
+
+
+def test_read_races_demote_and_reclaims_to_host(tmp_path):
+    """The demote_race: a read arriving while the writer is mid-spill wins —
+    the entry reclaims to host, the writer's commit re-check discards its
+    orphan file, and the race is counted (what the ``demote_race`` fleet
+    fault point makes deterministic)."""
+    store = TieredKVStore(spill_dir=str(tmp_path))
+    data = _payload(seed=4)
+    h = store.put(data)
+    raced = threading.Event()
+
+    def hook(handle):
+        # between the spill write and the commit: read NOW
+        got, tier = store.read(handle)
+        assert tier == "host"  # reclaimed, not served from the half-spill
+        np.testing.assert_array_equal(got, data)
+        raced.set()
+
+    store.race_hook = hook
+    store.demote(h, wait=True)
+    assert raced.wait(5)
+    assert store.tier_of(h) == "host"  # the reader won
+    assert store.stats()["demote_races"] == 1
+    # the writer unlinked its orphan: no spill file leaks for a host entry
+    assert not list(tmp_path.glob("kv_offload_*.bin"))
+    store.close()
+
+
+def test_configure_retrofits_policy():
+    """``configure`` is the serving controller's retrofit hook: the engine's
+    store is built before the serving config exists."""
+    store = TieredKVStore()
+    h = store.put(_payload())
+    assert not store.demote(h, wait=True)  # no spill dir yet
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        store.configure(spill_dir=d, host_bytes=None)
+        assert store.demote(h, wait=True)
+        assert store.tier_of(h) == "disk"
+        store.drop(h)
+        store.close()
+
+
+def test_stats_shape():
+    store = TieredKVStore()
+    h = store.put(_payload(n=3))
+    s = store.stats()
+    assert s["host_entries"] == 1 and s["host_blocks"] == 3
+    assert s["disk_entries"] == 0 and s["disk_blocks"] == 0
+    assert s["host_bytes"] == _payload(n=3).nbytes
+    for k in ("demotions", "demote_races", "reads_host", "reads_disk",
+              "writeback_joins"):
+        assert k in s
+    store.drop(h)
+    store.close()

@@ -34,7 +34,7 @@ def _prompt(n=9, vocab=64):
     return (np.arange(n) % vocab).tolist()
 
 
-def _frame_with(version=1, flip_kv_byte=None, truncate=0):
+def _frame_with(version=1, flip_kv_byte=None, truncate=0, extra=None):
     """A hand-built v1 frame over synthetic KV — the tamper-test substrate
     (no engine needed: the framing layer is pure bytes)."""
     kv = np.arange(2 * 1 * 2 * 16 * 1 * 4, dtype=np.float32).reshape(
@@ -45,7 +45,7 @@ def _frame_with(version=1, flip_kv_byte=None, truncate=0):
         "uid": 7,
         "seen_tokens": 32,
         "tokens": list(range(32)),
-        "extra": {},
+        "extra": extra if extra is not None else {},
         "cache": {"block_size": 16, "num_layers": 1, "kv_heads": 1,
                   "head_dim": 4, "dtype": "float32"},
         "kv": {"shape": list(kv.shape), "dtype": "float32"},
@@ -71,9 +71,36 @@ def test_handoff_roundtrips_and_carries_version():
 
 def test_handoff_unknown_version_rejected_loudly():
     with pytest.raises(ValueError, match="unsupported handoff payload version"):
-        handoff.unpack(_frame_with(version=2))
+        handoff.unpack(_frame_with(version=3))
     with pytest.raises(ValueError, match="unsupported handoff payload version"):
         handoff.unpack(_frame_with(version=None))
+
+
+def test_park_frame_version_matrix():
+    """The v2 (parked) frame's versioned ``tier`` record: a v2 frame without
+    it, with a malformed record, or with a tier version from the future is
+    rejected LOUDLY by unpack — an older replica can never silently
+    misinterpret a parked session. A v1 frame must not smuggle one in."""
+    tier = {"v": handoff.TIER_FIELD_VERSION, "source": "host"}
+    header, _ = handoff.unpack(_frame_with(version=2, extra={"tier": tier}))
+    assert header["extra"]["tier"] == tier
+    # v2 requires the record
+    with pytest.raises(ValueError, match="requires a versioned extra.tier"):
+        handoff.unpack(_frame_with(version=2))
+    # malformed records
+    for bad in ({"v": 0, "source": "host"}, {"v": 1}, {"source": "host"},
+                {"v": "x", "source": "host"}, {"v": 1, "source": 3}, "host"):
+        with pytest.raises(ValueError):
+            handoff.unpack(_frame_with(version=2, extra={"tier": bad}))
+    # a tier record from the future is a loud reject, not a silent downgrade
+    with pytest.raises(ValueError, match="tier record version"):
+        handoff.unpack(_frame_with(
+            version=2,
+            extra={"tier": {"v": handoff.TIER_FIELD_VERSION + 1,
+                            "source": "host"}}))
+    # v1 frames predate parking: a tier record there is a forgery
+    with pytest.raises(ValueError, match="requires payload version >= 2"):
+        handoff.unpack(_frame_with(version=1, extra={"tier": tier}))
 
 
 def test_handoff_crc_flip_and_truncation_rejected():
