@@ -442,7 +442,11 @@ def _bench_speculative_decode(llama, groups, jnp):
     prefix-hit admission, the single prefill step. Warmup requests absorb
     compiles (including every verify-feed bucket) before either arm is
     timed. Reports accepted-tokens-per-step, acceptance rate, and the ITL
-    delta/speedup."""
+    delta/speedup. The third arm runs ``drafter="auto"`` (tree verify, a
+    fresh learned head racing prompt-lookup): on this templated workload
+    arbitration should settle on prompt-lookup — the reported
+    ``winning_drafter`` shows auto finds the right drafter instead of
+    taxing the win the trie already delivers."""
     import numpy as np
     from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
     from deepspeed_tpu.inference.v2.engine_factory import build_engine
@@ -459,7 +463,11 @@ def _bench_speculative_decode(llama, groups, jnp):
     prompt = np.random.default_rng(0).integers(0, cfg.vocab_size, PROMPT).tolist()
 
     out = {"prompt_tokens": PROMPT, "n1": N1, "n2": N2, "max_draft_tokens": K}
-    for key, spec_on in (("spec_off", False), ("spec_on", True)):
+    arms = (("spec_off", dict(enabled=False)),
+            ("spec_on", dict(enabled=True, max_draft_tokens=K)),
+            ("spec_auto", dict(enabled=True, drafter="auto",
+                               max_draft_tokens=K)))
+    for key, spec_kw in arms:
         mgr = DSStateManagerConfig(memory_config=MemoryConfig(mode=AllocationMode.ALLOCATE,
                                                               size=512),
                                    max_context=MAXCTX, max_ragged_batch_size=2048,
@@ -469,7 +477,7 @@ def _bench_speculative_decode(llama, groups, jnp):
                                                        kv_block_size=16))
         sched = ServingScheduler(eng, ServingConfig(
             prefix_cache=PrefixCacheConfig(enabled=True),
-            speculative=SpeculativeConfig(enabled=spec_on, max_draft_tokens=K)))
+            speculative=SpeculativeConfig(**spec_kw)))
 
         def gen(n):
             req = sched.submit(prompt, max_new_tokens=n)
@@ -486,6 +494,12 @@ def _bench_speculative_decode(llama, groups, jnp):
             t0 = time.perf_counter()
             r2 = gen(N2)
             t_n2 = time.perf_counter() - t0
+            winner = None
+            if key == "spec_auto":
+                doc = sched.stats()["speculative"]
+                ew = {n: d["ewma"] for n, d in doc["drafters"].items()
+                      if d["ewma"] is not None}
+                winner = max(ew, key=ew.get) if ew else None
         finally:
             sched.stop(drain=False)
             del eng
@@ -497,6 +511,8 @@ def _bench_speculative_decode(llama, groups, jnp):
                     "tokens_per_step": round(N2 / dispatches, 2),
                     "accept_rate": (round(r2.spec_accepted / r2.spec_drafted, 3)
                                     if r2.spec_drafted else None)}
+        if key == "spec_auto":
+            out[key]["winning_drafter"] = winner
     out["accepted_tokens_per_step"] = out["spec_on"]["tokens_per_step"]
     out["itl_saved_ms"] = round(out["spec_off"]["itl_ms"]
                                 - out["spec_on"]["itl_ms"], 3)

@@ -624,8 +624,78 @@ def overload_report(path):
     return 0
 
 
+def spec_report(path):
+    """``dstpu_report --spec <loadgen-json>``: render the per-drafter
+    speculative-decoding comparison table from ``bin/dstpu_loadgen
+    --spec-demo --json`` — acceptance rate, tokens per decode dispatch, and
+    ITL percentiles for each drafter family the run observed (prompt_lookup
+    vs learned, or both under auto arbitration / --drafter pins). Returns 0
+    when the doc parses and carries at least one drafter row."""
+    import json
+    import os
+
+    path = os.path.abspath(path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"cannot read speculative report {path}: {e}")
+        return 2
+    drafters = doc.get("drafters") or {}
+    overall = doc.get("overall") or {}
+    if not drafters:
+        print(f"{path} has no per-drafter rows "
+              f"(is this a loadgen --spec-demo --json file against a "
+              f"speculation-enabled server?)")
+        return 2
+    wl = doc.get("workload") or {}
+    print("-" * 78)
+    print(f"speculative decoding ... {path}")
+    demo = wl.get("spec_demo")
+    if demo:
+        print(f"workload ............... --spec-demo "
+              f"{demo[0]}:{demo[1] if len(demo) > 1 else 1} "
+              f"({wl.get('ok', '?')}/{wl.get('requests', '?')} ok"
+              + (f", pinned --drafter {wl['drafter_pin']}"
+                 if wl.get("drafter_pin") else "")
+              + ")")
+    drafted = overall.get("drafted", 0)
+    print(f"overall ................ accept_rate="
+          f"{overall.get('accepted', 0) / max(1, drafted):.2f} "
+          f"({overall.get('accepted', 0)}/{drafted} drafts) "
+          f"tokens_per_step={overall.get('tokens_per_step', 0):.2f}")
+    print("-" * 78)
+    print(f"{'drafter':<14} {'reqs':>5} {'accepted':>9} {'drafted':>8} "
+          f"{'accept':>7} {'tok/step':>9} {'itl_p50':>9} {'itl_p99':>9}")
+
+    def _ms(agg, pct):
+        v = (agg.get("itl") or {}).get(pct, (agg.get("itl") or {}).get(str(pct)))
+        return f"{v * 1e3:>7.1f}ms" if isinstance(v, (int, float)) \
+            and v == v else f"{'—':>9}"
+
+    best = max(drafters, key=lambda n: drafters[n].get("tokens_per_step", 0))
+    for name in sorted(drafters):
+        agg = drafters[name]
+        marker = "  <- best" if name == best and len(drafters) > 1 else ""
+        print(f"{name:<14} {agg.get('requests', 0):>5} "
+              f"{agg.get('accepted', 0):>9} {agg.get('drafted', 0):>8} "
+              f"{agg.get('accept_rate', 0):>7.2f} "
+              f"{agg.get('tokens_per_step', 0):>9.2f} "
+              f"{_ms(agg, 50)} {_ms(agg, 99)}" + marker)
+    print("-" * 78)
+    print(f"verdict ................ {GREEN_OK} best tokens/step: {best} "
+          f"({drafters[best].get('tokens_per_step', 0):.2f})")
+    return 0
+
+
 def main(argv=None):
     argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if "--spec" in argv:
+        idx = argv.index("--spec")
+        if idx + 1 >= len(argv):
+            print("usage: dstpu_report --spec <loadgen-spec-demo.json>")
+            return 2
+        return spec_report(argv[idx + 1])
     if "--overload" in argv:
         idx = argv.index("--overload")
         if idx + 1 >= len(argv):

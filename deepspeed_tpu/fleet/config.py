@@ -341,7 +341,11 @@ class FleetConfig(DeepSpeedConfigModel):
     prefill/mixed-role concern (those pools carry the prefix-cache trie);
     decode-role replicas self-draft from the request's own history, with the
     acceptance EWMA riding the prefill→decode handoff payload so adaptation
-    survives the migration."""
+    survives the migration. With ``drafter`` set to ``learned``/``auto`` the
+    block's tree budgets and ``draft_head_path`` flow to the listed roles
+    verbatim; the handoff additionally carries the per-drafter EWMAs and the
+    draft-head id, and a recipient whose heads differ drops only the learned
+    EWMA (re-explored cold) while keeping the rest of the drafter state."""
 
     speculative_roles: Tuple[ReplicaRole, ...] = ("mixed", "decode")
     """Replica roles that receive ``speculative`` when it is set. Prefill

@@ -88,7 +88,7 @@ from deepspeed_tpu.utils.logging import logger
 # request fields forwarded verbatim to a replica leg (everything else —
 # stream, session, handoff — is router-interpreted, never blind-forwarded)
 _LEG_FIELDS = ("max_new_tokens", "temperature", "eos_token_id", "deadline_s",
-               "seed", "priority")
+               "seed", "priority", "drafter")
 
 
 class RoutingError(RuntimeError):

@@ -298,9 +298,10 @@ class InferenceEngineV2:
                 raise ValueError("the multi-token verify feed is greedy; sampled "
                                  "verification consumes engine.verify() logits "
                                  "host-side")
-            return [np.argmax(rows, axis=-1).astype(np.int32)
-                    for rows in self.verify(batch_uids, batch_tokens,
-                                            do_checks=do_checks)]
+            # device-side argmax: only [1+k] int32 ids per sequence cross the
+            # host boundary, not [1+k, vocab] float32 logits
+            return self.verify(batch_uids, batch_tokens, do_checks=do_checks,
+                               greedy=True)
         if do_checks:
             # each SCAN STEP's ragged batch holds one token per sequence, so
             # the token budget is checked against n_seqs — but the KV-block
@@ -357,13 +358,19 @@ class InferenceEngineV2:
 
     # ------------------------------------------------------ speculative verify --
     def verify(self, batch_uids: Iterable[int], batch_tokens: Iterable,
-               do_checks: bool = True) -> List[np.ndarray]:
+               do_checks: bool = True, greedy: bool = False) -> List[np.ndarray]:
         """Speculative-decoding verify step: feed each sequence its next-input
         token plus draft tokens (``batch_tokens[i]`` holds ``1+k_i`` ids)
         through ONE ragged forward — the chunked-prefill multi-token feed path
         — and return per-position logits: a list of float32 arrays, element i
         shaped ``[1+k_i, vocab]`` where row j scores the token AFTER
         ``batch_tokens[i][:j+1]``.
+
+        ``greedy=True`` returns per-position ARGMAX ids instead (int32 arrays
+        shaped ``[1+k_i]``): the argmax runs on device, so the host transfer
+        is ``T`` ids rather than a ``[T, vocab]`` float32 materialization —
+        the greedy verify path (decode_loop's multi-token branch) never pays
+        the full-logit transfer.
 
         Every fed position's KV is written and committed (``seen_tokens``
         advances by ``1+k_i``); the caller decides the accepted prefix and
@@ -393,7 +400,8 @@ class InferenceEngineV2:
         spans = self._resolve_spans()
         if spans is not None:
             _t0 = _tel_now_us()
-        logits = np.asarray(self._model.forward_verify(self._batch))  # [T, vocab]
+        # [T, vocab] logits, or [T] argmax ids when greedy
+        rows = np.asarray(self._model.forward_verify(self._batch, greedy=greedy))
 
         for uid in batch_uids:
             seq_desc = self._state_manager.get_sequence(uid)
@@ -413,9 +421,106 @@ class InferenceEngineV2:
         # contiguous token-major run
         out, offset = [], 0
         for tokens in batch_tokens:
-            out.append(logits[offset:offset + tokens.size])
+            out.append(rows[offset:offset + tokens.size])
             offset += tokens.size
         return out
+
+    def verify_tree(self, batch_uids: Iterable[int], trees: Iterable,
+                    greedy: bool = False, do_checks: bool = True) -> List[dict]:
+        """Token-tree verify: feed each sequence a draft TREE
+        (:class:`~deepspeed_tpu.inference.v2.spec.tree.TokenTree`, root =
+        next-input token) through ONE ragged forward under the tree-attention
+        mask — multiple candidate branches priced for the cost of one
+        dispatch. Returns one dict per sequence:
+
+        - ``rows``:   float32 ``[n_nodes, vocab]`` logits (None when greedy) —
+          row j scores the token AFTER node j's root path;
+        - ``ids``:    int32 ``[n_nodes]`` device-argmax ids (greedy only);
+        - ``hidden``: float32 ``[n_nodes, hidden]`` final residual states —
+          the learned draft head's input for the next draft step.
+
+        Every node's KV is written at slot ``seen + node_index`` and committed
+        (``seen_tokens`` advances by ``n_nodes``); the caller walks the tree
+        with the spec-off sampling rule and re-packs/truncates via
+        :meth:`compact_accepted`."""
+        batch_uids = list(batch_uids)
+        trees = list(trees)
+        if do_checks:
+            schedule_check = self.can_schedule(batch_uids, [t.size for t in trees])
+            if schedule_check != SchedulingResult.Success:
+                raise SchedulingError(schedule_check)
+        self._restore_offloaded(batch_uids)
+
+        self._batch.clear()
+        if self._tracer:
+            self._tracer.init_batch(is_empty_run=False, num_layers=self._model.num_layers)
+        for uid, tree in zip(batch_uids, trees):
+            seq_desc = self._state_manager.get_or_create_sequence(uid)
+            self._model.maybe_allocate_kv(seq_desc, tree.size)
+            seq_desc.pre_forward(tree.size)
+            self._batch.insert_sequence(seq_desc, tree.tokens, do_checks=do_checks,
+                                        tree=(tree.parents, tree.depths))
+            if self._tracer:
+                self._tracer.add_sequence(seq_desc)
+
+        self._batch.finalize()
+        self._model.prepare_batch(self._batch)
+        spans = self._resolve_spans()
+        if spans is not None:
+            _t0 = _tel_now_us()
+        rows, hidden = self._model.forward_verify_tree(self._batch, greedy=greedy)
+        rows, hidden = np.asarray(rows), np.asarray(hidden)
+
+        for uid in batch_uids:
+            seq_desc = self._state_manager.get_sequence(uid)
+            seq_desc.post_forward()
+            self._model.maybe_free_kv(seq_desc)
+        n_tokens = int(sum(t.size for t in trees))
+        if spans is not None:
+            spans.record("verify_tree", cat="inference", ts_us=_t0,
+                         dur_us=_tel_now_us() - _t0,
+                         args={"sequences": len(batch_uids),
+                               "tokens": n_tokens,
+                               "uids": [int(u) for u in batch_uids]})
+        metrics = self._resolve_tel_metrics()
+        if metrics is not None:
+            self._write_telemetry(metrics, batch_tokens=n_tokens)
+        out, offset = [], 0
+        for tree in trees:
+            n = tree.size
+            out.append({"rows": None if greedy else rows[offset:offset + n],
+                        "ids": rows[offset:offset + n] if greedy else None,
+                        "hidden": hidden[offset:offset + n]})
+            offset += n
+        return out
+
+    def compact_accepted(self, uid: int, n_fed: int, path_indices) -> int:
+        """Tree-aware KV compaction after a :meth:`verify_tree` step over an
+        ``n_fed``-node tree: keep the root plus the accepted path
+        ``path_indices`` (ascending LOCAL node indices, root excluded),
+        re-pack their KV to contiguous slots ``seen0 + 1..m`` in one jitted
+        gather-then-scatter, and truncate the rejected remainder with the
+        write-then-truncate rollback. Chain-shaped acceptances (``path[j] ==
+        j+1``, the prompt-lookup case) skip the device copy entirely. Returns
+        the number of rejected positions truncated."""
+        seq_desc = self._state_manager.get_sequence(uid)
+        if seq_desc is None:
+            raise ValueError(f"compact_accepted: unknown uid {uid}")
+        path = [int(i) for i in path_indices]
+        if any(not (0 < i < n_fed) for i in path) or \
+                any(b <= a for a, b in zip(path, path[1:])):
+            raise ValueError(f"accepted path must be ascending non-root node "
+                             f"indices inside the {n_fed}-node tree: {path}")
+        copies = [(i, j + 1) for j, i in enumerate(path) if i != j + 1]
+        if copies:
+            seen0 = seq_desc.seen_tokens - n_fed  # committed count pre-feed
+            self._model.compact_kv(seq_desc,
+                                   [seen0 + s for s, _ in copies],
+                                   [seen0 + d for _, d in copies])
+        rejected = n_fed - 1 - len(path)
+        if rejected > 0:
+            seq_desc.rollback(rejected)
+        return rejected
 
     def rollback(self, uid: int, n_tokens: int) -> None:
         """Truncate ``uid``'s last ``n_tokens`` committed tokens after a
@@ -566,6 +671,12 @@ class InferenceEngineV2:
         """``jax.stages.Lowered`` of the speculative verify program (one
         ragged forward unembedding every fed position). Never executes."""
         return self._model.lower_verify_step(bucket)
+
+    def lower_tree_verify(self, bucket=None, greedy: bool = False):
+        """``jax.stages.Lowered`` of the token-tree verify program (one
+        ragged forward under the tree-attention mask, unembedding every node
+        and returning the draft head's hidden states). Never executes."""
+        return self._model.lower_tree_verify(bucket, greedy=greedy)
 
     # -------------------------------------------------------------- empty_run --
     def empty_run(self) -> None:

@@ -200,23 +200,33 @@ class DSTransformerModelBase:
         return self._compiled[bucket]
 
     # -------------------------------------------------------- lowering hooks --
+    @staticmethod
+    def _lowerable_kind(key) -> str:
+        """Program-kind classification of a ``_compiled``/``_lowerable`` jit
+        cache key: ``(T, S, MB)`` int tuples are forward programs,
+        ``(bucket, n_steps, sampled)`` are decode loops, and every 2-tuple
+        with a string head is named after that head (``verify``,
+        ``verify_greedy``, ``tree_verify``, ``tree_verify_greedy``,
+        ``compact``)."""
+        if isinstance(key, tuple) and len(key) == 3 and isinstance(key[0], tuple):
+            return "decode_loop"
+        if isinstance(key, tuple) and len(key) == 2 and isinstance(key[0], str):
+            return key[0]
+        return "forward"
+
     def lowerable_callables(self):
-        """Raw ``jax.jit`` callables (they support ``.lower()``) keyed exactly
-        like ``_compiled``: forward programs by ``(T, S, MB)`` bucket, decode
-        programs by ``(bucket, n_steps, sampled)``, speculative verify
-        programs by ``("verify", bucket)``. The official hook for HLO-level
-        analysis (deepspeed_tpu/perf/) — the entries in ``_compiled`` may be
-        compile-watch wrappers, which cannot lower."""
-        return {"forward": {k: v for k, v in self._lowerable.items()
-                            if not (isinstance(k, tuple) and len(k) == 3
-                                    and isinstance(k[0], tuple))
-                            and not (isinstance(k, tuple) and k[0] == "verify")},
-                "decode_loop": {k: v for k, v in self._lowerable.items()
-                                if isinstance(k, tuple) and len(k) == 3
-                                and isinstance(k[0], tuple)},
-                "verify": {k: v for k, v in self._lowerable.items()
-                           if isinstance(k, tuple) and len(k) == 2
-                           and k[0] == "verify"}}
+        """Raw ``jax.jit`` callables (they support ``.lower()``) grouped by
+        program kind and keyed exactly like ``_compiled``: forward programs by
+        ``(T, S, MB)`` bucket, decode programs by ``(bucket, n_steps,
+        sampled)``, the speculative verify family by ``("verify"|
+        "verify_greedy"|"tree_verify"|"tree_verify_greedy", bucket)`` and the
+        accepted-path KV re-pack by ``("compact", n_pairs)``. The official
+        hook for HLO-level analysis (deepspeed_tpu/perf/) — the entries in
+        ``_compiled`` may be compile-watch wrappers, which cannot lower."""
+        out = {"forward": {}, "decode_loop": {}, "verify": {}}
+        for k, v in self._lowerable.items():
+            out.setdefault(self._lowerable_kind(k), {})[k] = v
+        return out
 
     def _synthetic_batch(self, bucket=None):
         """Shape/dtype-faithful device-batch arrays for ``bucket`` (default:
@@ -272,6 +282,23 @@ class DSTransformerModelBase:
                           dev["seq_meta"].shape[1] - 4))
         fn = self._lowerable.get(key) or jax.jit(self._verify_impl,
                                                  donate_argnums=(1, ))
+        return fn.lower(self._params, self._state_manager.kv_cache.cache, dev)
+
+    def lower_tree_verify(self, bucket=None, greedy: bool = False):
+        """Lower the token-tree verify program at ``bucket`` (default
+        smallest) — the same ``_tree_verify_impl`` jit
+        :meth:`forward_verify_tree` runs. The synthetic ``tree_meta`` is a
+        chain (lowering consumes avals only; the mask program is identical
+        for every tree shape at a bucket). Never executes."""
+        import jax
+        dev = self._synthetic_batch(bucket)
+        T = dev["tok_meta"].shape[1]
+        dev["tree_meta"] = np.stack([np.arange(-1, T - 1, dtype=np.int32),
+                                     np.arange(T, dtype=np.int32)])
+        key = ("tree_verify_greedy" if greedy else "tree_verify",
+               (T, dev["seq_meta"].shape[0], dev["seq_meta"].shape[1] - 4))
+        fn = self._lowerable.get(key) or jax.jit(
+            partial(self._tree_verify_impl, greedy=greedy), donate_argnums=(1, ))
         return fn.lower(self._params, self._state_manager.kv_cache.cache, dev)
 
     # ------------------------------------------------------------ decode loop --
@@ -380,7 +407,7 @@ class DSTransformerModelBase:
         return logits.astype(jnp.float32), cache
 
     # ----------------------------------------------------- speculative verify --
-    def forward_verify(self, ragged_batch):
+    def forward_verify(self, ragged_batch, greedy: bool = False):
         """The speculative-decoding verify forward: identical layer compute to
         :meth:`forward`, but EVERY token position is unembedded — returns
         logits ``[T_bucket, vocab]`` (row t scores the token AFTER batch
@@ -388,14 +415,21 @@ class DSTransformerModelBase:
         draft tokens per sequence. The KV cache is updated in place for every
         fed position, including drafts that turn out wrong — the caller rolls
         those back by truncating ``seen_tokens`` (the KV is overwritten when
-        the correct tokens are fed at the same positions)."""
+        the correct tokens are fed at the same positions).
+
+        ``greedy=True`` runs the device-argmax variant instead: the ``[T,
+        vocab]`` float32 logits stay on device and only ``[T]`` int32 token
+        ids cross to the host — the greedy verify path's host transfer drops
+        from ``T * vocab * 4`` bytes to ``T * 4`` (memoed in the
+        ``spec_verify_step`` perf budget)."""
         import jax
         batch = ragged_batch.device_batch if hasattr(ragged_batch, "device_batch") else ragged_batch
         bucket = (batch["tok_meta"].shape[1], batch["seq_meta"].shape[0],
                   batch["seq_meta"].shape[1] - 4)
-        key = ("verify", bucket)
+        key = ("verify_greedy" if greedy else "verify", bucket)
         if key not in self._compiled:
-            fn = jax.jit(self._verify_impl, donate_argnums=(1, ))
+            fn = jax.jit(self._verify_greedy_impl if greedy else self._verify_impl,
+                         donate_argnums=(1, ))
             self._lowerable[key] = fn
             cw = compile_watch.get()
             if cw is not None:
@@ -403,9 +437,9 @@ class DSTransformerModelBase:
             self._compiled[key] = fn
         cache = self._state_manager.kv_cache.cache
         dev = {"tok_meta": batch["tok_meta"], "seq_meta": batch["seq_meta"]}
-        logits, new_cache = self._compiled[key](self._params, cache, dev)
+        out, new_cache = self._compiled[key](self._params, cache, dev)
         self._state_manager.kv_cache.set_cache(new_cache)
-        return logits
+        return out
 
     def _verify_impl(self, params, cache, batch):
         """Same program body as :meth:`_forward_impl` minus the last-token
@@ -421,6 +455,78 @@ class DSTransformerModelBase:
             x, cache = self.layer_forward(params, li, x, cache, attn, batch)
         logits = self.unembed(params, x)  # ALL positions, token-major
         return logits.astype(jnp.float32), cache
+
+    def _verify_greedy_impl(self, params, cache, batch):
+        """Greedy verify: argmax on device, so only ``[T]`` int32 ids transfer
+        to the host instead of the full ``[T, vocab]`` float32 logits."""
+        import jax.numpy as jnp
+        logits, cache = self._verify_impl(params, cache, batch)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+    # ------------------------------------------------------ tree verification --
+    def forward_verify_tree(self, ragged_batch, greedy: bool = False):
+        """Token-tree verify (spec/tree.py): one ragged forward scores every
+        node of each sequence's draft TREE under a tree-attention mask — a
+        node attends to the committed prefix plus its own ancestor path only,
+        so sibling branches cannot see each other even though they share the
+        batch. Requires the batch to carry ``tree_meta`` (the ragged wrapper
+        packs it when a tree is inserted).
+
+        Returns ``(rows_or_ids, hidden)``: per-node float32 logits ``[T,
+        vocab]`` (or, with ``greedy=True``, device-argmax int32 ids ``[T]``)
+        plus the final residual hidden state ``[T, hidden]`` float32 — the
+        learned draft head's input for the NEXT draft step. KV is written at
+        slot positions ``seen + node_index``; the caller re-packs the accepted
+        path with ``engine_v2.compact_accepted``."""
+        import jax
+        batch = ragged_batch.device_batch if hasattr(ragged_batch, "device_batch") else ragged_batch
+        if "tree_meta" not in batch:
+            raise ValueError("forward_verify_tree needs a batch with tree_meta "
+                             "(insert sequences with tree=(parents, depths))")
+        bucket = (batch["tok_meta"].shape[1], batch["seq_meta"].shape[0],
+                  batch["seq_meta"].shape[1] - 4)
+        key = ("tree_verify_greedy" if greedy else "tree_verify", bucket)
+        if key not in self._compiled:
+            fn = jax.jit(partial(self._tree_verify_impl, greedy=greedy),
+                         donate_argnums=(1, ))
+            self._lowerable[key] = fn
+            cw = compile_watch.get()
+            if cw is not None:
+                fn = cw.wrap("inference_tree_verify", key, fn)
+            self._compiled[key] = fn
+        cache = self._state_manager.kv_cache.cache
+        dev = {"tok_meta": batch["tok_meta"], "seq_meta": batch["seq_meta"],
+               "tree_meta": batch["tree_meta"]}
+        out, hidden, new_cache = self._compiled[key](self._params, cache, dev)
+        self._state_manager.kv_cache.set_cache(new_cache)
+        return out, hidden
+
+    def _tree_verify_impl(self, params, cache, batch, *, greedy=False):
+        """Verify-program body for token trees. ``token_pos`` as packed by the
+        wrapper is the KV SLOT position (``seen + node_index``); the model
+        sees the LOGICAL position ``seen + depth`` (rotary embeddings must
+        encode tree depth, not slot), while the attention closure keeps the
+        slot positions for the cache scatter."""
+        import jax.numpy as jnp
+        from deepspeed_tpu.inference.v2.quantization import dequantize_tree
+
+        params = dequantize_tree(params)
+        tree_meta = jnp.asarray(batch["tree_meta"])
+        parents, depths = tree_meta[0], tree_meta[1]
+        batch = self._unpack_batch(batch)
+        slot_pos = batch["token_pos"]
+        batch = dict(batch,
+                     token_pos=batch["seq_seen"][batch["token_seq"]] + depths)
+        x = self.embed(params, batch["input_ids"])
+        attn = partial(self._tree_paged_attention, batch=batch,
+                       slot_pos=slot_pos, parents=parents, depths=depths)
+        for li in range(self.num_layers):
+            x, cache = self.layer_forward(params, li, x, cache, attn, batch)
+        hidden = x.astype(jnp.float32)  # pre-final-norm residual, token-major
+        logits = self.unembed(params, x).astype(jnp.float32)
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), hidden, cache
+        return logits, hidden, cache
 
     def _traced_forward(self, batch, cache, n):
         """Phase-timed execution for the tracer: embed / per-layer phases /
@@ -545,6 +651,179 @@ class DSTransformerModelBase:
         out = out_dense[token_seq, jnp.minimum(local_q, Qm - 1)]  # [T, H, D]
         out = jnp.where(token_valid[:, None, None], out, 0.0)
         return out, cache
+
+    def _tree_paged_attention(self, q, k_new, v_new, cache, li, *, batch,
+                              slot_pos, parents, depths):
+        """Tree-attention over the paged cache: each query node sees the
+        committed prefix plus its ANCESTOR-OR-SELF nodes only — sibling draft
+        branches sharing the feed are mutually invisible. New K/V scatter at
+        SLOT positions (``seen + node_index``, distinct per node) while
+        ``batch["token_pos"]`` already carries the LOGICAL (depth-based)
+        positions the rotary embedding consumed.
+
+        Bitwise-identity construction: every query node attends a PER-QUERY
+        virtual KV view in which its depth-d ancestor occupies kv index
+        ``seen + d`` — exactly the slot a linear feed of that root path would
+        write. The masked logits, softmax reduction and value contraction
+        then see identical operands at identical indices as the linear verify
+        of the same path, so any accepted branch scores bit-identically to
+        spec-off decode (floating-point reduction order is layout-sensitive;
+        a mask alone cannot give token-identical speculation). The view is a
+        gather of the shared history — ``Qm`` is a handful of draft nodes, so
+        the duplication is bounded by the tree budget.
+
+        Always the XLA fallback path: the Pallas paged kernel assumes a
+        contiguous causal feed and cannot express the ancestor view."""
+        import jax
+        import jax.numpy as jnp
+
+        T = q.shape[0]
+        S, MB = batch["block_table"].shape
+        bs = cache.shape[4]
+        H, D = self.num_heads, self.head_dim
+        KVH = self.num_kv_heads
+
+        token_seq = batch["token_seq"]
+        token_valid = batch["token_valid"]
+
+        # --- scatter new kv at slot positions --------------------------------
+        NB = cache.shape[2]
+        blk_idx = slot_pos // bs
+        blk_ids = batch["block_table"][token_seq, jnp.minimum(blk_idx, MB - 1)]
+        blk_ids = jnp.where(token_valid & (blk_ids >= 0), blk_ids, NB)
+        offs = slot_pos % bs
+        cache = cache.at[li, 0, blk_ids, :, offs].set(k_new.astype(cache.dtype), mode="drop")
+        cache = cache.at[li, 1, blk_ids, :, offs].set(v_new.astype(cache.dtype), mode="drop")
+
+        # --- gather per-sequence history -------------------------------------
+        table = jnp.maximum(batch["block_table"], 0)  # [S, MB]
+        k_hist = cache[li, 0][table]
+        v_hist = cache[li, 1][table]
+        KV = MB * bs
+        k_hist = k_hist.transpose(0, 2, 1, 3, 4).reshape(S, KVH, KV, D) \
+            .transpose(0, 2, 1, 3).astype(q.dtype)
+        v_hist = v_hist.transpose(0, 2, 1, 3, 4).reshape(S, KVH, KV, D) \
+            .transpose(0, 2, 1, 3).astype(q.dtype)
+        if KVH != H:  # GQA
+            rep = H // KVH
+            k_hist = jnp.repeat(k_hist, rep, axis=2)
+            v_hist = jnp.repeat(v_hist, rep, axis=2)
+
+        # --- densify queries + tree metadata per sequence --------------------
+        local_q = slot_pos - batch["seq_seen"][token_seq]  # node index in feed
+        Qm = int(np.max([1, T]))
+        seq_ids = jnp.where(token_valid, token_seq, S)  # OOB drop for padding
+        row = jnp.minimum(local_q, Qm - 1)
+        q_dense = jnp.zeros((S, Qm, H, D), q.dtype).at[seq_ids, row].set(q, mode="drop")
+        parent_dense = jnp.full((S, Qm), -1, jnp.int32) \
+            .at[seq_ids, row].set(parents.astype(jnp.int32), mode="drop")
+        depth_dense = jnp.zeros((S, Qm), jnp.int32) \
+            .at[seq_ids, row].set(depths.astype(jnp.int32), mode="drop")
+
+        # --- ancestors by depth: abd[s, i, d] = node on i's root path at
+        # depth d, or -1. Parent pointers are topological (parent < child), so
+        # Qm hops of pointer-chasing reach every ancestor.
+        s_ix = jnp.arange(S)[:, None]
+        i_ix = jnp.arange(Qm)[None, :]
+
+        def _hop(_, carry):
+            abd, cur = carry
+            d = jnp.take_along_axis(depth_dense, jnp.clip(cur, 0, Qm - 1), axis=1)
+            abd = abd.at[s_ix, i_ix, jnp.where(cur >= 0, d, Qm)].set(
+                jnp.maximum(cur, -1), mode="drop")
+            nxt = jnp.take_along_axis(parent_dense, jnp.clip(cur, 0, Qm - 1), axis=1)
+            return abd, jnp.where(cur >= 0, nxt, -1)
+
+        abd, _ = jax.lax.fori_loop(
+            0, Qm, _hop,
+            (jnp.full((S, Qm, Qm), -1, jnp.int32),
+             jnp.tile(jnp.arange(Qm, dtype=jnp.int32)[None, :], (S, 1))))
+
+        # --- per-query virtual KV: committed slots pass through; feed slot
+        # seen+d resolves to the query's depth-d ancestor's slot -------------
+        kvr = jnp.arange(KV)
+        seen_v = batch["seq_seen"]
+        d_of_kv = kvr[None, :] - seen_v[:, None]                     # [S, KV]
+        in_feed = (d_of_kv >= 0) & (d_of_kv < Qm)
+        node = abd[jnp.arange(S)[:, None, None],
+                   jnp.arange(Qm)[None, :, None],
+                   jnp.clip(d_of_kv, 0, Qm - 1)[:, None, :]]         # [S, Qm, KV]
+        src = jnp.where(in_feed[:, None, :],
+                        jnp.where(node >= 0, seen_v[:, None, None] + node, KV),
+                        kvr[None, None, :])                          # [S, Qm, KV]
+        src_c = jnp.clip(src, 0, KV - 1)
+        k_q = k_hist[jnp.arange(S)[:, None, None], src_c]            # [S, Qm, KV, H, D]
+        v_q = v_hist[jnp.arange(S)[:, None, None], src_c]
+
+        scale = 1.0 / (D**0.5)
+        logits = jnp.einsum("sihd,sikhd->shik", q_dense, k_q).astype(jnp.float32) * scale
+        # visibility: committed prefix, or an existing ancestor-or-self at the
+        # depth slot; the logical kv position of feed slot seen+d IS seen+d,
+        # so the sliding window applies to the raw kv index either way
+        valid_kv = (kvr[None, None, :] < seen_v[:, None, None]) | \
+            (in_feed[:, None, :] & (node >= 0))                      # [S, Qm, KV]
+        if self.attention_window > 0:
+            q_log = seen_v[:, None] + depth_dense                    # [S, Qm]
+            valid_kv &= kvr[None, None, :] > q_log[:, :, None] - self.attention_window
+        logits = jnp.where(valid_kv[:, None, :, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        out_dense = jnp.einsum("shik,sikhd->sihd", probs, v_q)
+
+        # --- back to token-major ---------------------------------------------
+        out = out_dense[token_seq, jnp.minimum(local_q, Qm - 1)]  # [T, H, D]
+        out = jnp.where(token_valid[:, None, None], out, 0.0)
+        return out, cache
+
+    # ---------------------------------------------------------- kv compaction --
+    def compact_kv(self, seq_desc: DSSequenceDescriptor, src_slots, dst_slots) -> None:
+        """Copy KV at ``src_slots`` to ``dst_slots`` (absolute token slots of
+        ``seq_desc``) across every layer and both K/V in ONE jitted
+        gather-then-scatter — the tree-verify accepted-path re-pack: accepted
+        nodes live at scattered slots ``seen0 + node_index`` and must land at
+        contiguous ``seen0 + 1..m`` before the rejected tail is truncated.
+        The gather reads the pre-copy cache, so overlapping src/dst pairs are
+        safe. Jitted per pow2-padded copy count; padded pairs scatter to an
+        out-of-range block and drop."""
+        import jax
+        from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import _pow2_pad
+
+        src = np.asarray(src_slots, np.int64).reshape(-1)
+        dst = np.asarray(dst_slots, np.int64).reshape(-1)
+        if src.size != dst.size:
+            raise ValueError("compact_kv needs matching src/dst slot lists")
+        if src.size == 0:
+            return
+        bs = self._state_manager.kv_block_size
+        blocks = seq_desc.kv_blocks
+        NB = self._state_manager.kv_cache.cache.shape[2]
+        P = _pow2_pad(src.size, 2)
+        src_blk = np.zeros(P, np.int32)
+        src_off = np.zeros(P, np.int32)
+        dst_blk = np.full(P, NB, np.int32)  # pad -> positive OOB -> drop
+        dst_off = np.zeros(P, np.int32)
+        src_blk[:src.size] = blocks[src // bs]
+        src_off[:src.size] = src % bs
+        dst_blk[:dst.size] = blocks[dst // bs]
+        dst_off[:dst.size] = dst % bs
+
+        key = ("compact", P)
+        if key not in self._compiled:
+            fn = jax.jit(self._compact_impl, donate_argnums=(0, ))
+            self._lowerable[key] = fn
+            cw = compile_watch.get()
+            if cw is not None:
+                fn = cw.wrap("inference_kv_compact", key, fn)
+            self._compiled[key] = fn
+        new_cache = self._compiled[key](self._state_manager.kv_cache.cache,
+                                        src_blk, src_off, dst_blk, dst_off)
+        self._state_manager.kv_cache.set_cache(new_cache)
+
+    @staticmethod
+    def _compact_impl(cache, src_blk, src_off, dst_blk, dst_off):
+        # advanced indexing at axes 2 (block) and 4 (offset) puts the pair
+        # axis first: vals[p, l, kv, h, d]
+        vals = cache[:, :, src_blk, :, src_off]
+        return cache.at[:, :, dst_blk, :, dst_off].set(vals, mode="drop")
 
     # ------------------------------------------------------------- serialize --
     def flattened_params(self):

@@ -28,6 +28,7 @@ DECODE_STEPS = 8
 PREFIX_TOKENS, SUFFIX_TOKENS = 192, 24
 KV_BLOCK = 16
 SPEC_DRAFT_K = 3  # verify feed width 1+k pads into the smallest token bucket
+SPEC_TREE_NODES = 8  # token-tree feed (root + draft branches) at the smallest bucket
 
 
 @dataclass
@@ -175,6 +176,28 @@ def _build_spec_verify_step() -> BuiltProgram:
         comparisons={"single_token_forward": engine.lower_forward()})
 
 
+def _build_spec_tree_verify() -> BuiltProgram:
+    """The token-tree verify program: one ragged forward scoring a whole
+    draft TREE (root + branching candidates) under the tree-attention mask
+    with the per-query virtual-KV gather, in its device-argmax greedy
+    variant — per-node ids cross the host boundary, not a ``[T, vocab]``
+    f32 logits block. Built at the smallest pad bucket; the comparisons ARE
+    the tree-speculation claim: verifying up to SPEC_TREE_NODES tree nodes
+    costs a budgeted multiple of ONE single-token forward at the same
+    bucket — nowhere near node-count sequential steps — and stays in the
+    linear verify program's weight class despite the mask and gather."""
+    engine, _ = build_v2_engine()
+    return BuiltProgram(
+        name="spec_tree_verify",
+        lowered=engine.lower_tree_verify(greedy=True),
+        meta={"tree_nodes": SPEC_TREE_NODES, "kv_block_size": KV_BLOCK,
+              "greedy": True,
+              "note": "tree-attention mask + per-query virtual KV at the "
+                      "smallest decode bucket; greedy returns per-node ids"},
+        comparisons={"single_token_forward": engine.lower_forward(),
+                     "linear_verify": engine.lower_verify_step()})
+
+
 def _build_int4_decode_matmul() -> BuiltProgram:
     engine, _ = build_v2_engine(quant_bits=4)
     bf16_engine, _ = build_v2_engine(quant_bits=None)
@@ -214,6 +237,7 @@ FLAGSHIP_PROGRAMS: Dict[str, Callable[[], BuiltProgram]] = {
     "flash_attention_fwd_bwd": _build_flash_fwd_bwd,
     "paged_decode_step": _build_paged_decode_step,
     "spec_verify_step": _build_spec_verify_step,
+    "spec_tree_verify": _build_spec_tree_verify,
     "int4_decode_matmul": _build_int4_decode_matmul,
     "prefix_suffix_prefill": _build_prefix_suffix_prefill,
 }

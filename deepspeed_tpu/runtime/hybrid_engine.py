@@ -105,6 +105,27 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                 self._inference_engine = None
             self.train(was_training)
 
+    # ---------------------------------------------------- draft-head distill --
+    def distill_draft_head(self, num_heads: int = 3, steps: int = 150,
+                           max_new_tokens: int = 48, seed: int = 0, **kw):
+        """Self-distill speculative draft heads against the LIVE training
+        weights (inference/v2/spec/distill.py): the corpus is generated
+        in-process through this engine's generate path — the RLHF shape,
+        where the policy drifts every step and the drafter must track it
+        without an external dataset. Returns ``(MedusaDraftHead, losses)``;
+        KV blocks recycle after, like :meth:`generate`."""
+        from deepspeed_tpu.inference.v2.spec.distill import self_distill
+
+        was_training = self.training
+        self.eval()
+        engine = self._get_inference_engine()
+        try:
+            return self_distill(engine, num_heads=num_heads, steps=steps,
+                                max_new_tokens=max_new_tokens, seed=seed, **kw)
+        finally:
+            engine.flush_all()
+            self.train(was_training)
+
     @property
     def inference_engine(self):
         return self._get_inference_engine()

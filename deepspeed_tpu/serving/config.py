@@ -44,24 +44,65 @@ class PrefixCacheConfig(DeepSpeedConfigModel):
 
 
 class SpeculativeConfig(DeepSpeedConfigModel):
-    """Speculative decoding via model-free self-drafting
-    (``inference/v2/spec/``): a prompt-lookup drafter proposes up to
-    ``max_draft_tokens`` continuation tokens per sequence per decode step
-    (mined from the prefix-cache trie when one is attached, else from the
-    request's own history); the engine verifies all ``1+k`` positions in ONE
-    ragged forward and the scheduler accepts the longest matching prefix.
+    """Speculative decoding (``inference/v2/spec/``): a drafter proposes
+    continuation tokens per sequence per decode step at batch-build time and
+    the engine verifies every proposed position in ONE ragged forward.
     Output is token-identical to non-speculative decoding at the same seed —
-    greedy and sampled — the only effect is fewer decode dispatches on
-    repetitive text. Off by default."""
+    greedy and sampled — the only effect is fewer decode dispatches. Off by
+    default.
+
+    Two drafter families, selected by ``drafter``: ``prompt_lookup`` mines
+    n-gram repeats (linear ``1+k`` feeds through ``engine.verify``; wins on
+    repetitive text, k adapts to 0 elsewhere) and ``learned`` reads the
+    target's hidden state through trained Medusa-style heads and proposes a
+    token TREE verified under the tree-attention mask
+    (``engine.verify_tree``; wins on arbitrary text after self-distillation
+    — ``bin/dstpu_spec_train``). ``auto`` arbitrates per request on measured
+    per-drafter acceptance EWMAs, probing the loser periodically."""
+
+    def __init__(self, strict=False, **data):
+        # the base model drops "auto"-valued kwargs so defaults apply (the
+        # reference's use-the-default marker) — but "auto" is a REAL drafter
+        # mode here; route it around the filter and through validation
+        drafter = data.pop("drafter", None)
+        super().__init__(strict=strict, **data)
+        if drafter is not None:
+            self.drafter = drafter
 
     enabled: bool = False
     """Draft at batch-build time and run multi-token verify feeds through
     the decode path."""
 
+    drafter: Literal["prompt_lookup", "learned", "auto"] = "prompt_lookup"
+    """Drafter selection. ``prompt_lookup`` keeps the linear verify path;
+    ``learned``/``auto`` route speculative decode through token-tree verify
+    (a prompt-lookup draft then rides as a chain tree — bitwise the linear
+    program's output)."""
+
     max_draft_tokens: int = Field(4, ge=1)
     """Upper bound on draft tokens per sequence per step (k). The effective k
     adapts per request from a measured acceptance EWMA and reaches 0 on
-    adversarial (pattern-free) text."""
+    adversarial (pattern-free) text. For the learned drafter this caps tree
+    DEPTH (bounded additionally by ``num_draft_heads``)."""
+
+    num_draft_heads: int = Field(3, ge=1, le=8)
+    """Medusa heads a freshly-initialized learned drafter carries (head ``h``
+    predicts the token ``h + 2`` positions past the hidden state); ignored
+    when ``draft_head_path`` loads trained heads with their own count."""
+
+    tree_width: int = Field(2, ge=1)
+    """Candidate tokens per head the learned drafter may branch over when
+    growing the draft tree (best-first by joint log-probability)."""
+
+    tree_node_budget: int = Field(8, ge=2)
+    """Cap on nodes per draft tree (root included). Tree nodes are fed
+    tokens: they compete under the ragged token budget and
+    ``draft_token_budget`` exactly like linear draft tokens."""
+
+    draft_head_path: Optional[str] = None
+    """Trained draft-head ``.npz`` (``bin/dstpu_spec_train`` output) for the
+    learned drafter; None = fresh deterministic heads (acceptance adapts k
+    to 0 until they are trained, so this is safe but slow)."""
 
     min_ngram: int = Field(1, ge=1)
     max_ngram: int = Field(3, ge=1)

@@ -81,6 +81,27 @@ class ServingMetrics:
             "serving_spec_tokens_per_step",
             "Tokens emitted per speculative verify step (1 = nothing accepted)",
             buckets=(1, 2, 3, 4, 6, 8, 12, 16))
+        # token-tree verification + drafter arbitration (learned/auto modes)
+        self.spec_tree_nodes = registry.counter(
+            "serving_spec_tree_nodes_total",
+            "Token-tree nodes fed through verify_tree dispatches (root included)")
+        self.spec_tree_accept_depth = registry.histogram(
+            "serving_spec_tree_accept_depth",
+            "Accepted path depth per tree-verify step (0 = root only survived)",
+            buckets=(0, 1, 2, 3, 4, 6, 8))
+        self.spec_tree_compactions = registry.counter(
+            "serving_spec_tree_compactions_total",
+            "Tree-verify steps whose accepted path needed a KV gather-compact "
+            "(non-chain acceptance)")
+        self.spec_drafter_switches = registry.counter(
+            "serving_spec_drafter_switches_total",
+            "Per-request drafter changes decided by the auto arbitration")
+        self.spec_drafter_learned_ewma = registry.gauge(
+            "serving_spec_drafter_learned_ewma",
+            "EWMA of the learned drafter's accepted-depth rate across requests")
+        self.spec_drafter_lookup_ewma = registry.gauge(
+            "serving_spec_drafter_lookup_ewma",
+            "EWMA of the prompt-lookup drafter's accepted-depth rate across requests")
         # overload control (serving/overload.py + scheduler admission/shed)
         self.shed_admission = registry.counter(
             "serving_shed_admission_total",

@@ -203,6 +203,20 @@ class Request:
         # the scheduler so per-step drafting copies O(new tokens), not O(all)
         self._spec_history: Optional[np.ndarray] = None
         self._spec_history_len = 0
+        # learned / auto drafter state (scheduler thread only): the target's
+        # hidden state behind the next decode input (valid only while
+        # _spec_hidden_pos equals the current history length), the per-drafter
+        # acceptance EWMAs "auto" arbitrates over (carried across handoffs),
+        # the drafter that built the in-flight feed, and the in-flight
+        # TokenTree awaiting verify (None = linear/plain feed this tick)
+        self._spec_hidden: Optional[np.ndarray] = None
+        self._spec_hidden_pos = -1
+        self._spec_ewmas: dict = {}
+        self._spec_last_drafter: Optional[str] = None
+        self._spec_tree = None
+        # client-requested drafter pin (``submit(drafter=...)``): overrides
+        # "auto" arbitration for THIS request — the loadgen's A/B lever
+        self._spec_drafter_pin: Optional[str] = None
 
     # ----------------------------------------------------------------- state --
     @property

@@ -62,6 +62,18 @@ _SCHEDULER_IDS = itertools.count()
 KILLED_ERROR_PREFIX = "replica killed"
 
 
+_DRAFTER_PINS = ("prompt_lookup", "learned", "auto")
+
+
+def _validate_drafter_pin(drafter) -> Optional[str]:
+    if drafter is None:
+        return None
+    if drafter not in _DRAFTER_PINS:
+        raise ValueError(f"unknown drafter {drafter!r}: "
+                         f"expected one of {_DRAFTER_PINS}")
+    return drafter
+
+
 class QueueFullError(RuntimeError):
     """reject-mode backpressure: the submission queue is at capacity."""
 
@@ -117,6 +129,10 @@ class ServingScheduler:
                            "shed_admission", "shed_queue", "brownout_rejected",
                            "brownout_clamped", "spec_drafted", "spec_accepted",
                            "spec_steps", "spec_rollback",
+                           "spec_tree_nodes", "spec_tree_compactions",
+                           "spec_drafter_switches",
+                           "spec_drafted_learned", "spec_accepted_learned",
+                           "spec_drafted_lookup", "spec_accepted_lookup",
                            "peer_fetch_hits", "peer_fetch_rejects",
                            "peer_fetch_blocks", "steals",
                            "tier_demotions", "brownout_demotions",
@@ -183,12 +199,35 @@ class ServingScheduler:
         # prompt-lookup drafter wants to mine), self-lookup otherwise.
         self._drafter = None
         self._spec_accept_ewma: Optional[float] = None
+        self._drafter_mode = "prompt_lookup"
+        self._learned = None
+        self._spec_head_id: Optional[str] = None
+        self._spec_drafter_ewmas: Dict[str, float] = {}
         if self._config.speculative.enabled:
             from deepspeed_tpu.inference.v2.spec import PromptLookupDrafter
             scfg = self._config.speculative
             self._drafter = PromptLookupDrafter(min_ngram=scfg.min_ngram,
                                                 max_ngram=scfg.max_ngram,
                                                 prefix_cache=self._prefix_cache)
+            self._drafter_mode = scfg.drafter
+            if scfg.drafter != "prompt_lookup":
+                # learned / auto: Medusa-style heads read the target's hidden
+                # state and propose token TREES verified by engine.verify_tree;
+                # "auto" races them against prompt-lookup per request on
+                # measured acceptance EWMAs. Untrained fresh heads are safe —
+                # acceptance adapts their k to 0 until dstpu_spec_train runs.
+                from deepspeed_tpu.inference.v2.spec import (LearnedDrafter,
+                                                             MedusaDraftHead)
+                if scfg.draft_head_path:
+                    head = MedusaDraftHead.load(scfg.draft_head_path)
+                else:
+                    mcfg = engine.model.config
+                    head = MedusaDraftHead.fresh(mcfg.hidden_size,
+                                                 mcfg.vocab_size,
+                                                 num_heads=scfg.num_draft_heads)
+                self._learned = LearnedDrafter(head, width=scfg.tree_width,
+                                               node_budget=scfg.tree_node_budget)
+                self._spec_head_id = head.head_id
 
         # tiered KV memory (serving/kv_tiers.py over ragged/tiering.py):
         # retrofits the engine's host→disk ladder with the operator's budget
@@ -247,7 +286,8 @@ class ServingScheduler:
                parent_span_id: Optional[int] = None,
                handoff: bool = False,
                priority: Optional[str] = None,
-               park: bool = False) -> Request:
+               park: bool = False,
+               drafter: Optional[str] = None) -> Request:
         """Enqueue a generation request (any thread). Returns the live
         :class:`Request`; stream tokens from ``request.stream`` or block on
         ``request.result()``. Backpressure per ``config.backpressure``:
@@ -266,7 +306,15 @@ class ServingScheduler:
         continuable multi-turn session: at finish (length OR eos) the engine
         state exports as a v2 *park frame* (``request.park_payload``) the
         fleet park store holds until the session returns — rehydrated via
-        :meth:`submit_resume` with the next turn's full prompt."""
+        :meth:`submit_resume` with the next turn's full prompt.
+
+        ``drafter`` pins THIS request's speculative drafter
+        (``prompt_lookup`` | ``learned`` | ``auto``), overriding the
+        scheduler's ``SpeculativeConfig.drafter`` arbitration — the loadgen's
+        per-request A/B lever. A pin the scheduler can't honor (``learned``
+        without a loaded draft head, or any pin on a linear prompt_lookup
+        scheduler) is ignored, never an error: output is drafter-independent
+        by the bitwise-identity invariant."""
         req = Request(prompt,
                       max_new_tokens=max_new_tokens if max_new_tokens is not None
                       else self._config.default_max_new_tokens,
@@ -277,6 +325,7 @@ class ServingScheduler:
                       seed=seed,
                       priority=validate_priority(priority))
         req.park_requested = bool(park)
+        req._spec_drafter_pin = _validate_drafter_pin(drafter)
         self._admission_gate(req)
         return self._enqueue(req, trace_id, parent_span_id, handoff)
 
@@ -292,7 +341,8 @@ class ServingScheduler:
                       handoff: bool = False,
                       priority: Optional[str] = None,
                       prompt=None,
-                      park: bool = False) -> Request:
+                      park: bool = False,
+                      drafter: Optional[str] = None) -> Request:
         """Admit a handed-off sequence for decode continuation: ``payload`` is
         an ``engine.export_sequence`` product from a prefill-role peer. The
         scheduler imports it into its engine at admission (on the scheduler
@@ -348,6 +398,7 @@ class ServingScheduler:
         req._resume_header = header
         req._rehydrate = prompt is not None
         req.park_requested = bool(park)
+        req._spec_drafter_pin = _validate_drafter_pin(drafter)
         self._admission_gate(req)  # after the header lands: resume work is
         # its generation budget (plus a rehydrate's un-parked suffix) only,
         # the donor already paid the parked turns' prefill
@@ -371,6 +422,16 @@ class ServingScheduler:
             req._spec_ewma = float(ewma) if ewma is not None else None
             req.spec_drafted = int(spec.get("drafted") or 0)
             req.spec_accepted = int(spec.get("accepted") or 0)
+            drafters = spec.get("drafters")
+            if drafters:
+                donor_head = spec.get("head_id")
+                for name, val in drafters.items():
+                    if name == "learned" and donor_head is not None \
+                            and donor_head != self._spec_head_id:
+                        # a different head's acceptance record says nothing
+                        # about ours: the learned drafter re-explores cold
+                        continue
+                    req._spec_ewmas[str(name)] = float(val)
         return self._enqueue(req, trace_id, parent_span_id, handoff)
 
     def _enqueue(self, req: Request, trace_id: Optional[str],
@@ -1173,6 +1234,92 @@ class ServingScheduler:
             digests = req._prefix_digests
         return self._drafter.draft(history, k, digests=digests)
 
+    def _pick_drafter(self, req: Request) -> str:
+        """Which drafter builds this request's feed this step. ``auto``
+        arbitrates on per-request per-drafter acceptance EWMAs: cold drafters
+        explore first (learned before lookup — it needs a step to capture its
+        hidden state anyway), then the higher EWMA wins, with the loser
+        probed every ``probe_interval`` decode steps so arbitration can
+        reverse when the text regime changes mid-stream. A per-request pin
+        (``submit(drafter=...)``) overrides both, when honorable: a
+        ``learned`` pin needs a loaded draft head."""
+        pin = req._spec_drafter_pin
+        if pin is not None and pin != "auto" and \
+                (pin != "learned" or self._learned is not None):
+            return pin
+        mode = self._drafter_mode
+        if mode != "auto":
+            return mode
+        ew = req._spec_ewmas
+        learned, lookup = ew.get("learned"), ew.get("prompt_lookup")
+        if learned is None:
+            return "learned"
+        if lookup is None:
+            return "prompt_lookup"
+        winner, loser = (("learned", "prompt_lookup") if learned >= lookup
+                         else ("prompt_lookup", "learned"))
+        if req.decode_steps and \
+                req.decode_steps % self._config.speculative.probe_interval == 0:
+            return loser  # periodic probe: the loser gets a round to recover
+        return winner
+
+    def _arb_update(self, req: Request, name: str, rate: float) -> None:
+        """Fold one step's depth-productivity ``rate`` into the arbitration
+        EWMAs: the request's (what ``auto`` decides on) and the scheduler's
+        (the per-drafter gauge). A picked drafter that proposes NOTHING
+        scores 0 here — otherwise "auto" wedges on a drafter that never
+        proposes and therefore never gets measured — while ``req._spec_ewma``
+        keeps the linear-path rule that an empty draft is not rejection."""
+        alpha = self._config.speculative.accept_alpha
+        prev = req._spec_ewmas.get(name)
+        req._spec_ewmas[name] = (rate if prev is None
+                                 else alpha * rate + (1 - alpha) * prev)
+        sprev = self._spec_drafter_ewmas.get(name)
+        self._spec_drafter_ewmas[name] = (rate if sprev is None
+                                          else alpha * rate + (1 - alpha) * sprev)
+        if self._metrics:
+            gauge = (self._metrics.spec_drafter_learned_ewma if name == "learned"
+                     else self._metrics.spec_drafter_lookup_ewma)
+            gauge.set(self._spec_drafter_ewmas[name])
+
+    def _draft_tree_for(self, req: Request, k: int, room: int):
+        """A :class:`TokenTree` feed for the learned/auto modes (always
+        non-None: every decode entry in tree mode feeds a tree, so one
+        ``verify_tree`` dispatch carries the whole tick). ``k`` caps draft
+        DEPTH, ``room`` caps draft NODES (root excluded) under the ragged
+        token budget. A prompt-lookup draft rides as a chain tree — bitwise
+        the linear verify program's output — and a learned draft without a
+        valid hidden state bootstraps with a root-only tree whose verify
+        returns the hidden state the next step drafts from."""
+        from deepspeed_tpu.inference.v2.spec import TokenTree
+        scfg = self._config.speculative
+        name = self._pick_drafter(req)
+        if name != req._spec_last_drafter:
+            if req._spec_last_drafter is not None:
+                self._counters["spec_drafter_switches"] += 1
+                if self._metrics:
+                    self._metrics.spec_drafter_switches.inc()
+            req._spec_last_drafter = name
+        root = np.asarray([req._next], np.int32)
+        room = min(room, scfg.tree_node_budget - 1)
+        if k <= 0 or room <= 0:
+            return TokenTree.chain(root)
+        if name == "prompt_lookup":
+            draft = self._draft_for(req, min(k, room))
+            if draft.size == 0:
+                self._arb_update(req, name, 0.0)  # no n-gram match: scored 0
+                return TokenTree.chain(root)
+            return TokenTree.chain(np.concatenate([root, draft]))
+        hist = int(req.prompt.size) + len(req.tokens)
+        if req._spec_hidden is None or req._spec_hidden_pos != hist:
+            return TokenTree.chain(root)  # bootstrap: capture hidden first
+        tree = self._learned.draft_tree(req._spec_hidden, int(req._next), k,
+                                        node_budget=room + 1)
+        if tree is None:
+            self._arb_update(req, name, 0.0)  # nothing fit the node budget
+            return TokenTree.chain(root)
+        return tree
+
     def _spec_accept(self, req: Request, feed: np.ndarray, rows: np.ndarray):
         """The acceptance rule over one verify feed. ``rows[j]`` scores the
         token after ``feed[:j+1]``; the emitted sequence is EXACTLY what
@@ -1279,16 +1426,25 @@ class ServingScheduler:
                 self._finalize(req, RequestState.DONE)
                 continue
             feed = None
+            tree = None
+            req._spec_tree = None
             if draft_budget > 0:
                 # draft tokens compete with prefill chunks under the same
                 # ragged token budget; never draft past the generation cap or
                 # the context window (the device commits every fed position)
-                k = min(self._spec_k(req), draft_budget,
-                        budget - sum(lens) - 1,
-                        req.max_new_tokens - len(req.tokens) - 1)
+                room = min(draft_budget, budget - sum(lens) - 1,
+                           req.max_new_tokens - len(req.tokens) - 1)
                 if seq is not None:
-                    k = min(k, sm_cfg.max_context - seq.seen_tokens - 1)
-                if k > 0:
+                    room = min(room, sm_cfg.max_context - seq.seen_tokens - 1)
+                k = min(self._spec_k(req), room)
+                if self._drafter_mode != "prompt_lookup":
+                    # learned/auto: every decode entry feeds a TokenTree so
+                    # ONE verify_tree dispatch carries the tick (a root-only
+                    # tree when nothing drafts — its verify still returns the
+                    # hidden state the learned drafter reads next step)
+                    tree = self._draft_tree_for(req, k, room)
+                    feed = tree.tokens
+                elif k > 0:
                     draft = self._draft_for(req, k)
                     if draft.size:
                         feed = np.concatenate(
@@ -1298,10 +1454,17 @@ class ServingScheduler:
                 # drafts are speculative: they never trigger eviction — a feed
                 # the pool can't take falls back to the k=0 single token below
                 req._deferred = 0
+                req._spec_tree = tree
                 admit(req, feed)
                 draft_budget -= int(feed.size) - 1
             elif admit_under_pressure(req, 1):
                 req._deferred = 0
+                if tree is not None:
+                    # tree mode under pressure: a root-only tree keeps the
+                    # tick on one verify_tree dispatch (same 1-token cost)
+                    from deepspeed_tpu.inference.v2.spec import TokenTree
+                    req._spec_tree = TokenTree.chain(
+                        np.asarray([req._next], np.int32))
                 admit(req, [req._next])
             else:
                 req._deferred += 1  # KV held by in-flight work; retry next tick
@@ -1396,6 +1559,12 @@ class ServingScheduler:
                              args={"uid": req.uid,
                                    "tokens": ntok if counts is None else counts[i]})
 
+        # tree-verify (learned/auto drafters): any decode entry carrying a
+        # TokenTree — root-only trees included — routes the tick through ONE
+        # engine.verify_tree dispatch
+        if any(req._spec_tree is not None for req, _ in plan):
+            self._execute_verify_tree(plan, _record_phase_spans)
+            return
         # speculative verify: any decode feed wider than one token (next
         # input + draft tokens) routes the tick through the verify path
         if any(req.state is RequestState.DECODE and toks.size > 1
@@ -1573,6 +1742,152 @@ class ServingScheduler:
         for i, (req, toks) in enumerate(prefill_plan):
             self._advance_prefill(req, toks, prefill_logits[i])
 
+    def _spec_accept_tree(self, req: Request, tree, rows, ids):
+        """The acceptance rule over one verified token tree. Walk from the
+        root: each emitted token is sampled (or argmaxed) from the target
+        distribution with the request's own stream — one draw per emitted
+        token, same draw order as spec-off — then the walk descends into the
+        child CARRYING that token while one exists (rejection sampling with a
+        point-mass draft at each branch). The deepest matching path is
+        accepted; the first disagreement's sampled token is the bonus
+        emission. Returns ``(emitted, path, last_node)``: ``path`` lists the
+        accepted draft node indices (root-exclusive, the compaction input)
+        and ``last_node`` is the deepest CONSUMED node, whose hidden state
+        seeds the next learned draft. Emission stops at eos / the generation
+        cap, mirroring :meth:`_push_token`'s rules."""
+        emitted: List[int] = []
+        path: List[int] = []
+        node = 0
+        while True:
+            tok = (int(ids[node]) if rows is None
+                   else self._sample(req, rows[node]))
+            emitted.append(tok)
+            if req.eos_token_id is not None and tok == req.eos_token_id:
+                break
+            if len(req.tokens) + len(emitted) >= req.max_new_tokens:
+                break
+            child = tree.child_with_token(node, tok)
+            if child is None:
+                break  # rejection: the target disagrees with every branch
+            path.append(child)
+            node = child
+        return emitted, path, node
+
+    def _execute_verify_tree(self, plan: List[Tuple[Request, np.ndarray]],
+                             record_spans) -> None:
+        """Execute a tick whose decode entries carry TokenTree feeds (the
+        learned/auto drafter modes). Every tree — branching, chain, or
+        root-only — verifies in ONE ``engine.verify_tree`` dispatch; prefill
+        chunks sharing the tick keep their normal ``engine.put`` (same split
+        as :meth:`_execute_verify`, same reason). Each entry accepts its
+        deepest matching path under the spec-off sampling rule, compacts the
+        accepted path's KV left behind the committed history (tree-aware
+        write-then-truncate) and streams the emitted run; the deepest
+        consumed node's hidden state is captured for the next learned
+        draft."""
+        engine = self._engine
+        decode_plan = [(req, toks) for req, toks in plan
+                       if req.state is not RequestState.PREFILL]
+        prefill_plan = [(req, toks) for req, toks in plan
+                        if req.state is RequestState.PREFILL]
+        trees = []
+        for req, toks in decode_plan:
+            tree = req._spec_tree
+            req._spec_tree = None
+            if tree is None:  # defensive: a plain feed rides as a chain
+                from deepspeed_tpu.inference.v2.spec import TokenTree
+                tree = TokenTree.chain(toks)
+            trees.append(tree)
+        # the device-argmax program only when EVERY decode entry is greedy: a
+        # sampled request needs the full rows for its private stream (greedy
+        # peers argmax the same f32 rows host-side — the identical result)
+        greedy = all(req.temperature <= 0.0 for req, _ in decode_plan)
+        try:
+            per_seq = engine.verify_tree([req.uid for req, _ in decode_plan],
+                                         trees, greedy=greedy)
+            prefill_logits = (np.asarray(engine.put(
+                [req.uid for req, _ in prefill_plan],
+                [toks for _, toks in prefill_plan])) if prefill_plan else None)
+        except Exception as e:  # pragma: no cover - defensive: same contract
+            # as the put path — the scheduler thread must survive
+            logger.exception("serving: tree-verify tick failed; failing the batch")
+            for req, _ in plan:
+                self._finalize(req, RequestState.FAILED, error=f"engine error: {e}")
+            return
+        # verify feeds cost their full width (accepted or not), like any fed
+        # token — tree nodes included
+        self._rate.observe(sum(int(t.size) for _, t in plan))
+        alpha = self._config.speculative.accept_alpha
+        # sample/accept BEFORE any push: span token counts must be final when
+        # the root span closes, and each request's private stream makes the
+        # per-request draw order independent of processing order
+        accepts = {id(req): self._spec_accept_tree(req, tree,
+                                                   res["rows"], res["ids"])
+                   for (req, _), tree, res in zip(decode_plan, trees, per_seq)}
+        record_spans(counts=[len(accepts[id(req)][0]) if id(req) in accepts
+                             else int(toks.size) for req, toks in plan])
+        for (req, toks), tree, res in zip(decode_plan, trees, per_seq):
+            emitted, path, last_node = accepts[id(req)]
+            k = tree.size - 1  # draft nodes proposed (the root is the input)
+            accepted = len(path)
+            # compact BEFORE pushing (a push may finalize, and the handoff
+            # export / trie publish there must see the truncated seen_tokens):
+            # accepted-path KV moves contiguously behind the committed
+            # history, the rejected remainder truncates off — the same
+            # full-history-minus-1 invariant every other path leaves behind
+            rejected = engine.compact_accepted(req.uid, tree.size, path)
+            req.decode_steps += 1
+            # the hidden state behind the next decode input is the deepest
+            # CONSUMED node's residual; _spec_hidden_pos stamps the history
+            # length it is valid at (stale after any gap: handoff, brownout)
+            hidden = res.get("hidden")
+            if hidden is not None:
+                req._spec_hidden = np.asarray(hidden[last_node], np.float32)
+                req._spec_hidden_pos = (int(req.prompt.size) + len(req.tokens)
+                                        + len(emitted))
+            self._counters["spec_tree_nodes"] += tree.size
+            compacted = any(p != j + 1 for j, p in enumerate(path))
+            if compacted:
+                self._counters["spec_tree_compactions"] += 1
+            if self._metrics:
+                self._metrics.spec_tree_nodes.inc(tree.size)
+                if compacted:
+                    self._metrics.spec_tree_compactions.inc()
+            if k:
+                # a root-only bootstrap proposed nothing — no acceptance
+                # evidence, no EWMA movement (linear-path rule, tree-shaped)
+                drafter = req._spec_last_drafter or self._drafter_mode
+                short = "learned" if drafter == "learned" else "lookup"
+                # the arbitration/adaptation signal is DEPTH productivity:
+                # accepted serial depth over proposed depth — comparable
+                # across a branching tree and a linear chain at the same k
+                rate = accepted / max(int(tree.max_depth), 1)
+                req.spec_drafted += k
+                req.spec_accepted += accepted
+                self._counters["spec_steps"] += 1
+                self._counters["spec_drafted"] += k
+                self._counters["spec_rollback"] += rejected
+                self._counters["spec_accepted"] += accepted
+                self._counters[f"spec_drafted_{short}"] += k
+                self._counters[f"spec_accepted_{short}"] += accepted
+                req._spec_ewma = (rate if req._spec_ewma is None
+                                  else alpha * rate + (1 - alpha) * req._spec_ewma)
+                self._arb_update(req, drafter, rate)
+                self._spec_accept_ewma = (rate if self._spec_accept_ewma is None
+                                          else alpha * rate
+                                          + (1 - alpha) * self._spec_accept_ewma)
+                if self._metrics:
+                    self._metrics.spec_verify_steps.inc()
+                    self._metrics.spec_drafted.inc(k)
+                    self._metrics.spec_accepted.inc(accepted)
+                    self._metrics.spec_rollback.inc(rejected)
+                    self._metrics.spec_accept_rate.set(self._spec_accept_ewma or 0.0)
+                    self._metrics.spec_tokens_per_step.observe(len(emitted))
+                    self._metrics.spec_tree_accept_depth.observe(accepted)
+            self._push_burst(req, emitted)
+        for i, (req, toks) in enumerate(prefill_plan):
+            self._advance_prefill(req, toks, prefill_logits[i])
+
     @staticmethod
     def _kept_tokens(req: Request, row) -> int:
         """How many of a decode-loop ``row``'s tokens the client will receive
@@ -1644,6 +1959,16 @@ class ServingScheduler:
             extra["spec"] = {"accept_ewma": req._spec_ewma,
                              "drafted": req.spec_drafted,
                              "accepted": req.spec_accepted}
+            if req._spec_ewmas:
+                # per-drafter EWMAs: an "auto" peer resumes the arbitration
+                # mid-race instead of re-exploring both drafters cold
+                extra["spec"]["drafters"] = {
+                    name: val for name, val in req._spec_ewmas.items()
+                    if val is not None}
+            if self._spec_head_id is not None:
+                # which trained heads produced the learned EWMA: a peer with
+                # different heads must not inherit their acceptance record
+                extra["spec"]["head_id"] = self._spec_head_id
         tokens = [int(t) for t in req.prompt.tolist()] + [int(t) for t in req.tokens]
         # chunked greedy decode feeds the device ahead of the kept history (a
         # mid-chunk cap leaves the last kept token — and discarded over-run —
@@ -1935,8 +2260,9 @@ class ServingScheduler:
         if self._drafter is None:
             return None
         drafted = self._counters["spec_drafted"]
-        return {
+        out = {
             "enabled": True,
+            "drafter": self._drafter_mode,
             "drafted": drafted,
             "accepted": self._counters["spec_accepted"],
             "accept_rate": (self._counters["spec_accepted"] / drafted
@@ -1946,6 +2272,23 @@ class ServingScheduler:
             "rollback_tokens": self._counters["spec_rollback"],
             "max_draft_tokens": self._config.speculative.max_draft_tokens,
         }
+        if self._drafter_mode != "prompt_lookup":
+            scfg = self._config.speculative
+            out["head_id"] = self._spec_head_id
+            out["tree"] = {
+                "nodes": self._counters["spec_tree_nodes"],
+                "compactions": self._counters["spec_tree_compactions"],
+                "width": scfg.tree_width,
+                "node_budget": scfg.tree_node_budget,
+            }
+            out["drafter_switches"] = self._counters["spec_drafter_switches"]
+            out["drafters"] = {
+                name: {"drafted": self._counters[f"spec_drafted_{short}"],
+                       "accepted": self._counters[f"spec_accepted_{short}"],
+                       "ewma": self._spec_drafter_ewmas.get(name)}
+                for name, short in (("learned", "learned"),
+                                    ("prompt_lookup", "lookup"))}
+        return out
 
     def stats(self) -> dict:
         queued, active = self._snapshot_requests()

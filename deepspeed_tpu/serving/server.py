@@ -240,9 +240,12 @@ def _request_doc(req: Request, raw_handoff: bool = False,
     if req.spec_drafted:
         # speculative decoding rode this request: drafted/accepted let a
         # client (and the loadgen --spec-demo report) compute acceptance rate
-        # and tokens-per-step without scraping /v1/stats
+        # and tokens-per-step without scraping /v1/stats; "drafter" is which
+        # drafter family served the request (last one used, under auto
+        # arbitration) so the loadgen report can split effectiveness by it
         doc["spec"] = {"drafted": req.spec_drafted,
-                       "accepted": req.spec_accepted}
+                       "accepted": req.spec_accepted,
+                       "drafter": req._spec_last_drafter or "prompt_lookup"}
     if req.degraded_mode:
         # brownout degradations applied to THIS request — never silent
         doc["degraded_mode"] = list(req.degraded_mode)
@@ -510,7 +513,8 @@ class ServingServer:
                                   parent_span_id=parent_span_id,
                                   handoff=bool(doc.get("handoff")),
                                   park=bool(doc.get("park")),
-                                  priority=request_priority(self, doc))
+                                  priority=request_priority(self, doc),
+                                  drafter=doc.get("drafter"))
                     if path == "/v1/resume":
                         # a resume body MAY carry a prompt: the rehydrate form
                         # (parked session returning with its next turn)

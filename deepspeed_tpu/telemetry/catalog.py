@@ -82,6 +82,12 @@ METRIC_FAMILIES = {
     "serving_spec_rollback_tokens_total": "rejected draft positions truncated from committed KV",
     "serving_spec_accept_rate": "EWMA of the speculative acceptance rate across verify steps",
     "serving_spec_tokens_per_step": "tokens emitted per speculative verify step (1 = nothing accepted)",
+    "serving_spec_tree_nodes_total": "token-tree nodes fed through verify_tree dispatches (root included)",
+    "serving_spec_tree_accept_depth": "accepted path depth per tree-verify step (0 = root only survived)",
+    "serving_spec_tree_compactions_total": "tree-verify steps whose accepted path needed a KV gather-compact",
+    "serving_spec_drafter_switches_total": "per-request drafter changes decided by the auto arbitration",
+    "serving_spec_drafter_learned_ewma": "EWMA of the learned drafter's accepted-depth rate across requests",
+    "serving_spec_drafter_lookup_ewma": "EWMA of the prompt-lookup drafter's accepted-depth rate across requests",
     # tiered KV memory (serving/metrics.py over inference/v2/ragged/tiering.py
     # and serving/kv_tiers.py)
     "serving_kv_tier_demotions_total": "KV payloads demoted down the tier ladder (device pressure and host-to-disk writeback)",

@@ -76,6 +76,30 @@ def test_spec_verify_step_costs_one_forward(built_results):
     assert result.stats.f32_dot_count == 0
 
 
+def test_spec_tree_verify_costs_bounded_multiple_of_one_forward(built_results):
+    built, result = built_results["spec_tree_verify"]
+    single = stats_from_lowered(built.comparisons["single_token_forward"],
+                                name="single_token_forward")
+    linear = stats_from_lowered(built.comparisons["linear_verify"],
+                                name="linear_verify")
+    n = built.meta["tree_nodes"]
+    # the tree-speculation claim, chip-independently: verifying a whole
+    # draft tree (root + branches, tree-attention mask, per-query virtual
+    # KV) is a BUDGETED multiple of one single-token forward at the same
+    # bucket — the mask/gather overhead is priced, not silent — and nowhere
+    # near node-count sequential decode steps
+    assert result.stats.flops <= 1.5 * single.flops, \
+        (result.stats.flops, single.flops)
+    assert result.stats.flops < 0.25 * n * single.flops
+    # same weight class as the linear verify program despite the tree mask
+    assert result.stats.flops <= 1.5 * linear.flops
+    # the greedy transfer win: per-node ids + hidden states cross the host
+    # boundary, never a [T, vocab] f32 logits block
+    assert result.stats.output_bytes < linear.output_bytes, \
+        (result.stats.output_bytes, linear.output_bytes)
+    assert result.stats.f32_dot_count == 0
+
+
 def test_int4_decode_matmul_beats_bf16_weight_bytes(built_results):
     built, result = built_results["int4_decode_matmul"]
     bf16 = stats_from_lowered(built.comparisons["bf16_forward"], name="bf16_forward")

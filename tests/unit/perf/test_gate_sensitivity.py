@@ -85,3 +85,19 @@ def test_doubling_collective_payload_trips_byte_budget(mesh8):
     tripped = [v.metric for v in violations]
     assert any(m.endswith(".bytes") or m == "collective_bytes_total"
                for m in tripped), f"tripped only: {tripped}"
+
+
+def test_widening_the_draft_tree_trips_tree_verify_flops_budget():
+    """The spec_tree_verify budget is pinned to the smallest decode bucket:
+    a tree that outgrows it (wider/deeper than the node budget the baseline
+    shipped with) pads into the next token bucket, and the extra attention +
+    unembed work must trip the flops ratchet — the gate proves the budgeted
+    'tree costs one forward' claim is falsifiable, not vacuous."""
+    from deepspeed_tpu.perf.programs import build_v2_engine
+
+    engine, _ = build_v2_engine()
+    wide = stats_from_lowered(engine.lower_tree_verify(bucket=(16, 8, 4),
+                                                       greedy=True),
+                              name="spec_tree_verify")
+    tripped = [v.metric for v in gate.check_program("spec_tree_verify", wide)]
+    assert "flops" in tripped, f"tripped only: {tripped}"

@@ -108,6 +108,76 @@ def test_loadgen_spec_demo_reports_acceptance(make_engine, llama_setup):
         srv.stop(drain=False)
 
 
+def test_loadgen_drafter_pin_and_split_report(make_engine, llama_setup, tmp_path):
+    """--drafter prompt_lookup against an auto-mode server: the pin rides the
+    request doc, the server reports which drafter served each request, and
+    the report gains the per-drafter split plus a --json doc dstpu_report
+    renders as the comparison table."""
+    import json
+
+    from deepspeed_tpu.env_report import spec_report
+
+    cfg, _, _ = llama_setup
+    sched = ServingScheduler(
+        make_engine(block_size=4),
+        ServingConfig(prefix_cache=PrefixCacheConfig(enabled=True),
+                      speculative=SpeculativeConfig(enabled=True, drafter="auto",
+                                                    max_draft_tokens=4)))
+    srv = ServingServer(sched).start()
+    out = tmp_path / "spec.json"
+    try:
+        r = _loadgen("--url", srv.url, "--requests", "6", "--mode", "closed",
+                     "--concurrency", "1", "--spec-demo", "16:2",
+                     "--drafter", "prompt_lookup", "--max-new-tokens", "10",
+                     "--json", str(out), "--vocab-size", str(cfg.vocab_size))
+        assert r.returncode == 0, r.stderr[-800:]
+        assert "ok=6 err=0" in r.stdout
+        # pinned: every request reports the prompt_lookup family, and the
+        # repetitive workload still speculates (the pin didn't disable it)
+        assert "# drafter[prompt_lookup]:" in r.stdout, r.stdout
+        assert "# drafter[learned]:" not in r.stdout, r.stdout
+        accepted = int(r.stdout.split("# drafter[prompt_lookup]: accept_rate=")
+                       [1].split("(")[1].split("/")[0])
+        assert accepted > 0
+        doc = json.loads(out.read_text())
+        assert doc["workload"]["drafter_pin"] == "prompt_lookup"
+        assert doc["drafters"]["prompt_lookup"]["accepted"] == accepted
+        assert spec_report(str(out)) == 0
+    finally:
+        srv.stop(drain=False)
+
+
+def test_loadgen_drafter_arg_validation():
+    r = _loadgen("--url", "http://127.0.0.1:1", "--requests", "1",
+                 "--drafter", "medusa")
+    assert r.returncode == 2
+    assert "--drafter" in r.stderr
+
+
+def test_report_spec_renders_drafter_comparison(tmp_path, capsys):
+    from deepspeed_tpu.env_report import spec_report
+    doc = {"workload": {"spec_demo": [16, 2], "drafter_pin": None,
+                        "requests": 8, "ok": 8},
+           "overall": {"drafted": 30, "accepted": 20, "tokens_per_step": 2.1},
+           "drafters": {
+               "prompt_lookup": {"requests": 4, "drafted": 12, "accepted": 2,
+                                 "accept_rate": 0.17, "tokens_per_step": 1.2,
+                                 "itl": {"50": 0.004, "90": 0.006, "99": 0.008}},
+               "learned": {"requests": 4, "drafted": 18, "accepted": 18,
+                           "accept_rate": 1.0, "tokens_per_step": 3.3,
+                           "itl": {"50": 0.002, "90": 0.003, "99": 0.004}}}}
+    path = tmp_path / "spec.json"
+    path.write_text(__import__("json").dumps(doc))
+    assert spec_report(str(path)) == 0
+    text = capsys.readouterr().out
+    assert "prompt_lookup" in text and "learned" in text
+    assert "<- best" in text and "best tokens/step: learned" in text
+
+    bad = tmp_path / "empty.json"
+    bad.write_text("{}")
+    assert spec_report(str(bad)) == 2
+
+
 def test_loadgen_shared_prefix_arg_validation():
     r = _loadgen("--url", "http://127.0.0.1:1", "--requests", "1",
                  "--shared-prefix", "0:2")
