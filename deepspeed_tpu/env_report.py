@@ -688,6 +688,156 @@ def spec_report(path):
     return 0
 
 
+def _load_usage_doc(src):
+    """A ``--usage`` operand is either a saved JSON file (a ``/v1/usage`` /
+    ``/v1/fleet/usage`` / ``/v1/stats`` doc, or a ``bin/dstpu_loadgen
+    --tenants --json`` file) or a live address: ``/v1/usage`` is tried first
+    (single replica; the ``perf`` join rides along from ``/v1/stats``), then
+    the router's ``/v1/fleet/usage``."""
+    import json
+    import os
+    import urllib.request
+
+    if os.path.isfile(src):
+        with open(src) as f:
+            return json.load(f)
+    base = src if src.startswith(("http://", "https://")) else "http://" + src
+    base = base.rstrip("/")
+    if base.endswith(("/v1/usage", "/v1/fleet/usage", "/v1/stats")):
+        urls = [base]
+    else:
+        urls = [base + "/v1/usage", base + "/v1/fleet/usage"]
+    last = None
+    for url in urls:
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                doc = json.loads(resp.read().decode())
+        except Exception as e:
+            last = e
+            continue
+        if url.endswith("/v1/usage") and "perf" not in doc:
+            stats_url = url[: -len("/v1/usage")] + "/v1/stats"
+            try:
+                with urllib.request.urlopen(stats_url, timeout=5) as resp:
+                    doc["perf"] = json.loads(resp.read().decode()).get("perf")
+            except Exception:
+                pass
+        return doc
+    raise last if last is not None else RuntimeError("no usage doc")
+
+
+def _render_ledger_tenants(tenants):
+    """The cost-ledger tenant table (``/v1/usage`` / ``/v1/fleet/usage``
+    shape: nested token/kv/wire accumulators per tenant)."""
+    print(f"{'tenant':<14} {'reqs':>5} {'billed_tok':>10} {'device_s':>9} "
+          f"{'kv_blk_s':>9} {'wire_B':>10} {'saved_tok':>9}")
+    for name in sorted(tenants, key=lambda n: -(tenants[n].get("tokens") or
+                                                {}).get("billed", 0)):
+        row = tenants[name]
+        tokens = row.get("tokens") or {}
+        saved = row.get("saved_tokens") or {}
+        print(f"{name:<14} {row.get('requests', 0):>5} "
+              f"{tokens.get('billed', 0):>10} "
+              f"{row.get('device_seconds', 0.0):>9.3f} "
+              f"{sum((row.get('kv_block_seconds') or {}).values()):>9.2f} "
+              f"{sum((row.get('wire_bytes') or {}).values()):>10} "
+              f"{sum(saved.values()):>9}")
+
+
+def _render_loadgen_tenants(tenants):
+    """The client-side tenant table (``bin/dstpu_loadgen --tenants --json``
+    shape: offered/ok/shed counts, goodput, TTFT percentiles)."""
+    print(f"{'tenant':<14} {'reqs':>5} {'ok':>5} {'shed':>5} "
+          f"{'goodput':>9} {'ttft_p50':>10} {'ttft_p99':>10}")
+
+    def _ms(row, pct):
+        v = (row.get("ttft_ms") or {}).get(pct)
+        return f"{v:>8.1f}ms" if isinstance(v, (int, float)) else f"{'—':>10}"
+
+    for name in sorted(tenants, key=lambda n: -tenants[n].get("requests", 0)):
+        row = tenants[name]
+        print(f"{name:<14} {row.get('requests', 0):>5} {row.get('ok', 0):>5} "
+              f"{row.get('shed', 0):>5} "
+              f"{row.get('goodput_req_s', 0.0):>7.2f}/s "
+              f"{_ms(row, 'p50')} {_ms(row, 'p99')}")
+
+
+def _render_perf_join(perf):
+    """The predicted-vs-observed table: one row per (program, bucket) the
+    engine dispatched, joined live against the roofline prediction. A ratio
+    near 1 means the analytic model holds; sustained drift raised the
+    ``perf_drift_events_total`` rows shown in the last column."""
+    rows = (perf or {}).get("programs") or []
+    if not rows:
+        print("predicted-vs-observed .. no dispatches observed yet")
+        return
+    print(f"predicted-vs-observed .. chip={perf.get('chip', '?')} "
+          f"drift_factor={perf.get('drift_factor', '?')}")
+    print(f"{'program':<24} {'bucket':>8} {'disp':>6} {'pred':>10} "
+          f"{'obs_p50':>10} {'ratio':>7} {'drift':>6}")
+    def _ms(v):
+        return (f"{v * 1e3:>8.2f}ms" if isinstance(v, (int, float)) and v == v
+                else f"{'—':>10}")
+
+    for row in sorted(rows, key=lambda r: (r.get("program", ""),
+                                           r.get("bucket", 0))):
+        ratio = row.get("ratio")
+        print(f"{row.get('program', '?'):<24} {row.get('bucket', 0):>8} "
+              f"{row.get('dispatches', 0):>6} "
+              f"{_ms(row.get('predicted_s'))} {_ms(row.get('observed_p50_s'))} "
+              + (f"{ratio:>7.2f}" if isinstance(ratio, (int, float))
+                 else f"{'—':>7}")
+              + f" {row.get('drift_events', 0):>6}")
+
+
+def usage_report(src):
+    """``dstpu_report --usage <file | host:port>``: tenant cost-attribution
+    tables plus the predicted-vs-observed perf join. The operand is a live
+    replica/router address, a saved ``/v1/usage`` / ``/v1/fleet/usage`` /
+    ``/v1/stats`` doc, or a ``bin/dstpu_loadgen --tenants --json`` file."""
+    try:
+        doc = _load_usage_doc(src)
+    except Exception as e:
+        print(f"cannot load usage doc from {src}: {e}")
+        return 2
+    if not isinstance(doc, dict):
+        print(f"{src}: not a usage doc")
+        return 2
+    perf = doc.get("perf")
+    if isinstance(doc.get("usage"), dict):  # a /v1/stats doc
+        doc = doc["usage"]
+    print("-" * 78)
+    print(f"cost attribution ....... {src}")
+    print("-" * 78)
+    if doc.get("enabled") is False:
+        print("cost ledger disabled (run the server with telemetry active "
+              "and ServingConfig.cost.enabled)")
+        return 0
+    totals = doc.get("totals")
+    if isinstance(totals, dict):
+        tokens = totals.get("tokens") or {}
+        print(f"totals ................. requests={totals.get('requests', 0)} "
+              f"billed_tokens={tokens.get('billed', 0)} "
+              f"device_s={totals.get('device_seconds', 0.0):.3f} "
+              f"dispatches={totals.get('dispatches', 0)}")
+    tenants = doc.get("tenants") or {}
+    if not tenants:
+        print("no tenant rows yet")
+    elif any("goodput_req_s" in row for row in tenants.values()):
+        _render_loadgen_tenants(tenants)
+    else:
+        _render_ledger_tenants(tenants)
+    if isinstance(doc.get("fair_share"), dict):
+        fs = doc["fair_share"]
+        print(f"fair share ............. sheds={fs.get('sheds', 0)} "
+              f"tenants={len(fs.get('tenants') or ())}")
+    if perf is not None:
+        print("-" * 78)
+        _render_perf_join(perf)
+    print("-" * 78)
+    return 0
+
+
 def main(argv=None):
     argv = list(sys.argv[1:]) if argv is None else list(argv)
     if "--spec" in argv:
@@ -746,6 +896,12 @@ def main(argv=None):
             print("usage: dstpu_report --timeseries <timeseries.json | host:port>")
             return 2
         return timeseries_report(argv[idx + 1])
+    if "--usage" in argv:
+        idx = argv.index("--usage")
+        if idx + 1 >= len(argv):
+            print("usage: dstpu_report --usage <usage.json | host:port>")
+            return 2
+        return usage_report(argv[idx + 1])
     if "--kv" in argv:
         idx = argv.index("--kv")
         if idx + 1 >= len(argv):

@@ -419,6 +419,11 @@ class LocalReplica(Replica):
             # fleet time-series rollup rides the probe doc (bounded: the
             # windowed summary, not the full retention)
             doc["timeseries"] = ts.snapshot(max_points=64)
+        usage = sched.usage()
+        if usage.get("enabled"):
+            # per-tenant cost rollup rides the probe doc (bounded by the
+            # ledger's max_tenants cap) — /v1/fleet/usage aggregates these
+            doc["usage"] = usage
         return doc
 
     @property
@@ -445,7 +450,8 @@ class LocalReplica(Replica):
                       trace_id=trace_id, parent_span_id=parent_span_id,
                       handoff=bool(doc.get("handoff")),
                       park=bool(doc.get("park")),
-                      priority=doc.get("priority"))
+                      priority=doc.get("priority"),
+                      tenant=doc.get("tenant"))
         try:
             if resume:
                 self.record_kv_bytes("local", len(doc["payload"]))
@@ -717,6 +723,9 @@ class HttpReplica(Replica):
                                     "trie_blocks")}
         if isinstance(stats.get("timeseries"), dict):
             doc["timeseries"] = stats["timeseries"]
+        usage = stats.get("usage")
+        if isinstance(usage, dict) and usage.get("enabled"):
+            doc["usage"] = usage
         return doc
 
     def collect_spans(self, since_us: int = 0) -> Optional[dict]:

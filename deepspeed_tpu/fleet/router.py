@@ -3,9 +3,13 @@
 Same wire format as ``serving/server.py`` (``POST /v1/generate`` with
 optional SSE streaming, ``POST /v1/resume``, ``GET /v1/stats``,
 ``GET /healthz``) plus ``GET /v1/fleet/stats`` (per-replica dispatch counts,
-roles, breaker states, supervisor slots, probes) and — when fault injection
-is armed with ``allow_remote`` — ``POST /v1/fleet/chaos`` (re-seed/disable
-the chaos harness; what ``bin/dstpu_loadgen --chaos`` drives). A client
+roles, breaker states, supervisor slots, probes), ``GET /v1/fleet/usage``
+(the per-tenant cost rollup summed across replica probe docs — the fleet
+face of each replica's ``/v1/usage`` ledger; tenant identity forwards via
+the JSON ``tenant`` field or the ``X-DSTPU-Tenant`` header) and — when fault
+injection is armed with ``allow_remote`` — ``POST /v1/fleet/chaos``
+(re-seed/disable the chaos harness; what ``bin/dstpu_loadgen --chaos``
+drives). A client
 cannot tell the router from a single replica, which is the point: "millions
 of users" is N replicas behind this process.
 
@@ -79,8 +83,8 @@ from deepspeed_tpu.fleet.replica import (Leg, Replica, ReplicaDied,
 from deepspeed_tpu.inference.v2.ragged.prefix_cache import (DIGEST_HEX,
                                                             digest_chain)
 from deepspeed_tpu.serving.overload import validate_priority
-from deepspeed_tpu.serving.server import (PRIORITY_HEADER, TRACE_HEADER,
-                                          parse_request_body,
+from deepspeed_tpu.serving.server import (PRIORITY_HEADER, TENANT_HEADER,
+                                          TRACE_HEADER, parse_request_body,
                                           retry_after_header)
 from deepspeed_tpu.telemetry import new_span_id, new_trace_id, now_us
 from deepspeed_tpu.utils.logging import logger
@@ -88,7 +92,19 @@ from deepspeed_tpu.utils.logging import logger
 # request fields forwarded verbatim to a replica leg (everything else —
 # stream, session, handoff — is router-interpreted, never blind-forwarded)
 _LEG_FIELDS = ("max_new_tokens", "temperature", "eos_token_id", "deadline_s",
-               "seed", "priority", "drafter")
+               "seed", "priority", "drafter", "tenant")
+
+
+def _merge_usage_row(agg: dict, row: dict) -> None:
+    """Recursively sum a replica's per-tenant usage row into ``agg`` (numeric
+    leaves add; nested dicts like ``tokens``/``wire_bytes`` merge by key)."""
+    for k, v in row.items():
+        if isinstance(v, dict):
+            _merge_usage_row(agg.setdefault(k, {}), v)
+        elif isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        else:
+            agg[k] = agg.get(k, 0) + v
 
 
 class RoutingError(RuntimeError):
@@ -1634,6 +1650,26 @@ class FleetRouter:
                 doc["replicas"][replica.id] = probe["timeseries"]
         return doc
 
+    def fleet_usage(self) -> dict:
+        """``/v1/fleet/usage`` body: the per-tenant cost rollup summed across
+        every replica's probe doc, with the per-replica breakdown alongside.
+        Each replica meters its own dispatches; the router only folds the
+        numeric fields, so fleet tenant totals reconcile exactly against the
+        per-replica ledgers (integer token counts sum losslessly)."""
+        self._manager.sweep_probes()
+        tenants: dict = {}
+        replicas: dict = {}
+        for replica in self._manager.replicas():
+            probe = replica._probe_doc or {}
+            usage = probe.get("usage")
+            if not isinstance(usage, dict) or not usage.get("enabled"):
+                continue
+            replicas[replica.id] = usage
+            for name, row in (usage.get("tenants") or {}).items():
+                _merge_usage_row(tenants.setdefault(name, {}), row)
+        return {"enabled": bool(replicas), "tenants": tenants,
+                "replicas": replicas}
+
     def fleet_slo(self) -> dict:
         """``/v1/fleet/slo`` body: the SLO engine's objective status (burn
         rates, open breach episodes), or ``enabled: false`` without one."""
@@ -1685,6 +1721,8 @@ class FleetRouter:
                     self._send_json(200, router.fleet_timeseries())
                 elif path == "/v1/fleet/slo":
                     self._send_json(200, router.fleet_slo())
+                elif path == "/v1/fleet/usage":
+                    self._send_json(200, router.fleet_usage())
                 elif path == "/healthz":
                     self._send_json(200, {"status": "draining" if draining.is_set()
                                           else "ok"})
@@ -1739,6 +1777,10 @@ class FleetRouter:
                 if not doc.get("priority") and self.headers.get(PRIORITY_HEADER):
                     # header-form priority class, same contract as a replica
                     doc["priority"] = self.headers.get(PRIORITY_HEADER)
+                if not doc.get("tenant") and self.headers.get(TENANT_HEADER):
+                    # header-form tenant identity: forwarded on the leg doc so
+                    # the serving replica bills the right tenant
+                    doc["tenant"] = self.headers.get(TENANT_HEADER)
                 upstream_trace = self.headers.get(TRACE_HEADER) or None
                 try:
                     routed = router.route(doc, resume=resume,
@@ -1838,7 +1880,7 @@ class FleetRouter:
         self._thread.start()
         logger.info(f"fleet router: /v1/generate /v1/resume /v1/stats "
                     f"/v1/fleet/stats /v1/fleet/trace /v1/fleet/timeseries "
-                    f"/v1/fleet/slo /healthz on {self.url}")
+                    f"/v1/fleet/slo /v1/fleet/usage /healthz on {self.url}")
         return self
 
     def stop(self, drain: bool = True, timeout: Optional[float] = None) -> None:

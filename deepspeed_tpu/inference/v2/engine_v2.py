@@ -68,6 +68,14 @@ class InferenceEngineV2:
         # stops it so the engine can always be torn down safely
         self._serving_scheduler = None
 
+        # cost-attribution hook (telemetry/ledger.py + perf/observed.py): a
+        # scheduler with an active telemetry session installs a callable
+        # ``(kind, n_seqs, n_tokens, wall_seconds)`` invoked around every
+        # jitted dispatch (put / decode_loop / verify / verify_tree). None —
+        # the default, and always the case with telemetry off — costs one
+        # attribute load per dispatch.
+        self.dispatch_observer = None
+
         if engine_config.trace_enabled:
             self._tracer = Tracer(max_batches=engine_config.max_trace_batches,
                                   span_recorder=self._telemetry.spans
@@ -187,9 +195,14 @@ class InferenceEngineV2:
         self._batch.finalize()
         self._model.prepare_batch(self._batch)
         spans = self._resolve_spans()
-        if spans is not None:
+        observer = self.dispatch_observer
+        if spans is not None or observer is not None:
             _t0 = _tel_now_us()
         logits = self._model.forward(self._batch)
+        if observer is not None:
+            observer("put", len(batch_uids),
+                     int(sum(t.size for t in batch_tokens)),
+                     (_tel_now_us() - _t0) / 1e6)
         assert logits.shape[0] == self._batch.current_sequences
 
         for uid in batch_uids:
@@ -334,10 +347,14 @@ class InferenceEngineV2:
 
         self._batch.finalize()
         spans = self._resolve_spans()
-        if spans is not None:
+        observer = self.dispatch_observer
+        if spans is not None or observer is not None:
             _t0 = _tel_now_us()
         tokens = self._model.decode_loop(self._batch, n_steps, temperature=temperature,
                                          rng=rng)  # [n_steps, S_bucket]
+        if observer is not None:
+            observer("decode_loop", len(batch_uids),
+                     len(batch_uids) * n_steps, (_tel_now_us() - _t0) / 1e6)
         if spans is not None:
             spans.record("decode_loop", cat="inference", ts_us=_t0,
                          dur_us=_tel_now_us() - _t0,
@@ -398,10 +415,15 @@ class InferenceEngineV2:
         self._batch.finalize()
         self._model.prepare_batch(self._batch)
         spans = self._resolve_spans()
-        if spans is not None:
+        observer = self.dispatch_observer
+        if spans is not None or observer is not None:
             _t0 = _tel_now_us()
         # [T, vocab] logits, or [T] argmax ids when greedy
         rows = np.asarray(self._model.forward_verify(self._batch, greedy=greedy))
+        if observer is not None:
+            observer("verify", len(batch_uids),
+                     int(sum(t.size for t in batch_tokens)),
+                     (_tel_now_us() - _t0) / 1e6)
 
         for uid in batch_uids:
             seq_desc = self._state_manager.get_sequence(uid)
@@ -466,10 +488,15 @@ class InferenceEngineV2:
         self._batch.finalize()
         self._model.prepare_batch(self._batch)
         spans = self._resolve_spans()
-        if spans is not None:
+        observer = self.dispatch_observer
+        if spans is not None or observer is not None:
             _t0 = _tel_now_us()
         rows, hidden = self._model.forward_verify_tree(self._batch, greedy=greedy)
         rows, hidden = np.asarray(rows), np.asarray(hidden)
+        if observer is not None:
+            observer("verify_tree", len(batch_uids),
+                     int(sum(t.size for t in trees)),
+                     (_tel_now_us() - _t0) / 1e6)
 
         for uid in batch_uids:
             seq_desc = self._state_manager.get_sequence(uid)

@@ -6,7 +6,7 @@ validated pydantic-style like the other config blocks (``config_v2.py``,
 ``telemetry/config.py``).
 """
 
-from typing import Literal, Optional, Tuple
+from typing import Dict, Literal, Optional, Tuple
 
 from pydantic import Field, field_validator, model_validator
 
@@ -224,11 +224,77 @@ class OverloadConfig(DeepSpeedConfigModel):
     depth and KV occupancy look healthy. Requires an active telemetry
     session with ``telemetry.slo`` configured; off by default."""
 
+    fair_share_enabled: bool = False
+    """Tenant fair-share stage in the admission path (opt-in): while the
+    brownout controller reports pressure (stage >= 1), a tenant whose
+    measured share of the token rate exceeds ``fair_share_over_factor`` x its
+    configured share is shed first — new submissions 429 with ``Retry-After``
+    and its queued requests are shed ahead of deadline-based shedding
+    (deficit-weighted). Requires ``enabled``; the ``enabled=false`` control
+    arm is untouched."""
+
+    fair_share_shares: Optional[Dict[str, float]] = None
+    """Per-tenant share weights (normalized over tenants seen); None = equal
+    split across every tenant that has submitted. Tenants missing from the
+    map get weight 1.0."""
+
+    fair_share_alpha: float = Field(0.2, gt=0, le=1)
+    """EWMA smoothing for per-tenant measured token rates."""
+
+    fair_share_over_factor: float = Field(1.25, gt=1)
+    """A tenant is over-share when measured share > factor x configured
+    share."""
+
+    fair_share_hysteresis: float = Field(0.25, ge=0)
+    """The over-share verdict clears only below
+    ``(over_factor - hysteresis) x configured share`` (no admit/shed
+    flapping at the boundary)."""
+
     @model_validator(mode="after")
     def _ordered_thresholds(self):
         if list(self.brownout_stage_thresholds) != sorted(self.brownout_stage_thresholds):
             raise ValueError("brownout_stage_thresholds must be ascending")
         return self
+
+
+class CostConfig(DeepSpeedConfigModel):
+    """Cost-attribution plane (``telemetry/ledger.py`` + ``perf/observed.py``):
+    per-request metering, bounded per-tenant rollups (``/v1/usage``), and the
+    predicted-vs-observed perf ledger. The plane only materializes while a
+    telemetry session is active — with telemetry off every hot-path site is a
+    single None check and the registry sees zero api_calls."""
+
+    enabled: bool = True
+    """Meter requests when telemetry is active. False = no ledger even with
+    telemetry on (spans/metrics still record)."""
+
+    default_tenant: str = "default"
+    """Tenant billed for requests that carry no identity (no ``tenant`` JSON
+    field, no ``X-DSTPU-Tenant`` header)."""
+
+    max_tenants: int = Field(64, ge=1)
+    """Bound on distinct tenants in the usage rollup; later tenants fold
+    into ``<other>`` (sums still reconcile against the aggregate)."""
+
+    tenant_metric_top_k: int = Field(8, ge=1)
+    """Bound on per-tenant metric label sets (``serving_tenant_*``); tenants
+    past the cap share the ``<other>`` label."""
+
+    perf_chip: str = "v5e"
+    """Chip spec the observed-vs-predicted join prices rooflines against
+    (``perf/chip_specs.py``); drift detection is baseline-relative, so an
+    off-target chip only shifts the absolute ratio, not the alarm."""
+
+    perf_drift_factor: float = Field(4.0, gt=1)
+    """Observed/predicted ratio above ``factor x baseline`` counts toward a
+    drift episode."""
+
+    perf_drift_consecutive: int = Field(3, ge=1)
+    """Consecutive over-factor dispatches that raise one drift event."""
+
+    perf_baseline_dispatches: int = Field(8, ge=1)
+    """Post-amnesty dispatches averaged into each (program, bucket)'s
+    baseline ratio before drift detection arms."""
 
 
 class ServingConfig(DeepSpeedConfigModel):
@@ -301,6 +367,10 @@ class ServingConfig(DeepSpeedConfigModel):
     kv_tiers: KVTierConfig = KVTierConfig()
     """Tiered KV memory (device→host→disk demotion under pressure); see
     :class:`KVTierConfig`."""
+
+    cost: CostConfig = CostConfig()
+    """Cost-attribution plane: per-request/per-tenant metering ledger and the
+    predicted-vs-observed perf ledger; see :class:`CostConfig`."""
 
     max_resume_body_bytes: int = Field(DEFAULT_MAX_RESUME_BODY_BYTES, gt=0)
     """Upper bound on a ``POST /v1/resume`` body (the base64 KV-handoff

@@ -121,6 +121,10 @@ class ServingMetrics:
         self.brownout_rejections = registry.counter(
             "serving_brownout_rejections_total",
             "Batch-class requests rejected outright at brownout stage 3")
+        self.fair_share_sheds = registry.counter(
+            "serving_fair_share_sheds_total",
+            "Requests shed/429'd by the fair-share stage (tenant over measured "
+            "share under pressure)")
         # tiered KV memory (inference/v2/ragged/tiering.py + serving/kv_tiers.py)
         self.kv_tier_demotions = registry.counter(
             "serving_kv_tier_demotions_total",

@@ -101,6 +101,133 @@ class RateEstimator:
         return n_tokens / rate
 
 
+DEFAULT_TENANT = "default"
+_TENANT_MAX_LEN = 64
+
+
+def validate_tenant(tenant: Optional[str]) -> Optional[str]:
+    """Normalize/validate a wire-level tenant field. None stays None (the
+    scheduler substitutes its configured default tenant at submission);
+    anything else must be a short printable identifier."""
+    if tenant is None:
+        return None
+    tenant = str(tenant).strip()
+    if not tenant:
+        return None
+    if len(tenant) > _TENANT_MAX_LEN:
+        raise ValueError(f"tenant identifier longer than {_TENANT_MAX_LEN} chars")
+    if any(c in tenant for c in "\r\n\x00"):
+        raise ValueError("tenant identifier contains control characters")
+    return tenant
+
+
+class FairSharePolicy:
+    """Deficit-weighted fair-share over measured per-tenant token rates.
+
+    Engine-free (scheduler-composed, like the other pieces here): the
+    scheduler feeds ``observe(tenant, tokens)`` from its execute path — the
+    same committed-token signal the :class:`RateEstimator` sees, split by
+    tenant — and consults ``over_share(tenant)`` at admission and queue-shed
+    time *while the brownout controller reports pressure*.  A tenant is over
+    its share when its measured fraction of the total token rate exceeds
+    ``over_factor`` x its configured share; the verdict is hysteresis-smoothed
+    (it clears only below ``(over_factor - hysteresis) x share``), so a tenant
+    flapping at the boundary is not alternately admitted and shed.
+
+    Shares: an explicit ``shares`` map (weights, normalized over tenants seen
+    so far) or, by default, an equal split across every tenant that has
+    submitted — a lone tenant owns share 1.0 and can never be over it, so the
+    policy is inert until there is someone to be unfair *to*.
+    """
+
+    def __init__(self, shares: Optional[dict] = None, alpha: float = 0.2,
+                 over_factor: float = 1.25, hysteresis: float = 0.25):
+        if over_factor <= 1.0:
+            raise ValueError(f"over_factor must be > 1, got {over_factor}")
+        # the clear threshold (over_factor - hysteresis) must stay positive
+        hysteresis = max(0.0, min(float(hysteresis), over_factor - 1e-3))
+        self._shares = dict(shares) if shares else None
+        self._alpha = alpha
+        self._over_factor = float(over_factor)
+        self._hysteresis = float(hysteresis)
+        self._rates = {}   # tenant -> EWMA tokens/s
+        self._last_s = {}  # tenant -> last observation timestamp
+        self._seen = set()
+        self._over = set()  # tenants currently flagged (hysteresis state)
+        self.sheds = 0      # bumped by the scheduler per fair-share shed
+
+    def note(self, tenant: str) -> None:
+        """Register a tenant sighting (submission) — what the default
+        equal-split share is computed over."""
+        self._seen.add(tenant)
+
+    def observe(self, tenant: str, n_tokens: int,
+                now: Optional[float] = None) -> None:
+        """Fold one executed batch member's committed tokens into the
+        tenant's rate EWMA (same instantaneous-rate construction as
+        :class:`RateEstimator`)."""
+        if n_tokens <= 0:
+            return
+        now = time.monotonic() if now is None else now
+        self._seen.add(tenant)
+        last = self._last_s.get(tenant)
+        self._last_s[tenant] = now
+        if last is None:
+            return
+        dt = now - last
+        if dt <= 0:
+            return
+        inst = n_tokens / dt
+        prev = self._rates.get(tenant)
+        self._rates[tenant] = (inst if prev is None
+                               else (1 - self._alpha) * prev + self._alpha * inst)
+
+    def configured_share(self, tenant: str) -> float:
+        """The tenant's entitled fraction of the measured token rate:
+        its weight over the weights of every tenant seen so far (weight 1.0
+        for tenants the share map does not list — never entitled to zero)."""
+        tenants = self._seen | {tenant}
+        shares = self._shares or {}
+        weights = {t: max(0.0, float(shares.get(t, 1.0))) for t in tenants}
+        total = sum(weights.values())
+        return weights[tenant] / total if total > 0 else 1.0
+
+    def measured_share(self, tenant: str) -> float:
+        total = sum(self._rates.values())
+        if total <= 0:
+            return 0.0
+        return self._rates.get(tenant, 0.0) / total
+
+    def deficit(self, tenant: str) -> float:
+        """measured - configured share: positive = consuming past its
+        entitlement (the queue-shed ordering key, largest first)."""
+        return self.measured_share(tenant) - self.configured_share(tenant)
+
+    def over_share(self, tenant: str) -> bool:
+        """Hysteresis-smoothed over-share verdict (pressure-independent —
+        the *scheduler* gates calls on brownout pressure)."""
+        share = self.configured_share(tenant)
+        measured = self.measured_share(tenant)
+        if tenant in self._over:
+            if measured < (self._over_factor - self._hysteresis) * share:
+                self._over.discard(tenant)
+        elif measured > self._over_factor * share:
+            self._over.add(tenant)
+        return tenant in self._over
+
+    def doc(self) -> dict:
+        """The /v1/stats usage-block fair-share view."""
+        tenants = sorted(self._seen)
+        return {"over_factor": self._over_factor,
+                "hysteresis": self._hysteresis,
+                "sheds": self.sheds,
+                "tenants": {t: {"rate_tokens_per_s": self._rates.get(t),
+                                "measured_share": round(self.measured_share(t), 4),
+                                "configured_share": round(self.configured_share(t), 4),
+                                "over_share": t in self._over}
+                            for t in tenants}}
+
+
 class BrownoutController:
     """Staged degradation driven by a smoothed pressure signal.
 

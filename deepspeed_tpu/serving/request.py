@@ -28,7 +28,8 @@ from typing import Iterator, List, Optional
 
 import numpy as np
 
-from deepspeed_tpu.serving.overload import DEFAULT_PRIORITY, validate_priority
+from deepspeed_tpu.serving.overload import (DEFAULT_PRIORITY, validate_priority,
+                                            validate_tenant)
 from deepspeed_tpu.telemetry import now_us
 
 
@@ -109,7 +110,8 @@ class Request:
                  eos_token_id: Optional[int] = None,
                  deadline_s: Optional[float] = None,
                  seed: int = 0,
-                 priority: str = DEFAULT_PRIORITY):
+                 priority: str = DEFAULT_PRIORITY,
+                 tenant: Optional[str] = None):
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("prompt must contain at least one token")
@@ -122,6 +124,13 @@ class Request:
         self.deadline_s = deadline_s
         self.seed = int(seed)
         self.priority = validate_priority(priority)
+        # tenant identity for the cost-attribution plane: the scheduler
+        # normalizes None to the configured default tenant at submission;
+        # cost is the per-request ledger accumulator
+        # (telemetry.ledger.RequestCost), None while telemetry is off — the
+        # zero-cost contract makes every charging site one None check
+        self.tenant = validate_tenant(tenant)
+        self.cost = None
 
         self.uid: Optional[int] = None  # assigned at admission by the scheduler
         # stable cross-thread identity from birth: the work-stealing path

@@ -39,8 +39,9 @@ from deepspeed_tpu import telemetry
 from deepspeed_tpu.inference.v2.scheduling_utils import SchedulingError, SchedulingResult
 from deepspeed_tpu.serving.config import ServingConfig
 from deepspeed_tpu.serving.metrics import ServingMetrics
-from deepspeed_tpu.serving.overload import (BrownoutController, RateEstimator,
-                                            priority_rank, validate_priority)
+from deepspeed_tpu.serving.overload import (BrownoutController, FairSharePolicy,
+                                            RateEstimator, priority_rank,
+                                            validate_priority)
 from deepspeed_tpu.serving.request import Request, RequestState
 from deepspeed_tpu.telemetry import new_span_id, new_trace_id, now_us
 from deepspeed_tpu.telemetry.flight_recorder import SERVING_SCHEDULER_CHANNEL
@@ -136,7 +137,7 @@ class ServingScheduler:
                            "peer_fetch_hits", "peer_fetch_rejects",
                            "peer_fetch_blocks", "steals",
                            "tier_demotions", "brownout_demotions",
-                           "parks", "rehydrates")}
+                           "parks", "rehydrates", "fair_share_shed")}
         self._stopping = False   # no new submits
         self._shutdown = False   # thread exit
         self._stopped = False
@@ -178,6 +179,44 @@ class ServingScheduler:
             hysteresis=ocfg.brownout_hysteresis,
             alpha=ocfg.pressure_alpha)
         self._brownout_transitions_seen = 0
+
+        # cost-attribution plane (telemetry/ledger.py + perf/observed.py):
+        # both exist only while a telemetry session is active, so every
+        # charging site below is one `is not None` check and disabled
+        # telemetry pays nothing — the same zero-cost contract as _metrics.
+        # The engine's dispatch_observer stashes each jitted call's wall time
+        # here (same thread, same tick) for the execute path to attribute.
+        ccfg = self._config.cost
+        self._ledger = None
+        self._perf_obs = None
+        self._last_dispatch_s = 0.0
+        self._last_dispatch_amnesty_s = 0.0
+        if ccfg.enabled and telemetry.is_active():
+            from deepspeed_tpu.perf.observed import PerfObservedLedger
+            from deepspeed_tpu.telemetry.ledger import CostLedger, PriceBook
+            pricebook = PriceBook.from_model_config(
+                getattr(getattr(engine, "model", None), "config", None))
+            registry = telemetry.get_registry()
+            self._ledger = CostLedger(registry, pricebook,
+                                      max_tenants=ccfg.max_tenants,
+                                      tenant_metric_top_k=ccfg.tenant_metric_top_k,
+                                      default_tenant=ccfg.default_tenant)
+            self._perf_obs = PerfObservedLedger(
+                registry, pricebook, chip=ccfg.perf_chip,
+                drift_factor=ccfg.perf_drift_factor,
+                drift_consecutive=ccfg.perf_drift_consecutive,
+                baseline_dispatches=ccfg.perf_baseline_dispatches)
+            engine.dispatch_observer = self._on_dispatch
+        # fair-share admission (opt-in): the policy itself is pressure-
+        # independent; THIS scheduler gates every consult on brownout stage
+        # >= 1, so an uncontended fleet never sheds on share arithmetic
+        self._fair_share = None
+        if ocfg.enabled and ocfg.fair_share_enabled:
+            self._fair_share = FairSharePolicy(
+                shares=ocfg.fair_share_shares,
+                alpha=ocfg.fair_share_alpha,
+                over_factor=ocfg.fair_share_over_factor,
+                hysteresis=ocfg.fair_share_hysteresis)
 
         # automatic prefix caching: radix-tree KV reuse with copy-on-write
         # block sharing (inference/v2/ragged/prefix_cache.py). All trie
@@ -274,6 +313,55 @@ class ServingScheduler:
             if watch:
                 flight.watch_heartbeat(self._flight_channel)
 
+    # ------------------------------------------------------------ cost plane --
+    def _on_dispatch(self, kind: str, n_seqs: int, n_tokens: int,
+                     seconds: float) -> None:
+        """Engine ``dispatch_observer`` hook (scheduler thread, fired right
+        after each jitted forward): feeds the predicted-vs-observed perf
+        ledger and stashes the wall time — minus any compile amnesty — for
+        the execute path's cost attribution on the same tick."""
+        amnesty = 0.0
+        if self._perf_obs is not None:
+            amnesty = self._perf_obs.observe(kind, n_seqs, n_tokens, seconds)
+        self._last_dispatch_s = seconds - amnesty
+        self._last_dispatch_amnesty_s = amnesty
+
+    def _charge_members(self, members, seconds: Optional[float] = None,
+                        amnesty: Optional[float] = None) -> None:
+        """Bill one executed dispatch to its plan members
+        (``[(req, phase, tokens)]``): ledger attribution amortized by token
+        share, plus the fair-share policy's per-tenant rate EWMAs. Defaults
+        to the observer-stashed wall time of the dispatch that just ran."""
+        if self._ledger is not None and members:
+            self._ledger.charge_dispatch(
+                [(req.cost, phase, tokens) for req, phase, tokens in members],
+                self._last_dispatch_s if seconds is None else seconds,
+                self._last_dispatch_amnesty_s if amnesty is None else amnesty)
+        if self._fair_share is not None:
+            by_tenant: Dict[str, int] = {}
+            for req, _, tokens in members:
+                if req.tenant is not None:
+                    by_tenant[req.tenant] = by_tenant.get(req.tenant, 0) + tokens
+            now = time.monotonic()
+            for tenant, tokens in by_tenant.items():
+                self._fair_share.observe(tenant, tokens, now=now)
+
+    def _touch_kv_plan(self, plan) -> None:
+        """Re-anchor each scheduled request's KV block-second accrual at its
+        current (blocks, tier) — piecewise-constant billing between execute
+        ticks; the final segment closes at ledger finalize."""
+        if self._ledger is None:
+            return
+        sm = self._engine._state_manager
+        now_s = time.monotonic()
+        for req, _ in plan:
+            if req.cost is None:
+                continue
+            seq = sm.get_sequence(req.uid)
+            blocks = seq.cur_allocated_blocks if seq is not None else 0
+            tier = (sm.sequence_tier(req.uid) or "device") if blocks else "device"
+            self._ledger.touch_kv(req.cost, blocks, tier, now_s)
+
     # ------------------------------------------------------------- submission --
     def submit(self,
                prompt,
@@ -287,7 +375,8 @@ class ServingScheduler:
                handoff: bool = False,
                priority: Optional[str] = None,
                park: bool = False,
-               drafter: Optional[str] = None) -> Request:
+               drafter: Optional[str] = None,
+               tenant: Optional[str] = None) -> Request:
         """Enqueue a generation request (any thread). Returns the live
         :class:`Request`; stream tokens from ``request.stream`` or block on
         ``request.result()``. Backpressure per ``config.backpressure``:
@@ -314,7 +403,13 @@ class ServingScheduler:
         per-request A/B lever. A pin the scheduler can't honor (``learned``
         without a loaded draft head, or any pin on a linear prompt_lookup
         scheduler) is ignored, never an error: output is drafter-independent
-        by the bitwise-identity invariant."""
+        by the bitwise-identity invariant.
+
+        ``tenant`` is the cost-attribution identity (JSON field or
+        ``X-DSTPU-Tenant`` header at the HTTP layer): the ledger bills every
+        dispatch/KV/wire charge to it and the opt-in fair-share stage sheds
+        a tenant over its measured share first under pressure. None lands on
+        ``config.cost.default_tenant``."""
         req = Request(prompt,
                       max_new_tokens=max_new_tokens if max_new_tokens is not None
                       else self._config.default_max_new_tokens,
@@ -323,7 +418,8 @@ class ServingScheduler:
                       deadline_s=deadline_s if deadline_s is not None
                       else self._config.default_deadline_s,
                       seed=seed,
-                      priority=validate_priority(priority))
+                      priority=validate_priority(priority),
+                      tenant=tenant)
         req.park_requested = bool(park)
         req._spec_drafter_pin = _validate_drafter_pin(drafter)
         self._admission_gate(req)
@@ -342,7 +438,8 @@ class ServingScheduler:
                       priority: Optional[str] = None,
                       prompt=None,
                       park: bool = False,
-                      drafter: Optional[str] = None) -> Request:
+                      drafter: Optional[str] = None,
+                      tenant: Optional[str] = None) -> Request:
         """Admit a handed-off sequence for decode continuation: ``payload`` is
         an ``engine.export_sequence`` product from a prefill-role peer. The
         scheduler imports it into its engine at admission (on the scheduler
@@ -393,7 +490,8 @@ class ServingScheduler:
                       deadline_s=deadline_s if deadline_s is not None
                       else self._config.default_deadline_s,
                       seed=seed,
-                      priority=validate_priority(priority))
+                      priority=validate_priority(priority),
+                      tenant=tenant)
         req._resume_payload = payload
         req._resume_header = header
         req._rehydrate = prompt is not None
@@ -437,6 +535,10 @@ class ServingScheduler:
     def _enqueue(self, req: Request, trace_id: Optional[str],
                  parent_span_id: Optional[int], handoff: bool) -> Request:
         req.handoff_requested = bool(handoff)
+        if self._ledger is not None:
+            # every admitted request carries a RequestCost from birth (the
+            # charging sites assume it); rejected requests never get one
+            self._ledger.begin(req)
         if self._spans is not None:
             # trace identity is assigned at admission so the HTTP layer can
             # hand the id back in response headers before streaming begins
@@ -517,10 +619,30 @@ class ServingScheduler:
         for the batch class, then the deadline-feasibility estimate. Raises
         :class:`AdmissionRejected` — failing here is cheap; admitting a
         provably-doomed request wastes prefill work and queue capacity."""
+        if req.tenant is None:
+            # every request bills to a concrete tenant from here on (the
+            # ledger, the fair-share EWMAs and the stats rows all key on it)
+            req.tenant = self._config.cost.default_tenant
         ocfg = self._config.overload
         if not ocfg.enabled:
             return
         stage = self._brownout.stage
+        fs = self._fair_share
+        if fs is not None:
+            fs.note(req.tenant)
+            if stage >= 1 and fs.over_share(req.tenant):
+                # the fair-share stage fires only under pressure: a tenant
+                # past over_factor x its configured share is 429'd before
+                # anyone else degrades (hysteresis clears the flag once its
+                # measured rate falls back under the share)
+                self._counters["fair_share_shed"] += 1
+                fs.sheds += 1
+                if self._metrics:
+                    self._metrics.fair_share_sheds.inc()
+                raise AdmissionRejected(
+                    f"fair-share: tenant {req.tenant!r} is over its share "
+                    f"under overload (brownout stage {stage})",
+                    retry_after_s=self.retry_after_s())
         if stage >= 1 and req.priority == "batch":
             if stage >= self._brownout.max_stage:
                 self._counters["brownout_rejected"] += 1
@@ -637,14 +759,21 @@ class ServingScheduler:
         provably unmeetable at the measured rate — before they waste a
         prefill. The feasibility walk runs in scheduling order (work ahead of
         a request is work that WILL run first); the doomed are shed lowest
-        priority / latest deadline first."""
-        rate = self._rate.rate
-        if rate is None or rate <= 0:
-            return  # cannot prove anything on a cold estimator
+        priority / latest deadline first.
+
+        The fair-share pass runs first and independently of the rate
+        estimator (the policy owns its own per-tenant EWMAs): queued work
+        from tenants over their measured share is shed deficit-weighted, so
+        a flooding tenant drains the queue before anyone else loses work."""
         with self._not_full:
             queued = list(self._queue)
         if not queued:
             return
+        self._shed_fair_share(queued)
+        rate = self._rate.rate
+        if rate is None or rate <= 0:
+            return  # cannot prove anything on a cold estimator
+        queued = [r for r in queued if not r.finished]
         margin = self._config.overload.admission_margin
         acc = self._active_work_tokens()
         doomed = []
@@ -674,6 +803,43 @@ class ServingScheduler:
             self._counters["shed_queue"] += 1
             if self._metrics:
                 self._metrics.shed_queue.inc()
+            self._finalize(req, RequestState.FAILED,
+                           error=f"shed: {req.shed_reason}")
+
+    def _shed_fair_share(self, queued: List[Request]) -> None:
+        """Shed queued work from over-share tenants (this only runs from
+        :meth:`_overload_tick`'s stage >= 1 branch — never unpressured).
+        Deficit order: the most-over tenant's requests go first, and every
+        shed carries the same Retry-After contract as any other 429."""
+        fs = self._fair_share
+        if fs is None:
+            return
+        over = [r for r in queued
+                if r.tenant is not None and fs.over_share(r.tenant)]
+        if not over or len(over) == len(queued):
+            # work-conserving guard: shed only while an under-share tenant is
+            # actually waiting behind the over-share work. With no such
+            # victim, dropping queued work frees capacity for nobody — and a
+            # tenant legitimately alone on the engine (its competitors shed
+            # or departed, their stale rate EWMAs still inflating the
+            # measured-share denominator) must not lose work to its own flag.
+            return
+        over.sort(key=lambda r: -fs.deficit(r.tenant))
+        retry_after = self.retry_after_s()
+        for req in over:
+            with self._not_full:
+                try:
+                    self._queue.remove(req)
+                except ValueError:
+                    continue  # raced into admission
+                self._not_full.notify()
+            req.shed_reason = (f"fair-share shed under overload: tenant "
+                               f"{req.tenant!r} is over its share")
+            req.retry_after_s = retry_after
+            self._counters["fair_share_shed"] += 1
+            fs.sheds += 1
+            if self._metrics:
+                self._metrics.fair_share_sheds.inc()
             self._finalize(req, RequestState.FAILED,
                            error=f"shed: {req.shed_reason}")
 
@@ -822,6 +988,9 @@ class ServingScheduler:
                 if self._evict_one({req.uid}):
                     continue
                 return None
+            if self._ledger is not None and req.cost is not None:
+                self._ledger.charge_wire(req.cost, "resume",
+                                         len(req._resume_payload))
             req._resume_payload = None  # imported; the engine owns the KV now
             req._resume_kv = None
             if req._rehydrate:
@@ -993,6 +1162,8 @@ class ServingScheduler:
         # already-indexed prefix
         pc.publish(tokens, ids, int(tokens.size), digests=got)
         sm.kv_cache.free(ids)
+        if self._ledger is not None and req.cost is not None:
+            self._ledger.charge_wire(req.cost, "peer_fetch", len(payload))
         self._counters["peer_fetch_hits"] += 1
         self._counters["peer_fetch_blocks"] += needed
         notify("hit")
@@ -1063,6 +1234,8 @@ class ServingScheduler:
                                f"{req.uid}: {e}")
                 return {"status": "finished"}
             req.finish_reason = None
+            if self._ledger is not None and req.cost is not None:
+                self._ledger.charge_wire(req.cost, "steal", len(payload))
             self._counters["steals"] += 1
             self._finalize(req, RequestState.CANCELLED,
                            error="stolen: exported to a peer replica")
@@ -1126,6 +1299,10 @@ class ServingScheduler:
             raise
         req._fed = seen
         req.cached_tokens = seen
+        if self._ledger is not None and req.cost is not None:
+            # the savings side of the bill: prompt tokens this request did
+            # NOT pay to prefill
+            self._ledger.charge_prefix(req.cost, seen)
         pc.record_hit(len(blocks), seen)  # applied for real: now it counts
         self._counters["prefix_hits"] += 1
         self._counters["prefix_tokens_saved"] += seen
@@ -1541,6 +1718,9 @@ class ServingScheduler:
         now = time.monotonic()
         for req, _ in plan:
             req._last_touch_s = now
+        # close + re-anchor each member's KV block-second segment at its
+        # pre-dispatch occupancy (the final segment closes at finalize)
+        self._touch_kv_plan(plan)
         spans = self._spans
         if spans is not None:
             # capture each request's phase before the processing loop mutates
@@ -1601,6 +1781,9 @@ class ServingScheduler:
                 counts = [self._kept_tokens(req, row)
                           for (req, _), row in zip(plan, rows)]
                 self._rate.observe(sum(counts))
+                # billed work is what the device ran: K decode steps per
+                # member, kept or not (the discarded over-run still computed)
+                self._charge_members([(req, "decode", K) for req, _ in plan])
                 _record_phase_spans(counts=counts)
                 for (req, _), row, kept in zip(plan, rows, counts):
                     req.decode_steps += 1
@@ -1617,6 +1800,10 @@ class ServingScheduler:
                 self._finalize(req, RequestState.FAILED, error=f"engine error: {e}")
             return
         self._rate.observe(sum(int(t.size) for t in tokens))
+        # attribute BEFORE the processing loop flips any PREFILL to DECODE
+        self._charge_members(
+            [(req, "prefill" if req.state is RequestState.PREFILL else "decode",
+              int(toks.size)) for req, toks in plan])
         _record_phase_spans()
         for i, (req, toks) in enumerate(plan):
             if req.state is RequestState.PREFILL:
@@ -1687,6 +1874,10 @@ class ServingScheduler:
         try:
             per_seq = engine.verify([req.uid for req, _ in decode_plan],
                                     [toks for _, toks in decode_plan])
+            # stash the verify dispatch's observed wall time before the
+            # prefill put overwrites the observer slots
+            verify_s = self._last_dispatch_s
+            verify_amnesty_s = self._last_dispatch_amnesty_s
             prefill_logits = (np.asarray(engine.put(
                 [req.uid for req, _ in prefill_plan],
                 [toks for _, toks in prefill_plan])) if prefill_plan else None)
@@ -1699,6 +1890,12 @@ class ServingScheduler:
         # the estimator measures engine-token throughput: verify feeds cost
         # their full width (accepted or not), like any other fed token
         self._rate.observe(sum(int(t.size) for _, t in plan))
+        self._charge_members([(req, "verify", int(t.size))
+                              for req, t in decode_plan],
+                             seconds=verify_s, amnesty=verify_amnesty_s)
+        if prefill_plan:
+            self._charge_members([(req, "prefill", int(t.size))
+                                  for req, t in prefill_plan])
         alpha = self._config.speculative.accept_alpha
         # sample/accept BEFORE any push: span token counts must be final when
         # the root span closes, and each request's private stream makes the
@@ -1721,6 +1918,8 @@ class ServingScheduler:
                 # acceptance evidence, no EWMA movement
                 req.spec_drafted += k
                 req.spec_accepted += accepted
+                if self._ledger is not None and req.cost is not None:
+                    self._ledger.charge_spec(req.cost, k, accepted)
                 self._counters["spec_steps"] += 1
                 self._counters["spec_drafted"] += k
                 self._counters["spec_rollback"] += rejected
@@ -1805,6 +2004,10 @@ class ServingScheduler:
         try:
             per_seq = engine.verify_tree([req.uid for req, _ in decode_plan],
                                          trees, greedy=greedy)
+            # stash the tree-verify dispatch's observed wall time before the
+            # prefill put overwrites the observer slots
+            verify_s = self._last_dispatch_s
+            verify_amnesty_s = self._last_dispatch_amnesty_s
             prefill_logits = (np.asarray(engine.put(
                 [req.uid for req, _ in prefill_plan],
                 [toks for _, toks in prefill_plan])) if prefill_plan else None)
@@ -1817,6 +2020,12 @@ class ServingScheduler:
         # verify feeds cost their full width (accepted or not), like any fed
         # token — tree nodes included
         self._rate.observe(sum(int(t.size) for _, t in plan))
+        self._charge_members([(req, "tree_verify", int(t.size))
+                              for req, t in decode_plan],
+                             seconds=verify_s, amnesty=verify_amnesty_s)
+        if prefill_plan:
+            self._charge_members([(req, "prefill", int(t.size))
+                                  for req, t in prefill_plan])
         alpha = self._config.speculative.accept_alpha
         # sample/accept BEFORE any push: span token counts must be final when
         # the root span closes, and each request's private stream makes the
@@ -1864,6 +2073,8 @@ class ServingScheduler:
                 rate = accepted / max(int(tree.max_depth), 1)
                 req.spec_drafted += k
                 req.spec_accepted += accepted
+                if self._ledger is not None and req.cost is not None:
+                    self._ledger.charge_spec(req.cost, k, accepted)
                 self._counters["spec_steps"] += 1
                 self._counters["spec_drafted"] += k
                 self._counters["spec_rollback"] += rejected
@@ -2020,6 +2231,9 @@ class ServingScheduler:
                     # device_get the whole KV only for the router to discard it
                     try:
                         req.handoff_payload = self._export_handoff(req)
+                        if self._ledger is not None and req.cost is not None:
+                            self._ledger.charge_wire(req.cost, "handoff",
+                                                     len(req.handoff_payload))
                     except Exception:  # pragma: no cover - defensive: a failed
                         # export degrades to a non-continuable response
                         logger.exception(f"serving: handoff export failed for "
@@ -2032,6 +2246,9 @@ class ServingScheduler:
                     # rehydrates with a longer prompt, no next_token needed)
                     try:
                         req.park_payload = self._export_park(req)
+                        if self._ledger is not None and req.cost is not None:
+                            self._ledger.charge_wire(req.cost, "park",
+                                                     len(req.park_payload))
                         self._counters["parks"] += 1
                     except Exception:  # pragma: no cover - defensive: a failed
                         # park degrades to a cold next turn
@@ -2048,6 +2265,10 @@ class ServingScheduler:
                 self._engine.flush(req.uid)  # returns KV blocks (incl. offloaded)
         req._set_state(state)
         self._counters[self._FINAL_COUNTER[state]] += 1
+        if self._ledger is not None and req.cost is not None:
+            # close the open KV segment and fold the bill into the tenant
+            # rollup — conservation holds once every request finalizes
+            self._ledger.finalize(req, time.monotonic())
         spans = self._spans  # bind once: the property re-resolves
         if spans is not None and req.trace_id is not None:
             # the trace's root: arrival → terminal state, with the ids every
@@ -2150,8 +2371,16 @@ class ServingScheduler:
                 self._metrics.prefix_trie_blocks.set(0)
         if getattr(self._engine, "_serving_scheduler", None) is self:
             self._engine._serving_scheduler = None
+        self._detach_observer()
         self._attach_flight(None)
         self._stopped = True
+
+    def _detach_observer(self) -> None:
+        """Clear the engine's dispatch observer iff it is still ours — a
+        stopped scheduler must not keep feeding (or block a successor from
+        installing) the cost plane's timing hook."""
+        if getattr(self._engine, "dispatch_observer", None) == self._on_dispatch:
+            self._engine.dispatch_observer = None
 
     def _has_work(self) -> bool:
         return (bool(self._queue) or bool(self._active)
@@ -2196,6 +2425,7 @@ class ServingScheduler:
                 self._metrics.prefix_trie_blocks.set(0)
         if getattr(self._engine, "_serving_scheduler", None) is self:
             self._engine._serving_scheduler = None
+        self._detach_observer()
         self._attach_flight(None)
         self._stopped = True
 
@@ -2235,12 +2465,16 @@ class ServingScheduler:
             "uid": req.uid,
             "state": req.state.name,
             "priority": req.priority,
+            "tenant": req.tenant,
             "prompt_tokens": int(req.prompt.size),
             "cached_tokens": req.cached_tokens,
             "generated": len(req.tokens),
             "age_s": now - req.arrival_s,
             "ttft_s": req.ttft_s,
             "trace_id": req.trace_id,
+            # cost-to-date (None with telemetry off): post-mortems and the
+            # stats surface see the bill as it accrues, not only at the end
+            "cost": req.cost.compact_row() if req.cost is not None else None,
         }
 
     def _latency_percentiles(self) -> Optional[dict]:
@@ -2290,6 +2524,17 @@ class ServingScheduler:
                                     ("prompt_lookup", "lookup"))}
         return out
 
+    def usage(self) -> dict:
+        """The ``/v1/usage`` document: ledger totals, per-tenant rollups,
+        pricing, and the fair-share posture. ``{"enabled": False}`` with
+        telemetry (or the cost plane) off — the endpoint stays useful as a
+        feature probe either way."""
+        doc = (self._ledger.usage_doc() if self._ledger is not None
+               else {"enabled": False})
+        if self._fair_share is not None:
+            doc["fair_share"] = self._fair_share.doc()
+        return doc
+
     def stats(self) -> dict:
         queued, active = self._snapshot_requests()
         return self._stats_doc(queued, active)
@@ -2327,6 +2572,9 @@ class ServingScheduler:
             "speculative": self._spec_stats(),
             "kv_tiers": (self._kv_tiers.stats(self._prefix_cache)
                          if self._kv_tiers is not None else None),
+            "usage": self.usage(),
+            "perf": (self._perf_obs.doc()
+                     if self._perf_obs is not None else None),
             "timeseries": (ts.snapshot(max_points=64)
                            if (ts := telemetry.get_timeseries()) is not None
                            else None),

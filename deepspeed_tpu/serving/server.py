@@ -38,8 +38,14 @@ ephemeral-port support), serving the request lifecycle instead of metrics:
   ``X-DSTPU-Trace-Id`` / ``X-DSTPU-Parent-Span`` request headers, so the
   fleet router's hop parents the replica's request track.
 - ``GET /v1/stats`` — scheduler + engine occupancy JSON: per-request rows
-  (uid, state, age, trace id) and p50/p95/p99 TTFT/ITL/e2e when telemetry is
-  active.
+  (uid, state, tenant, cost-to-date, age, trace id), p50/p95/p99
+  TTFT/ITL/e2e, the ``usage`` rollup and the predicted-vs-observed ``perf``
+  join when telemetry is active.
+- ``GET /v1/usage`` — the cost-attribution document: ledger totals, the
+  per-tenant rollup, pricing, and the fair-share posture
+  (``{"enabled": false}`` with telemetry off). Requests carry a tenant
+  identity via the JSON ``tenant`` field or the ``X-DSTPU-Tenant`` header;
+  unlabeled traffic bills to the configured default tenant.
 - ``GET /healthz`` — liveness (same contract as the telemetry exporter).
 
 With a telemetry session active every request is traced end-to-end: the
@@ -68,7 +74,7 @@ from deepspeed_tpu.inference.v2.ragged.handoff import \
     CONTENT_TYPE as HANDOFF_CONTENT_TYPE
 from deepspeed_tpu.serving.config import (DEFAULT_MAX_RESUME_BODY_BYTES,
                                           ServingConfig)
-from deepspeed_tpu.serving.overload import validate_priority
+from deepspeed_tpu.serving.overload import validate_priority, validate_tenant
 from deepspeed_tpu.serving.request import Request
 from deepspeed_tpu.serving.scheduler import (AdmissionRejected, QueueFullError,
                                              SchedulerStopped, ServingScheduler)
@@ -88,6 +94,9 @@ PARENT_SPAN_HEADER = "X-DSTPU-Parent-Span"
 # priority class (interactive | batch) — header form; the JSON body's
 # "priority" field wins when both are present
 PRIORITY_HEADER = "X-DSTPU-Priority"
+# cost-attribution tenant identity — header form; the JSON body's "tenant"
+# field wins when both are present (same precedence as priority)
+TENANT_HEADER = "X-DSTPU-Tenant"
 # fleet data motion: the request's steal handle (sent up-front on SSE
 # responses so the router can address a live request), the generation params
 # riding a binary-transport resume POST, the client's handoff-return
@@ -106,6 +115,14 @@ def request_priority(handler, doc: dict) -> Optional[str]:
     ``ValueError`` on an unknown class (callers answer 400)."""
     raw = doc.get("priority") or handler.headers.get(PRIORITY_HEADER) or None
     return validate_priority(raw) if raw is not None else None
+
+
+def request_tenant(handler, doc: dict) -> Optional[str]:
+    """The request's tenant identity from the JSON ``tenant`` field (wins) or
+    the ``X-DSTPU-Tenant`` header; None = the scheduler's default tenant.
+    Raises ``ValueError`` on a malformed identifier (callers answer 400)."""
+    raw = doc.get("tenant") or handler.headers.get(TENANT_HEADER) or None
+    return validate_tenant(raw)
 
 
 def retry_after_header(seconds: float) -> str:
@@ -236,7 +253,13 @@ def _request_doc(req: Request, raw_handoff: bool = False,
         "e2e_s": req.e2e_s,
         "trace_id": req.trace_id,
         "priority": req.priority,
+        "tenant": req.tenant,
     }
+    if req.cost is not None:
+        # the per-request bill (telemetry active): device-seconds by phase,
+        # priced token work, KV block-seconds by tier, wire bytes by channel,
+        # and the cache/spec savings — same shape as the /v1/usage rollup rows
+        doc["cost"] = req.cost.to_dict()
     if req.spec_drafted:
         # speculative decoding rode this request: drafted/accepted let a
         # client (and the loadgen --spec-demo report) compute acceptance rate
@@ -363,6 +386,10 @@ class ServingServer:
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"
                 if path == "/v1/stats":
                     self._send_json(200, scheduler.stats())
+                elif path == "/v1/usage":
+                    # cost attribution: ledger totals + per-tenant rollup +
+                    # fair-share posture ({"enabled": false} w/o telemetry)
+                    self._send_json(200, scheduler.usage())
                 elif path.startswith("/v1/handoff/"):
                     # claim-once binary handoff fetch (the "ref" transport's
                     # second half): the raw frame, exactly once
@@ -514,7 +541,8 @@ class ServingServer:
                                   handoff=bool(doc.get("handoff")),
                                   park=bool(doc.get("park")),
                                   priority=request_priority(self, doc),
-                                  drafter=doc.get("drafter"))
+                                  drafter=doc.get("drafter"),
+                                  tenant=request_tenant(self, doc))
                     if path == "/v1/resume":
                         # a resume body MAY carry a prompt: the rehydrate form
                         # (parked session returning with its next turn)
@@ -615,7 +643,8 @@ class ServingServer:
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="dstpu-serving-http", daemon=True)
         self._thread.start()
-        logger.info(f"serving: /v1/generate /v1/resume /v1/stats /healthz on {self.url}")
+        logger.info(f"serving: /v1/generate /v1/resume /v1/stats /v1/usage "
+                    f"/healthz on {self.url}")
         return self
 
     # ------------------------------------------------------------------ stop --

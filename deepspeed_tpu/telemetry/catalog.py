@@ -103,6 +103,20 @@ METRIC_FAMILIES = {
     "serving_brownout_transitions_total": "brownout stage changes (hysteresis-smoothed)",
     "serving_brownout_clamped_total": "batch-class requests whose max_new_tokens was brownout-clamped",
     "serving_brownout_rejections_total": "batch-class requests rejected outright at brownout stage 3",
+    # cost attribution plane (telemetry/ledger.py, serving/metrics.py,
+    # perf/observed.py)
+    "serving_cost_billed_tokens_total": "tokens billed by the cost ledger, by engine phase",
+    "serving_cost_device_seconds_total": "dispatch wall-seconds attributed to requests (amortized over batch occupants)",
+    "serving_cost_amnesty_seconds_total": "dispatch wall-seconds forgiven as compile amnesty (first sight of a (program, bucket))",
+    "serving_cost_kv_block_seconds_total": "KV block-seconds billed to requests, by residency tier",
+    "serving_cost_wire_bytes_total": "KV payload bytes billed to requests, by motion channel",
+    "serving_cost_saved_tokens_total": "tokens the request did NOT pay for (prefix-cache hits, accepted spec drafts)",
+    "serving_tenant_tokens_total": "tokens billed per tenant (top-K tenants; overflow under <other>)",
+    "serving_tenant_requests_total": "finished requests per tenant (top-K tenants; overflow under <other>)",
+    "serving_fair_share_sheds_total": "requests shed/429'd by the fair-share stage (tenant over measured share under pressure)",
+    "perf_observed_dispatch_seconds": "wall seconds around the engine's jitted dispatches, by program/bucket",
+    "perf_observed_ratio": "observed dispatch seconds over roofline-predicted step seconds",
+    "perf_drift_events_total": "sustained observed-vs-predicted dispatch-time drift episodes",
     # compile watch (telemetry/compile_watch.py)
     "compile_cache_misses_total": "XLA backend compiles (jit cache misses), by site",
     "compile_seconds_total": "cumulative XLA compile wall seconds, by site",

@@ -88,7 +88,8 @@ class SLOObjectiveConfig(DeepSpeedConfigModel):
 
     metric: str = "ttft"
     """Objective kind: ``ttft`` | ``itl`` | ``e2e`` (latency percentile
-    objectives), ``error_rate``, or ``goodput``."""
+    objectives), ``error_rate``, ``goodput``, or ``perf_drift``
+    (observed-vs-predicted dispatch-time drift events per dispatch)."""
 
     target_s: float = 1.0
     """Latency bound (seconds) an observation must meet — latency kinds."""

@@ -115,10 +115,18 @@ class Histogram(_Metric):
     def quantile(self, q):
         """Bucket-based quantile estimate (the ``histogram_quantile`` a
         Prometheus server would compute, done locally): linear interpolation
-        inside the bucket holding the q-th observation. Returns None with no
-        observations; the tail past the last finite bucket clamps to that
-        bucket's bound (its true upper edge is unknown). A read, like
-        ``samples()`` — not a counted telemetry call."""
+        inside the bucket holding the q-th observation. A read, like
+        ``samples()`` — not a counted telemetry call.
+
+        Edge cases are pinned, not left to bucket math:
+
+        - no observations: returns None for every q;
+        - ``q == 0``: the lower edge of the first non-empty bucket (the
+          distribution's known lower bound);
+        - ``q == 1``: the upper bound (``le``) of the last non-empty bucket —
+          or the last finite bucket's bound when observations landed past it
+          (the overflow tail's true upper edge is unknown, so the estimate
+          clamps there, same as any tail quantile)."""
         if not 0.0 <= q <= 1.0:
             raise ValueError(f"quantile q must be in [0, 1], got {q}")
         with self._registry._lock:
@@ -126,6 +134,21 @@ class Histogram(_Metric):
             bucket_counts = list(self.bucket_counts)
         if count == 0:
             return None
+        if q == 0.0:
+            prev_le = 0.0
+            for le, n in zip(self.buckets, bucket_counts):
+                if n > 0:
+                    return prev_le
+                prev_le = le
+            return float(self.buckets[-1])  # every observation overflowed
+        if q == 1.0:
+            last_le = None
+            for le, n in zip(self.buckets, bucket_counts):
+                if n > 0:
+                    last_le = float(le)
+            if last_le is None or count > sum(bucket_counts):
+                return float(self.buckets[-1])  # overflow tail: clamp
+            return last_le
         target = q * count
         cum, prev_le = 0, 0.0
         for le, n in zip(self.buckets, bucket_counts):

@@ -17,6 +17,10 @@ Objective kinds:
   the windowed error ratio, allowed is ``1 - target_ratio``.
 - ``goodput`` — completions over all admission outcomes (terminal states
   plus rejections/sheds); bad fraction is ``1 - goodput ratio``.
+- ``perf_drift`` — observed-vs-predicted dispatch drift: drift events
+  (``perf_drift_events_total``) over engine dispatches observed in the window
+  (the ``perf_observed_dispatch_seconds`` count) — the alarm surface for the
+  cost plane's perf ledger.
 
 Everything here runs on the sampler thread, off the request path; the
 zero-cost-when-disabled contract is inherited from the store.
@@ -94,6 +98,13 @@ class SLOEngine:
                 tuple(f for f in _GOODPUT_TOTAL if f not in _GOODPUT_GOOD),
                 _GOODPUT_TOTAL, window_s)
             return frac
+        if spec.metric == "perf_drift":
+            events = self.store.window_delta("perf_drift_events_total", window_s)
+            dispatches = self.store.window_hist_delta(
+                "perf_observed_dispatch_seconds", window_s)
+            if events is None or dispatches is None or dispatches[0] <= 0:
+                return None
+            return max(0.0, min(1.0, events / dispatches[0]))
         return None
 
     def burn_rate(self, spec, window_s):

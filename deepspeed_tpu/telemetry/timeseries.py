@@ -11,6 +11,10 @@ bucket-count difference between the first and last point inside the window
 
 Aggregation is per *family*: label sets are summed elementwise at sample
 time, matching how the SLO engine and the sparkline report consume them.
+A family spec may carry a split label — ``"serving_tenant_tokens_total{tenant}"``
+— which instead keeps one series per value of that label (series are named
+``family{label="value"}``); the per-tenant cost families ride this, bounded
+upstream by the ledger's top-K tenant label cap.
 
 Zero-cost contract: nothing here runs unless a telemetry session with
 ``timeseries.enabled`` starts the sampler thread; instrumented hot paths are
@@ -44,6 +48,14 @@ DEFAULT_FAMILIES = (
     "fleet_global_queue_depth",
     "fleet_global_queue_expired_total",
     "slo_burn_rate",
+    # cost attribution plane: per-tenant billed tokens (split per tenant,
+    # label cardinality bounded by the ledger's top-K cap), fair-share sheds,
+    # device-seconds burn, and the predicted-vs-observed drift surface
+    "serving_tenant_tokens_total{tenant}",
+    "serving_fair_share_sheds_total",
+    "serving_cost_device_seconds_total",
+    "perf_observed_dispatch_seconds",
+    "perf_drift_events_total",
 )
 
 
@@ -106,6 +118,16 @@ class TimeSeriesStore:
         self.interval_s = float(interval_s)
         self.retention_points = int(retention_points)
         self.families = tuple(families) if families else DEFAULT_FAMILIES
+        # "family" samples the label-set sum; "family{label}" keeps one
+        # series per value of that label instead
+        self._plain = set()
+        self._split = {}  # family -> split label key
+        for fam in self.families:
+            if fam.endswith("}") and "{" in fam:
+                base, label = fam[:-1].split("{", 1)
+                self._split[base] = label
+            else:
+                self._plain.add(fam)
         self._lock = threading.Lock()
         self._series = {}  # family -> {"kind", "buckets", "points": deque((t, value))}
         self._on_tick = []
@@ -117,18 +139,22 @@ class TimeSeriesStore:
     def _sample_families(self):
         """Aggregate each selected family across its label sets. Reads the
         registry under its lock (like ``samples()``) — not a counted call."""
-        wanted = set(self.families)
         out = {}
         with self._registry._lock:
             for (name, _), metric in self._registry._metrics.items():
-                if name not in wanted:
-                    continue
+                if name in self._plain:
+                    key = name
+                else:
+                    label = self._split.get(name)
+                    if label is None:
+                        continue
+                    key = f'{name}{{{label}="{metric.labels.get(label, "")}"}}'
                 if metric.kind == "histogram":
-                    prev = out.get(name)
+                    prev = out.get(key)
                     if prev is None:
-                        out[name] = ("histogram", metric.buckets,
-                                     _HistPoint(metric.count, metric.sum,
-                                                list(metric.bucket_counts)))
+                        out[key] = ("histogram", metric.buckets,
+                                    _HistPoint(metric.count, metric.sum,
+                                               list(metric.bucket_counts)))
                     else:
                         point = prev[2]
                         point.count += metric.count
@@ -136,9 +162,9 @@ class TimeSeriesStore:
                         for i, n in enumerate(metric.bucket_counts):
                             point.bucket_counts[i] += n
                 else:
-                    prev = out.get(name)
+                    prev = out.get(key)
                     value = metric.value + (prev[2] if prev else 0.0)
-                    out[name] = (metric.kind, None, value)
+                    out[key] = (metric.kind, None, value)
         return out
 
     def tick(self, now=None):

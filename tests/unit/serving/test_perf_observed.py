@@ -1,0 +1,116 @@
+"""Predicted-vs-observed perf through the real serving path (ISSUE tentpole
+c): every program a workload exercises gets a populated observed/predicted
+ratio, and a chaos-injected dispatch slowdown raises a drift event while an
+identical control run stays quiet — the observed-vs-predicted gate.
+"""
+
+import numpy as np
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.serving import RequestState, ServingConfig, ServingScheduler
+from deepspeed_tpu.serving.config import CostConfig
+
+MAX_STEPS = 400
+
+
+def _run_until(sched, pred, max_steps=MAX_STEPS):
+    for _ in range(max_steps):
+        if pred():
+            return
+        sched.step()
+    raise AssertionError(f"predicate not reached in {max_steps} steps")
+
+
+def _prompt(n=9, vocab=64):
+    return (np.arange(n) % vocab).tolist()
+
+
+def test_ratio_populated_for_every_program_exercised(make_engine):
+    """The gate's first clause: after a workload, every (program, bucket) the
+    engine dispatched past its compile amnesty reports a live ratio — in the
+    /v1/stats perf block AND as a perf_observed_ratio gauge."""
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+    engine = make_engine()
+    sched = ServingScheduler(engine, ServingConfig(), start=False)
+    try:
+        # two identical waves: the second re-dispatches every (program,
+        # bucket) the first compile-amnestied, so both flagship programs
+        # report post-amnesty observations
+        for _ in range(2):
+            reqs = [sched.submit(_prompt(), max_new_tokens=6)
+                    for _ in range(2)]
+            _run_until(sched, lambda: all(r.finished for r in reqs))
+            assert all(r.state is RequestState.DONE for r in reqs)
+
+        perf = sched.stats()["perf"]
+        assert perf["chip"]  # roofline joined against a concrete chip spec
+        rows = perf["programs"]
+        assert rows, "no programs observed — the dispatch observer rotted?"
+        exercised = [r for r in rows if r["dispatches"] > 0]
+        # prefill + repeated same-size decode: both flagship programs show up
+        assert {r["program"] for r in exercised} \
+            >= {"prefix_suffix_prefill", "paged_decode_step"}
+        for row in exercised:
+            assert row["ratio"] is not None and row["ratio"] > 0
+            assert row["predicted_s"] > 0
+            assert row["observed_p50_s"] is not None
+
+        snap = telemetry.get_registry().snapshot()
+        gauges = {(labels["program"], labels["bucket"]): v
+                  for labels, v in snap["perf_observed_ratio"]}
+        for row in exercised:
+            assert gauges[(row["program"], str(row["bucket"]))] > 0
+    finally:
+        sched.stop(drain=False)
+
+
+def _run_arm(make_engine, inject_delay_s):
+    """One chaos-gate arm: freeze a baseline on a steady decode workload,
+    then (chaos arm) inflate every subsequent dispatch's observed wall time
+    via the engine's observer chain — the deterministic stand-in for a
+    seeded perf fault — and report the drift evidence."""
+    telemetry.shutdown()
+    telemetry.state.registry = None
+    telemetry.configure(telemetry.TelemetryConfig(enabled=True))
+    engine = make_engine()
+    cfg = ServingConfig(cost=CostConfig(perf_baseline_dispatches=2,
+                                        perf_drift_consecutive=2,
+                                        perf_drift_factor=4.0))
+    sched = ServingScheduler(engine, cfg, start=False)
+    try:
+        # enough same-size decode ticks to pass amnesty AND freeze a baseline
+        warm = sched.submit(_prompt(), max_new_tokens=8)
+        _run_until(sched, lambda: warm.finished)
+        assert any(r["baseline_ratio"] is not None
+                   for r in sched.stats()["perf"]["programs"])
+        if inject_delay_s:
+            orig = engine.dispatch_observer
+            engine.dispatch_observer = \
+                lambda kind, n_seqs, n_tokens, seconds: \
+                orig(kind, n_seqs, n_tokens, seconds + inject_delay_s)
+        slow = sched.submit(_prompt(), max_new_tokens=8)
+        _run_until(sched, lambda: slow.finished)
+        drift_events = sum(r["drift_events"]
+                           for r in sched.stats()["perf"]["programs"])
+        events = [e for e in telemetry.get_registry().recent_events_snapshot()
+                  if e.get("event") == "perf_drift"]
+        snap = telemetry.get_registry().snapshot()
+        counter = sum(v for _, v in snap.get("perf_drift_events_total", []))
+        return drift_events, events, counter
+    finally:
+        sched.stop(drain=False)
+
+
+def test_injected_slowdown_raises_drift_event_control_quiet(make_engine):
+    # control first: the identical workload with no injection stays quiet
+    drift, events, counter = _run_arm(make_engine, 0.0)
+    assert drift == 0 and counter == 0 and not events
+
+    # chaos arm: +250ms on every observed dispatch is far past
+    # drift_factor x any sane CPU baseline for these tiny steps
+    drift, events, counter = _run_arm(make_engine, 0.25)
+    assert drift >= 1 and counter >= 1
+    assert events, "drift fired but no perf_drift event reached the registry"
+    assert events[-1]["ratio"] > events[-1]["baseline"]
+    assert events[-1]["program"] in ("paged_decode_step",
+                                     "prefix_suffix_prefill")

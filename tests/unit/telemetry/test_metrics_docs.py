@@ -71,7 +71,8 @@ def test_every_http_route_is_documented_in_readme():
     for rel in _SERVER_SOURCES:
         with open(os.path.join(SRC, rel)) as f:
             routes.update(_ROUTE_RE.findall(f.read()))
-    assert {"/v1/generate", "/healthz", "/metrics"} <= routes, (
+    assert {"/v1/generate", "/healthz", "/metrics",
+            "/v1/usage", "/v1/fleet/usage"} <= routes, (
         f"the route scan missed known endpoints — regex rotted? got {sorted(routes)}")
     with open(README) as f:
         readme = f.read()
@@ -89,12 +90,28 @@ def test_runtime_registration_stays_within_catalog(tmp_path):
     from deepspeed_tpu.telemetry.config import FlightRecorderConfig
     from deepspeed_tpu.telemetry.flight_recorder import FlightRecorder
 
+    from deepspeed_tpu.perf.observed import PerfObservedLedger
+    from deepspeed_tpu.telemetry.ledger import CostLedger, PriceBook
+
     reg = telemetry.MetricsRegistry()
     ServingMetrics(reg)
     watch = CompileWatch(reg)
     watch._metrics_for("train")
     recorder = FlightRecorder(FlightRecorderConfig(dir=str(tmp_path)), reg)
     recorder.dump("api")
+    # the cost plane registers lazily per label — exercise every family
+    ledger = CostLedger(reg, PriceBook())
+    req = type("R", (), {"tenant": "t", "cost": None})()
+    ledger.begin(req)
+    ledger.charge_dispatch([(req.cost, "decode", 1)], seconds=1e-3)
+    ledger.charge_wire(req.cost, "handoff", 1)
+    ledger.touch_kv(req.cost, 1, "device", 0.0)
+    ledger.finalize(req, 1.0)
+    perf = PerfObservedLedger(reg, PriceBook(), baseline_dispatches=1,
+                              drift_consecutive=1)
+    perf.observe("decode_loop", 1, 1, 1e-3)   # amnesty
+    perf.observe("decode_loop", 1, 1, 1e-3)   # baseline
+    perf.observe("decode_loop", 1, 1, 1e3)    # drift counter family
     registered = {name for (name, _) in reg._metrics}
     assert registered, "nothing registered — the instantiation path rotted?"
     assert registered <= set(METRIC_FAMILIES), (

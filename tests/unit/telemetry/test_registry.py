@@ -120,6 +120,38 @@ def test_histogram_quantile_tail_clamps_to_last_bucket():
     assert reg.api_calls == 1  # just the observe
 
 
+def test_histogram_quantile_pinned_edges():
+    """The documented q=0 / q=1 / empty contracts (not emergent bucket math)."""
+    reg = MetricsRegistry()
+    h = reg.histogram("edge_seconds", "e", buckets=(0.01, 0.1, 1.0))
+    # empty: None for EVERY q, the edges included
+    assert h.quantile(0.0) is None
+    assert h.quantile(1.0) is None
+    for _ in range(5):
+        h.observe(0.05)  # all in the (0.01, 0.1] bucket
+    # q=0 is the lower edge of the first non-empty bucket...
+    assert h.quantile(0.0) == 0.01
+    # ...and q=1 the upper bound (le) of the last non-empty one
+    assert h.quantile(1.0) == 0.1
+    h.observe(0.005)  # first bucket's lower edge is the implicit 0.0
+    assert h.quantile(0.0) == 0.0
+    assert h.quantile(1.0) == 0.1
+
+
+def test_histogram_quantile_edges_with_overflow_tail():
+    reg = MetricsRegistry()
+    h = reg.histogram("ovf_seconds", "o", buckets=(0.01, 1.0))
+    h.observe(50.0)  # every observation past the last finite bucket
+    # the tail's true edges are unknown: both ends clamp to the last bound
+    assert h.quantile(0.0) == 1.0
+    assert h.quantile(1.0) == 1.0
+    h.observe(0.5)  # now the (0.01, 1.0] bucket holds the q=0 floor
+    assert h.quantile(0.0) == 0.01
+    assert h.quantile(1.0) == 1.0  # overflow still clamps the top
+    # edge reads are reads: observes were the only counted calls
+    assert reg.api_calls == 2
+
+
 def test_api_call_counting():
     """The registry counts every telemetry API call — the probe the disabled-
     hot-path test relies on."""
